@@ -1,0 +1,51 @@
+"""Test configuration: a forced 8-device CPU mesh.
+
+The reference can only test distributed code with real multi-GPU torchrun
+(SURVEY.md §4). On TPU/JAX we get a single-process multi-device simulation:
+8 virtual CPU devices + Pallas TPU interpret mode (which simulates remote
+DMAs and semaphores), so the whole distributed test suite runs on any
+machine.
+"""
+
+import os
+
+# Must be set before the CPU backend is initialized.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The environment pins JAX_PLATFORMS=axon (a tunneled single real TPU chip).
+# Tests run on the virtual CPU mesh instead; the benchmark (bench.py) is what
+# runs on real TPU hardware.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def mesh8(devices):
+    """1-D tp=8 mesh (the reference's default TP group of all ranks)."""
+    from jax.sharding import Mesh
+    return Mesh(np.array(devices), ("tp",))
+
+
+@pytest.fixture()
+def mesh4x2(devices):
+    from jax.sharding import Mesh
+    return Mesh(np.array(devices).reshape(4, 2), ("tp", "ep"))
+
+
+@pytest.fixture()
+def key():
+    return jax.random.PRNGKey(0)

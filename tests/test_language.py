@@ -1,0 +1,145 @@
+"""Device-primitive tests (reference analogs: test_distributed_wait.py,
+test_nvshmem_api.py, test_common_ops.py — here runnable single-process via
+Pallas TPU interpret mode on the 8-device CPU mesh)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.language as dl
+from triton_dist_tpu.language import shmem
+from triton_dist_tpu.ops.common import comm_params, resolve_interpret
+
+
+def _run_1d(mesh, kernel, x, out_shape=None, scratch_shapes=(),
+            collective_id=0):
+    """shard_map a single-axis pallas kernel over the tp mesh."""
+    out_shape = out_shape or jax.ShapeDtypeStruct(
+        (x.shape[0] // mesh.shape["tp"],) + x.shape[1:], x.dtype)
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("tp"),
+                       out_specs=P("tp"), check_vma=False)
+    def run(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=out_shape,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=list(scratch_shapes),
+            compiler_params=comm_params(collective_id),
+            interpret=resolve_interpret(None),
+        )(x)
+
+    return run(x)
+
+
+def test_rank_num_ranks(mesh8):
+    def kernel(x_ref, o_ref):
+        r = dl.rank("tp")
+        n = dl.num_ranks("tp")
+        o_ref[:] = jnp.full_like(o_ref, r * 100 + n)
+
+    x = jnp.zeros((8 * 8, 128), jnp.int32)
+    y = _run_1d(mesh8, kernel, x)
+    got = np.asarray(y).reshape(8, 8, 128)
+    for r in range(8):
+        assert (got[r] == r * 100 + 8).all()
+
+
+def test_put_ring(mesh8):
+    """Each rank puts its block to its right neighbor — the minimal one-sided
+    put+signal (reference test_nvshmem_api.py putmem_signal cases)."""
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        me = dl.rank("tp")
+        dst = jax.lax.rem(me + 1, dl.num_ranks("tp"))
+        copy = shmem.putmem_nbi_block(o_ref, x_ref, dst, send_sem, recv_sem)
+        copy.wait()
+
+    x = (jnp.arange(8)[:, None, None] *
+         jnp.ones((8, 8, 128))).astype(jnp.float32).reshape(64, 128)
+    y = _run_1d(mesh8, kernel, x, scratch_shapes=[
+        pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())])
+    got = np.asarray(y).reshape(8, 8, 128)
+    for r in range(8):
+        assert (got[r] == (r - 1) % 8).all(), r
+
+
+def test_notify_wait_ring(mesh8):
+    """Signal right neighbor's semaphore, wait for left's — reference
+    test_distributed_wait.py / test_wait_and_notify.py shape."""
+    def kernel(x_ref, o_ref, sem):
+        me = dl.rank("tp")
+        n = dl.num_ranks("tp")
+        dst = jax.lax.rem(me + 1, n)
+        dl.notify(sem, peer=dst, inc=3)
+        dl.wait(sem, 3)
+        o_ref[:] = x_ref[:] + 1.0
+
+    x = jnp.zeros((64, 128), jnp.float32)
+    y = _run_1d(mesh8, kernel, x,
+                scratch_shapes=[pltpu.SemaphoreType.REGULAR])
+    assert (np.asarray(y) == 1.0).all()
+
+
+def test_barrier_all(mesh8):
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        me = dl.rank("tp")
+        n = dl.num_ranks("tp")
+        dst = jax.lax.rem(me + 1, n)
+        copy = shmem.putmem_nbi_block(o_ref, x_ref, dst, send_sem, recv_sem)
+        copy.wait()
+        dl.barrier_all("tp")
+        # after the barrier every rank's put has landed
+        o_ref[:] = o_ref[:] * 2.0
+
+    x = (jnp.arange(8)[:, None, None] *
+         jnp.ones((8, 8, 128))).astype(jnp.float32).reshape(64, 128)
+    y = _run_1d(mesh8, kernel, x, scratch_shapes=[
+        pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())])
+    got = np.asarray(y).reshape(8, 8, 128)
+    for r in range(8):
+        assert (got[r] == 2 * ((r - 1) % 8)).all()
+
+
+def test_consume_token():
+    assert dl.consume_token(5, None) == 5
+
+
+def test_logical_device_id_2d(mesh4x2):
+    """On a (tp=4, ep=2) mesh, notify along ep must translate to global
+    logical ids (reference: team-relative→global PE translation)."""
+    def kernel(x_ref, o_ref, sem):
+        me = dl.rank("ep")
+        n = dl.num_ranks("ep")
+        dst = jax.lax.rem(me + 1, n)
+        # mesh_axes intentionally omitted: auto-detected from the enclosing
+        # mesh trace context
+        dl.notify(sem, peer=dst, axis="ep")
+        dl.wait(sem, 1)
+        o_ref[:] = x_ref[:] + 10.0
+
+    x = jnp.zeros((8 * 8, 128), jnp.float32)
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh4x2,
+                       in_specs=P(("tp", "ep")),
+                       out_specs=P(("tp", "ep")), check_vma=False)
+    def run(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.SemaphoreType.REGULAR],
+            compiler_params=comm_params(),
+            interpret=resolve_interpret(None),
+        )(x)
+
+    y = run(x)
+    assert (np.asarray(y) == 10.0).all()

@@ -1,0 +1,60 @@
+"""Runtime tests (reference analog: test/nvidia/test_utils.py — but runnable
+single-process, see conftest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import triton_dist_tpu as tdt
+from triton_dist_tpu.runtime import (
+    assert_allclose, local_shard, perf_func, symm_tensor)
+
+
+def test_initialize_default(devices):
+    ctx = tdt.initialize_distributed()
+    assert ctx.world_size == 8
+    assert ctx.axis_names == ("tp",)
+    assert ctx.axis_size("tp") == 8
+    tdt.finalize_distributed()
+    with pytest.raises(RuntimeError):
+        tdt.get_context()
+
+
+def test_initialize_2d(devices):
+    ctx = tdt.initialize_distributed({"dp": 2, "tp": 4})
+    assert ctx.axis_size("dp") == 2
+    assert ctx.axis_size("tp") == 4
+    assert tdt.get_mesh().shape["tp"] == 4
+    tdt.finalize_distributed()
+
+
+def test_initialize_bad_shape(devices):
+    with pytest.raises(ValueError):
+        tdt.initialize_distributed({"tp": 3})
+
+
+def test_symm_tensor(mesh8):
+    buf = symm_tensor((4, 128), jnp.float32, mesh8, axis="tp")
+    assert buf.shape == (8, 4, 128)
+    # one addressable shard of local shape per device
+    shards = buf.addressable_shards
+    assert len(shards) == 8
+    assert shards[0].data.shape == (1, 4, 128)
+    assert local_shard(buf, 3).shape == (4, 128)
+
+
+def test_perf_func():
+    f = jax.jit(lambda: jnp.ones((64, 64)) * 2)
+    out, ms = perf_func(lambda: f(), iters=3, warmup_iters=1)
+    assert ms > 0
+    assert float(out[0, 0]) == 2.0
+
+
+def test_assert_allclose():
+    a = np.ones((4, 4))
+    assert_allclose(a, a + 1e-4)
+    with pytest.raises(AssertionError):
+        assert_allclose(a, a + 1.0)
+    with pytest.raises(AssertionError):
+        assert_allclose(a, np.ones((2, 2)))

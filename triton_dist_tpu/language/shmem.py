@@ -1,0 +1,122 @@
+"""SHMEM-style façade over the Pallas primitives.
+
+Name-for-name analog of the reference's vendor-neutral ``libshmem_device``
+stub API (python/triton_dist/language/extra/libshmem_device.py:28-341) so
+kernels translated from SHMEM-style pseudocode read naturally. Everything
+here delegates to :mod:`triton_dist_tpu.language`.
+
+Semantic notes vs NVSHMEM:
+
+- ``putmem_signal*`` collapses into one op: a TPU remote DMA signals the
+  receiver's ``recv_sem`` on delivery.
+- ``fence``/``quiet`` (ordering/completion of outstanding puts) map to
+  waiting on the relevant send semaphores — puts are tracked per-descriptor,
+  so completion is explicit rather than global.
+- Teams are mesh axes; ``team_my_pe``/``team_n_pes`` take an axis name.
+"""
+
+from __future__ import annotations
+
+import triton_dist_tpu.language as dl
+
+# Comparison constants (libshmem_device.py CMP_* — only EQ/GE are used by the
+# reference kernels; TPU semaphore_wait is >= with decrement).
+CMP_EQ = 0
+CMP_NE = 1
+CMP_GT = 2
+CMP_LE = 3
+CMP_LT = 4
+CMP_GE = 5
+
+SIGNAL_SET = 9
+SIGNAL_ADD = 10
+
+
+def my_pe(axis: str = "tp"):
+    return dl.rank(axis)
+
+
+def n_pes(axis: str = "tp"):
+    return dl.num_ranks(axis)
+
+
+def team_my_pe(axis: str):
+    return dl.rank(axis)
+
+
+def team_n_pes(axis: str):
+    return dl.num_ranks(axis)
+
+
+def putmem_nbi_block(dst_ref, src_ref, peer, send_sem, recv_sem,
+                     axis: str | None = None, mesh_axes=None):
+    """Non-blocking put; returns the descriptor (call ``.wait()`` for
+    completion). Reference: libshmem_device.putmem_nbi_block."""
+    copy = dl.remote_copy(src_ref, dst_ref, peer, send_sem, recv_sem,
+                          axis=axis, mesh_axes=mesh_axes)
+    copy.start()
+    return copy
+
+
+def putmem_block(dst_ref, src_ref, peer, send_sem, recv_sem,
+                 axis: str | None = None, mesh_axes=None):
+    """Blocking put (reference libshmem_device.putmem_block)."""
+    copy = putmem_nbi_block(dst_ref, src_ref, peer, send_sem, recv_sem,
+                            axis=axis, mesh_axes=mesh_axes)
+    copy.wait_send()
+    return copy
+
+
+def putmem_signal_nbi_block(dst_ref, src_ref, peer, send_sem, recv_sem,
+                            axis: str | None = None, mesh_axes=None):
+    """Put + signal-on-delivery. On TPU the recv semaphore *is* the signal,
+    so this is identical to ``putmem_nbi_block``
+    (reference libshmem_device.putmem_signal_nbi_block)."""
+    return putmem_nbi_block(dst_ref, src_ref, peer, send_sem, recv_sem,
+                            axis=axis, mesh_axes=mesh_axes)
+
+
+def signal_op(sem, peer, inc: int = 1, axis: str | None = None,
+              mesh_axes=None):
+    """Remote signal (reference libshmem_device.signal_op with SIGNAL_ADD)."""
+    dl.notify(sem, peer=peer, inc=inc, axis=axis, mesh_axes=mesh_axes)
+
+
+def signal_wait_until(sem, cmp: int, value):
+    """Wait until the local signal reaches ``value``
+    (reference libshmem_device.signal_wait_until).
+
+    TPU semaphores implement *wait-for-at-least-value-then-decrement*;
+    CMP_GE maps exactly. CMP_EQ is accepted because the reference kernels
+    use it on monotonic flags where EQ and GE coincide (e.g.
+    low_latency_all_to_all.py signal_wait_until(EQ, call_count)) — true
+    exact-equality gating on an over-signaled semaphore is NOT expressible.
+    """
+    assert cmp in (CMP_EQ, CMP_GE), "TPU semaphores support GE-style waits"
+    dl.wait(sem, value)
+
+
+def fence(*copies):
+    """Order prior puts before subsequent ones (reference
+    libshmem_device.fence): wait for the given descriptors' local sends to
+    complete. ICI delivers a single put's data in order, so send-completion
+    is sufficient for producer-side ordering."""
+    for c in copies:
+        c.wait_send()
+
+
+def quiet(*copies):
+    """Complete the *send side* of all given puts (reference
+    libshmem_device.quiet).
+
+    Note: a put's delivery is observed by the RECEIVER via its recv
+    semaphore (which the transport signals); the sender cannot wait on it.
+    Receivers must ``signal_wait_until``/``dl.wait`` their recv semaphore
+    before reading — same contract as NVSHMEM putmem_signal + wait.
+    """
+    for c in copies:
+        c.wait_send()
+
+
+def barrier_all(axis: str = "tp", mesh_axes=None):
+    dl.barrier_all(axis, mesh_axes)

@@ -1,0 +1,14 @@
+"""Overlapping kernel library (reference L5: python/triton_dist/kernels/).
+
+Every op follows the reference's API shape: a ``create_*_context`` builder
+that allocates persistent workspaces/configs, plus a functional entry point
+(e.g. ``ag_gemm``, ``gemm_rs``, ``all_reduce``, ``fast_all_to_all``).
+
+Each op has (at least) two implementations:
+
+- ``impl="xla"``  — shard_map + ``jax.lax`` collectives. Always correct;
+  XLA's async collective scheduler provides coarse overlap. This is also
+  the golden baseline, like the reference's torch/NCCL goldens.
+- ``impl="pallas"`` — fused Pallas kernel with explicit remote DMA /
+  semaphore overlap (compiled on TPU, interpreted on CPU meshes).
+"""

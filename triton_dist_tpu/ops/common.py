@@ -1,0 +1,72 @@
+"""Shared helpers for the kernel library (reference
+python/triton_dist/kernels/nvidia/common_ops.py — barriers, signal ops —
+plus the per-op boilerplate every kernel repeats)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.runtime.platform import default_interpret
+
+
+def resolve_interpret(interpret: bool | None):
+    """Auto-select interpret mode: compiled on TPU, interpreted elsewhere.
+
+    Interpreted kernels simulate remote DMA + semaphores on a multi-device
+    CPU mesh — the framework's single-process distributed test mode.
+    """
+    if interpret is None:
+        interpret = default_interpret()
+    if interpret:
+        return pltpu.InterpretParams()
+    return False
+
+
+def comm_params(collective_id: int = 0,
+                vmem_limit_bytes: int | None = None) -> pltpu.CompilerParams:
+    """CompilerParams for kernels that communicate: side effects must be kept
+    (DMA-only kernels would be DCE'd) and a collective_id is required for the
+    global barrier semaphore."""
+    kwargs = dict(has_side_effects=True, collective_id=collective_id)
+    if vmem_limit_bytes is not None:
+        kwargs["vmem_limit_bytes"] = vmem_limit_bytes
+    return pltpu.CompilerParams(**kwargs)
+
+
+def vmem_spec(block_shape=None, index_map=None):
+    return pl.BlockSpec(block_shape, index_map, memory_space=pltpu.VMEM)
+
+
+def any_spec():
+    return pl.BlockSpec(memory_space=pltpu.ANY)
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(a: int, b: int) -> int:
+    return cdiv(a, b) * b
+
+
+@functools.cache
+def min_tile(dtype) -> tuple[int, int]:
+    """Minimum TPU tile (sublane, lane) for ``dtype`` — layout constraint for
+    block shapes (pallas_guide: Tiling Constraints)."""
+    import jax.numpy as jnp
+    dtype = jnp.dtype(dtype)
+    sublane = {4: 8, 2: 16, 1: 32}[dtype.itemsize]
+    return (sublane, 128)
+
+
+def shard_map_1d(fn, mesh, axis: str = "tp"):
+    """Wrap ``fn`` in a shard_map over a single mesh axis with everything
+    sharded on its leading dim. Convenience for op entry points."""
+    from jax.sharding import PartitionSpec as P
+    spec = P(axis)
+    return jax.shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec,
+                         check_vma=False)

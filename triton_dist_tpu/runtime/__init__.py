@@ -1,0 +1,25 @@
+"""Host distributed runtime (reference L4: python/triton_dist/utils.py)."""
+
+from triton_dist_tpu.runtime.dist import (  # noqa: F401
+    DistContext,
+    initialize_distributed,
+    finalize_distributed,
+    get_context,
+    get_mesh,
+)
+from triton_dist_tpu.runtime.platform import (  # noqa: F401
+    is_tpu,
+    is_cpu,
+    default_interpret,
+)
+from triton_dist_tpu.runtime.symm_mem import (  # noqa: F401
+    symm_tensor,
+    symm_like,
+    local_shard,
+)
+from triton_dist_tpu.runtime.utils import (  # noqa: F401
+    perf_func,
+    dist_print,
+    assert_allclose,
+    init_seed,
+)

@@ -1,0 +1,144 @@
+"""Distributed initialization and the global mesh context.
+
+TPU-native analog of the reference's process-group bootstrap
+(python/triton_dist/utils.py:182 ``initialize_distributed``: torchrun env →
+``init_process_group`` → NVSHMEM UID broadcast). On TPU the runtime is
+simpler: ``jax.distributed.initialize`` (multi-host only) plus a
+``jax.sharding.Mesh`` over the devices. ICI connectivity replaces NVLink;
+the mesh axes replace NVSHMEM teams (SURVEY.md §5 "Distributed communication
+backend").
+
+Axis-name conventions used across the framework:
+
+- ``"tp"``  tensor parallel (the reference's default TP group = all ranks,
+  utils.py:197)
+- ``"ep"``  expert parallel
+- ``"sp"``  sequence parallel
+- ``"pp"``  pipeline parallel
+- ``"dp"``  data parallel / replicated inference
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from collections.abc import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_CONTEXT: "DistContext | None" = None
+
+DEFAULT_TP_AXIS = "tp"
+
+
+@dataclasses.dataclass(frozen=True)
+class DistContext:
+    """Global distributed context: the device mesh plus bookkeeping.
+
+    Plays the role of the reference's ``TP_GROUP`` process group returned by
+    ``initialize_distributed`` (utils.py:182-205).
+    """
+
+    mesh: Mesh
+    seed: int = 42
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.size
+
+    @property
+    def num_processes(self) -> int:
+        return jax.process_count()
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    def axis_size(self, axis: str) -> int:
+        return self.mesh.shape[axis]
+
+
+def _maybe_multihost_init() -> None:
+    """Call ``jax.distributed.initialize`` iff a coordinator is configured.
+
+    Mirrors the reference reading RANK/WORLD_SIZE from torchrun env
+    (utils.py:183-186); JAX's equivalent env is set by the TPU pod launcher
+    or explicitly via JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID.
+    """
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") and os.environ.get("JAX_NUM_PROCESSES"):
+        try:
+            jax.distributed.initialize()
+        except RuntimeError:
+            # Already initialized (idempotent re-entry, like the reference's
+            # barrier-guarded re-init).
+            pass
+
+
+def initialize_distributed(
+    mesh_shape: dict[str, int] | Sequence[int] | None = None,
+    axis_names: Sequence[str] | None = None,
+    seed: int = 42,
+    devices: Sequence[jax.Device] | None = None,
+) -> DistContext:
+    """Create (and globally register) the device mesh context.
+
+    Args:
+      mesh_shape: either a dict ``{"tp": 8}`` / ``{"dp": 2, "tp": 4}`` or a
+        plain shape tuple matched with ``axis_names``. Default: 1-D mesh of
+        all devices on axis ``"tp"`` — the reference's default TP group of
+        all ranks (utils.py:197).
+      axis_names: names for a tuple ``mesh_shape``.
+      seed: base RNG seed (reference ``init_seed`` utils.py:77).
+      devices: explicit device list (tests may pass a subset).
+
+    Returns:
+      The registered ``DistContext``.
+    """
+    global _CONTEXT
+    _maybe_multihost_init()
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+
+    if mesh_shape is None:
+        mesh_shape = {DEFAULT_TP_AXIS: devices.size}
+    if isinstance(mesh_shape, dict):
+        names = tuple(mesh_shape.keys())
+        shape = tuple(mesh_shape.values())
+    else:
+        shape = tuple(mesh_shape)
+        if axis_names is None:
+            raise ValueError("axis_names required when mesh_shape is a tuple")
+        names = tuple(axis_names)
+    if int(np.prod(shape)) != devices.size:
+        raise ValueError(
+            f"mesh shape {shape} does not cover {devices.size} devices")
+
+    mesh = Mesh(devices.reshape(shape), names)
+    _CONTEXT = DistContext(mesh=mesh, seed=seed)
+    return _CONTEXT
+
+
+def get_context() -> DistContext:
+    if _CONTEXT is None:
+        raise RuntimeError(
+            "initialize_distributed() has not been called")
+    return _CONTEXT
+
+
+def get_mesh() -> Mesh:
+    return get_context().mesh
+
+
+def finalize_distributed() -> None:
+    """Drop the global context (reference ``finalize_distributed``
+    utils.py:145)."""
+    global _CONTEXT
+    _CONTEXT = None

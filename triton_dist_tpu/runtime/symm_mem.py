@@ -1,0 +1,58 @@
+"""Symmetric memory over a device mesh.
+
+TPU-native analog of NVSHMEM symmetric-heap tensors
+(reference ``nvshmem_create_tensor(s)`` python/triton_dist/utils.py:114-136).
+
+On TPU, a "symmetric tensor" is a globally-shaped array sharded along a mesh
+axis so that *every device holds an identically-shaped local shard at the
+same logical offset*. Inside ``jax.shard_map``, each device sees its local
+shard; Pallas kernels address a *peer's* shard with
+``pltpu.make_async_remote_copy(..., device_id=peer)`` — the analog of
+``nvshmem_ptr`` / ``symm_at`` (DistributedOps.td:120-150).
+
+There is no separate allocator: XLA owns HBM. Persistent workspaces are
+ordinary sharded arrays threaded through jitted functions (donated when
+mutated in place).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def symm_tensor(
+    local_shape: Sequence[int],
+    dtype,
+    mesh: Mesh,
+    axis: str = "tp",
+    fill: float | int = 0,
+) -> jax.Array:
+    """Allocate a symmetric tensor: one ``local_shape`` buffer per device.
+
+    Returns a global array of shape ``(axis_size, *local_shape)`` sharded on
+    its leading dimension over ``axis``. Device ``i``'s shard is the slice
+    ``[i]`` — its symmetric buffer. Analog of ``nvshmem_create_tensor``
+    (utils.py:114).
+    """
+    world = mesh.shape[axis]
+    spec = P(axis, *([None] * len(local_shape)))
+    sharding = NamedSharding(mesh, spec)
+    # Allocate shard-by-shard on each device (jnp.full + device_put would
+    # first materialize the full world-sized array on one device).
+    return jnp.full((world, *local_shape), fill, dtype=dtype,
+                    device=sharding)
+
+
+def symm_like(x: jax.Array, mesh: Mesh, axis: str = "tp") -> jax.Array:
+    """Symmetric tensor with per-device buffers shaped like ``x``."""
+    return symm_tensor(x.shape, x.dtype, mesh, axis)
+
+
+def local_shard(x: jax.Array, index: int = 0) -> jax.Array:
+    """Host-side view of one device's shard (debug/test helper, analog of
+    peeking a single rank's symmetric buffer)."""
+    return jax.device_get(x)[index]

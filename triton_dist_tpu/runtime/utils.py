@@ -1,0 +1,126 @@
+"""Bench / verify / logging helpers.
+
+TPU-native analogs of the reference's host utilities
+(python/triton_dist/utils.py): ``perf_func`` (:274), ``dist_print`` (:289),
+``assert_allclose`` (:870), ``init_seed`` (:77).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from collections.abc import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_seed(seed: int = 42) -> jax.Array:
+    """Deterministic seeding (reference utils.py:77-96). Returns a JAX PRNG
+    key; numpy is seeded for host-side golden generation."""
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def _block(tree) -> None:
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            leaf.block_until_ready()
+
+
+def perf_func(
+    func: Callable,
+    iters: int = 50,
+    warmup_iters: int = 10,
+    return_output: bool = True,
+):
+    """Time a JAX function with proper device synchronization.
+
+    Analog of reference ``perf_func`` (utils.py:274-288, CUDA-event based).
+    Returns ``(output, avg_ms)``.
+    """
+    out = None
+    for _ in range(max(warmup_iters, 1)):
+        out = func()
+    _block(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = func()
+    _block(out)
+    avg_ms = (time.perf_counter() - t0) / iters * 1e3
+    if return_output:
+        return out, avg_ms
+    return None, avg_ms
+
+
+def dist_print(*args, prefix: bool = True, need_sync: bool = False,
+               allowed_ranks="all", **kwargs) -> None:
+    """Per-process-prefixed printing (reference ``dist_print`` utils.py:289).
+
+    ``allowed_ranks`` filters by ``jax.process_index()`` (host granularity —
+    per-device printing from inside jitted code uses ``jax.debug.print``).
+    ``need_sync`` serializes output across processes: each rank prints in
+    turn with a global barrier between turns (reference behavior).
+    """
+    rank = jax.process_index()
+    world = jax.process_count()
+    if allowed_ranks == "all":
+        allowed = range(world)
+    else:
+        allowed = allowed_ranks
+
+    def _emit():
+        if rank in allowed:
+            if prefix:
+                print(f"[rank {rank}/{world}]", *args, **kwargs)
+            else:
+                print(*args, **kwargs)
+            sys.stdout.flush()
+
+    if need_sync and world > 1:
+        from jax.experimental import multihost_utils
+        for r in range(world):
+            if rank == r:
+                _emit()
+            multihost_utils.sync_global_devices(f"dist_print_{r}")
+    else:
+        _emit()
+
+
+def assert_allclose(x, y, rtol: float = 1e-2, atol: float = 1e-2,
+                    verbose: bool = True) -> None:
+    """Structured allclose with mismatch diagnostics (reference
+    ``assert_allclose`` utils.py:870-886)."""
+    x = np.asarray(jax.device_get(x), dtype=np.float64)
+    y = np.asarray(jax.device_get(y), dtype=np.float64)
+    if x.shape != y.shape:
+        raise AssertionError(f"shape mismatch: {x.shape} vs {y.shape}")
+    close = np.isclose(x, y, rtol=rtol, atol=atol)
+    if not close.all():
+        bad = np.argwhere(~close)
+        n = bad.shape[0]
+        msg = [f"allclose failed: {n}/{x.size} mismatched "
+               f"(rtol={rtol}, atol={atol})"]
+        if verbose:
+            for idx in bad[:10]:
+                i = tuple(idx)
+                msg.append(f"  at {i}: {x[i]!r} vs {y[i]!r}")
+            abs_err = np.abs(x - y)
+            msg.append(f"  max abs err {abs_err.max():.3e}, "
+                       f"mean abs err {abs_err.mean():.3e}")
+        raise AssertionError("\n".join(msg))
+
+
+def bitwise_equal(x, y) -> bool:
+    """Bitwise comparison used to gate deterministic collectives
+    (SURVEY.md §7 stage-2 gate)."""
+    x = np.asarray(jax.device_get(x))
+    y = np.asarray(jax.device_get(y))
+    return x.shape == y.shape and bool(
+        np.array_equal(x.view(np.uint8), y.view(np.uint8)))
+
+
+def rand(key, shape, dtype=jnp.float32, scale: float = 1.0) -> jax.Array:
+    """Test-data helper: normal data cast to ``dtype``."""
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
