@@ -9,6 +9,10 @@ machine.
 
 import os
 
+# NOTE: on 1-core hosts the run is re-exec'd with the CPU-affinity shim by
+# triton_dist_tpu.testing.shim_plugin (loaded via addopts) before capture
+# starts — see runtime/cpu_shim.py for why.
+
 # Must be set before the CPU backend is initialized.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

@@ -1,0 +1,40 @@
+/* LD_PRELOAD shim: report N schedulable CPUs (default 16) regardless of the
+ * container's cpuset. XLA's CPU PJRT client sizes its thread pools from
+ * sched_getaffinity; on 1-core CI boxes a pool of one thread deadlocks
+ * Pallas TPU interpret mode, whose kernels issue blocking host callbacks
+ * (semaphore waits) that occupy pool threads while other devices' compute
+ * feeds their callbacks. Oversizing the pools costs only timesharing. */
+#define _GNU_SOURCE
+#include <sched.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+static int shim_ncpus(void) {
+    const char *s = getenv("TDT_FAKE_NCPUS");
+    int n = s ? atoi(s) : 16;
+    return n > 0 && n <= CPU_SETSIZE ? n : 16;
+}
+
+int sched_getaffinity(pid_t pid, size_t cpusetsize, cpu_set_t *mask) {
+    (void)pid;
+    int n = shim_ncpus();
+    CPU_ZERO_S(cpusetsize, mask);
+    for (int i = 0; i < n; i++)
+        CPU_SET_S(i, cpusetsize, mask);
+    return 0;
+}
+
+long sysconf(int name);  /* glibc prototype */
+
+/* std::thread::hardware_concurrency and some TSL paths use sysconf. */
+static long (*real_sysconf)(int) = 0;
+long sysconf(int name) {
+    if (name == _SC_NPROCESSORS_ONLN || name == _SC_NPROCESSORS_CONF)
+        return shim_ncpus();
+    if (!real_sysconf) {
+        extern long __sysconf(int);
+        real_sysconf = __sysconf;
+    }
+    return real_sysconf(name);
+}

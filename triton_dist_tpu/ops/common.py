@@ -22,6 +22,9 @@ def resolve_interpret(interpret: bool | None):
     if interpret is None:
         interpret = default_interpret()
     if interpret:
+        from triton_dist_tpu.runtime.interpret_compat import (
+            patch_interpreter_spin)
+        patch_interpreter_spin()
         return pltpu.InterpretParams()
     return False
 

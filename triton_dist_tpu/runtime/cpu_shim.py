@@ -1,0 +1,54 @@
+"""Build/locate the CPU-count shim used for multi-device CPU testing.
+
+XLA's CPU PJRT client sizes its thread pools from ``sched_getaffinity``. On
+1-core hosts the compute pool has a single thread; Pallas TPU interpret mode
+issues blocking host callbacks (semaphore waits) that occupy pool threads
+while *other* simulated devices' compute feeds their callbacks — a hard
+deadlock. ``libcpushim.so`` (csrc/cpushim/cpushim.c) LD_PRELOADs a fake
+16-CPU affinity so the pools are sized for the 8-device simulation; the
+threads simply timeshare the physical core.
+
+LD_PRELOAD must be set before process start — ``maybe_reexec_with_shim()``
+re-execs the current process once if needed (used by tests/conftest.py and
+__graft_entry__.dryrun_multichip).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "csrc", "cpushim",
+                    "cpushim.c")
+_SO = os.path.join(os.path.dirname(_SRC), "libcpushim.so")
+
+
+def ensure_cpu_shim() -> str | None:
+    """Compile the shim if needed; return its path (None if no compiler)."""
+    src = os.path.abspath(_SRC)
+    so = os.path.abspath(_SO)
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
+    try:
+        subprocess.run(["gcc", "-shared", "-fPIC", "-O2", "-o", so, src],
+                       check=True, capture_output=True)
+        return so
+    except (OSError, subprocess.CalledProcessError):
+        return None
+
+
+def maybe_reexec_with_shim() -> None:
+    """Re-exec the current process with LD_PRELOAD=libcpushim.so (no-op when
+    already loaded, on multi-core hosts, or if the shim can't be built)."""
+    if os.cpu_count() and os.cpu_count() >= 8:
+        return
+    so = ensure_cpu_shim()
+    if so is None or so in os.environ.get("LD_PRELOAD", ""):
+        return
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = ":".join(
+        p for p in (env.get("LD_PRELOAD"), so) if p)
+    with open("/proc/self/cmdline", "rb") as f:
+        args = [a.decode() for a in f.read().split(b"\0") if a]
+    os.execve(sys.executable, [sys.executable] + args[1:], env)

@@ -1,0 +1,1 @@
+"""Test-support utilities (single-process multi-device simulation)."""

@@ -1,0 +1,11 @@
+"""pytest plugin (loaded via addopts `-p`) that re-execs the test process
+with the CPU-affinity shim preloaded BEFORE pytest's output capture starts.
+
+Must be a plugin, not conftest logic: initial conftests are imported inside
+pytest's global capture, so an exec there inherits redirected fds and the
+run's output vanishes. `-p` plugins import during config setup, earlier.
+"""
+
+from triton_dist_tpu.runtime.cpu_shim import maybe_reexec_with_shim
+
+maybe_reexec_with_shim()
