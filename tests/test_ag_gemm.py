@@ -1,0 +1,91 @@
+"""Fused AG-GEMM / GEMM-RS / GEMM-AR tests vs XLA goldens (reference
+analogs: test_ag_gemm.py:72-197, test_gemm_rs.py, test_gemm_ar.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.ops.allgather_gemm import ag_gemm, create_ag_gemm_context
+from triton_dist_tpu.ops.gemm_reduce_scatter import (
+    create_gemm_rs_context, gemm_ar, gemm_rs)
+from triton_dist_tpu.runtime.utils import assert_allclose
+
+WORLD = 8
+M, K, N = 64, 32, 256   # per-device: (8, 32) x (32, 32)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_ag_gemm(mesh8, key, dtype):
+    ka, kb = jax.random.split(key)
+    a = (jax.random.normal(ka, (M, K)) / 4).astype(dtype)
+    b = (jax.random.normal(kb, (K, N)) / 4).astype(dtype)
+    ctx = create_ag_gemm_context(mesh8)
+    got = ag_gemm(a, b, ctx, impl="pallas")
+    ref = ag_gemm(a, b, ctx, impl="xla")
+    assert got.shape == (M, N)
+    tol = 1e-5 if dtype == jnp.float32 else 5e-2
+    assert_allclose(got, ref, rtol=tol, atol=tol)
+    # analytic golden
+    full = np.asarray(a, np.float32) @ np.asarray(b, np.float32)
+    assert_allclose(got, full, rtol=2e-2, atol=2e-1)
+
+
+def test_ag_gemm_return_gathered(mesh8, key):
+    ka, kb = jax.random.split(key)
+    a = (jax.random.normal(ka, (M, K)) / 4).astype(jnp.float32)
+    b = (jax.random.normal(kb, (K, N)) / 4).astype(jnp.float32)
+    ctx = create_ag_gemm_context(mesh8, return_gathered=True)
+    c, ag = ag_gemm(a, b, ctx, impl="pallas")
+    assert c.shape == (M, N)
+    ag = np.asarray(ag).reshape(WORLD, M, K)
+    for d in range(WORLD):
+        assert np.array_equal(ag[d], np.asarray(a)), f"device {d}"
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32])
+def test_gemm_rs(mesh8, key, dtype):
+    ka, kb = jax.random.split(key)
+    a = (jax.random.normal(ka, (M, K)) / 4).astype(dtype)   # col-sharded
+    b = (jax.random.normal(kb, (K, N)) / 4).astype(dtype)   # row-sharded
+    ctx = create_gemm_rs_context(mesh8)
+    got = gemm_rs(a, b, ctx, impl="pallas")
+    ref = gemm_rs(a, b, ctx, impl="xla")
+    assert got.shape == (M, N)
+    assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    full = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
+    assert_allclose(got, full, rtol=1e-3, atol=1e-3)
+
+
+def test_gemm_ar(mesh8, key):
+    ka, kb = jax.random.split(key)
+    a = (jax.random.normal(ka, (M, K)) / 4).astype(jnp.float32)
+    b = (jax.random.normal(kb, (K, N)) / 4).astype(jnp.float32)
+    ctx = create_gemm_rs_context(mesh8)
+    got = gemm_ar(a, b, ctx, impl="pallas")
+    assert got.shape == (M, N)
+    full = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
+    assert_allclose(got, full, rtol=1e-3, atol=1e-3)
+
+
+def test_ag_gemm_jit_grad_composes(mesh8, key):
+    """The fused op must compose under jit; the XLA impl must also be
+    differentiable (training use beyond the reference's inference-only
+    scope)."""
+    ka, kb = jax.random.split(key)
+    a = (jax.random.normal(ka, (M, K)) / 4).astype(jnp.float32)
+    b = (jax.random.normal(kb, (K, N)) / 4).astype(jnp.float32)
+    ctx = create_ag_gemm_context(mesh8)
+
+    @jax.jit
+    def f(a, b):
+        return ag_gemm(a, b, ctx, impl="pallas").sum()
+
+    @jax.jit
+    def g(a, b):
+        return ag_gemm(a, b, ctx, impl="xla").sum()
+
+    assert_allclose(f(a, b), g(a, b), rtol=1e-4, atol=1e-2)
+    da = jax.grad(lambda a, b: ag_gemm(a, b, ctx, impl="xla").sum(),
+                  argnums=0)(a, b)
+    assert da.shape == a.shape

@@ -1,0 +1,179 @@
+"""Fused AllGather-GEMM (tensor-parallel column-linear forward).
+
+TPU-native redesign of the reference's flagship overlapped op
+(python/triton_dist/kernels/nvidia/allgather_gemm.py: ``create_ag_gemm_context``
+:489, ``ag_gemm`` :534, consumer GEMM that per-M-tile ``dl.wait``s on
+per-rank ready flags :158-264, rank-rotated tile swizzle :221-229).
+
+Math: A is row-sharded over the axis ((M/w, K) per device), B is
+column-sharded ((K, N/w) per device). Every device computes
+``C_local = allgather(A) @ B_local`` — full M rows of its N-columns.
+
+The TPU design is a *collective matmul*: one Pallas kernel per device runs
+the ring all-gather of A chunks and, as each chunk lands (semaphore wait —
+the analog of the reference's per-rank ``dl.wait``), feeds it to the MXU.
+The remote DMA of chunk s+1 overlaps the dot of chunk s. Consumption starts
+with the device's own chunk, so compute order is naturally rank-rotated
+(reference swizzle allgather_gemm.py:221-229).
+
+``impl="xla"``: ``lax.all_gather`` + ``jnp.dot`` — the unfused golden
+(XLA's latency-hiding scheduler may still overlap at coarse grain; it is
+also the measuring stick for overlap efficiency, BASELINE.md north star).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+import triton_dist_tpu.language as dl
+from triton_dist_tpu.ops.common import comm_params, resolve_interpret
+
+
+@dataclasses.dataclass
+class AllGatherGEMMContext:
+    """Analog of ``AllGatherGEMMTensorParallelContext``
+    (allgather_gemm.py:417-456): owns tuning params; the symmetric
+    workspace/barrier allocation collapses into kernel buffers on TPU."""
+    mesh: Mesh
+    axis: str = "tp"
+    # Dot accumulation dtype on the MXU.
+    acc_dtype: jnp.dtype = jnp.float32
+    interpret: bool | None = None
+    # Return the gathered A alongside C (the reference reuses the AG
+    # workspace for attention, tp_attn.py).
+    return_gathered: bool = False
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_ag_gemm_context(mesh: Mesh | None = None, axis: str = "tp",
+                           acc_dtype=jnp.float32,
+                           interpret: bool | None = None,
+                           return_gathered: bool = False
+                           ) -> AllGatherGEMMContext:
+    if mesh is None:
+        from triton_dist_tpu.runtime.dist import get_mesh
+        mesh = get_mesh()
+    return AllGatherGEMMContext(mesh=mesh, axis=axis, acc_dtype=acc_dtype,
+                                interpret=interpret,
+                                return_gathered=return_gathered)
+
+
+def _ag_gemm_kernel(x_ref, w_ref, ag_ref, c_ref, send_sem, recv_sem, *,
+                    axis: str, world: int, rows: int, acc_dtype):
+    """Ring AG of A chunks fused with per-chunk GEMM.
+
+    Per step: start forwarding the freshest chunk (DMA on ICI), then run
+    the MXU on it (overlap), then wait for the next chunk's arrival — the
+    wait is the reference's ``dl.wait(ready_ptr + rank, ...)``
+    (allgather_gemm.py:236)."""
+    me = lax.axis_index(axis)
+    right = lax.rem(me + 1, world)
+
+    ag_ref[pl.ds(me * rows, rows), :] = x_ref[:]
+    if world > 1:
+        dl.barrier_all(axis)
+
+    def chunk_copy(idx):
+        return dl.remote_copy(
+            ag_ref.at[pl.ds(idx * rows, rows), :],
+            ag_ref.at[pl.ds(idx * rows, rows), :],
+            right, send_sem.at[idx], recv_sem.at[idx], axis=axis)
+
+    def gemm_chunk(idx):
+        c_ref[pl.ds(idx * rows, rows), :] = jnp.dot(
+            ag_ref[pl.ds(idx * rows, rows), :], w_ref[:],
+            preferred_element_type=acc_dtype).astype(c_ref.dtype)
+
+    if world == 1:
+        gemm_chunk(me)
+        return
+
+    def step(s, _):
+        cur = lax.rem(me - s + world, world)
+        nxt = lax.rem(me - s - 1 + world, world)
+
+        @pl.when(s < world - 1)
+        def _():
+            chunk_copy(cur).start()       # forward current chunk (ICI)
+        gemm_chunk(cur)                   # MXU on current chunk (overlap)
+
+        @pl.when(s < world - 1)
+        def _():
+            chunk_copy(nxt).wait_recv()   # next chunk must have landed
+        return _
+
+    lax.fori_loop(0, world, step, None)
+
+    def drain(s, _):
+        chunk_copy(lax.rem(me - s + world, world)).wait_send()
+        return _
+
+    lax.fori_loop(0, world - 1, drain, None)
+
+
+def ag_gemm(a: jax.Array, b: jax.Array,
+            ctx: AllGatherGEMMContext | None = None,
+            impl: str = "pallas"):
+    """C = allgather(a) @ b (functional entry, reference ``ag_gemm``
+    allgather_gemm.py:534).
+
+    Args:
+      a: (M, K) row-sharded over ``ctx.axis``.
+      b: (K, N) column-sharded over ``ctx.axis``.
+    Returns:
+      C: (M, N) column-sharded; with ``ctx.return_gathered`` also the
+      gathered A (stacked per device: (w*M, K) sharded).
+    """
+    ctx = ctx or create_ag_gemm_context()
+    mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2 and m % world == 0 and n % world == 0
+    rows = m // world
+    out_specs = (P(None, axis), P(axis)) if ctx.return_gathered \
+        else P(None, axis)
+
+    if impl == "xla":
+        def body(xs, ws):
+            ag = lax.all_gather(xs, axis, tiled=True)
+            c = jnp.dot(ag, ws, preferred_element_type=ctx.acc_dtype
+                        ).astype(xs.dtype)
+            return (c, ag) if ctx.return_gathered else c
+        f = jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P(None, axis)),
+                          out_specs=out_specs, check_vma=False)
+        return f(a, b)
+
+    interpret = resolve_interpret(ctx.interpret)
+    kernel = functools.partial(_ag_gemm_kernel, axis=axis, world=world,
+                               rows=rows, acc_dtype=ctx.acc_dtype)
+
+    def body(xs, ws):
+        ag, c = pl.pallas_call(
+            kernel,
+            out_shape=(jax.ShapeDtypeStruct((m, k), a.dtype),
+                       jax.ShapeDtypeStruct((m, n // world), a.dtype)),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                       pl.BlockSpec(memory_space=pltpu.VMEM)),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((world,)),
+                            pltpu.SemaphoreType.DMA((world,))],
+            compiler_params=comm_params(collective_id=4),
+            interpret=interpret,
+        )(xs, ws)
+        return (c, ag) if ctx.return_gathered else c
+
+    f = jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P(None, axis)),
+                      out_specs=out_specs, check_vma=False)
+    return f(a, b)

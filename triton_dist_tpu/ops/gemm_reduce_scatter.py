@@ -1,0 +1,221 @@
+"""Fused GEMM-ReduceScatter (tensor-parallel row-linear forward).
+
+TPU-native redesign of the reference's GEMM-RS
+(python/triton_dist/kernels/nvidia/gemm_reduce_scatter.py: producer GEMM
+notifies per-tile barriers :122-285, ``gemm_rs_op`` :508; ring reduce
+reduce_scatter.py:674-826) and of the fused GEMM-AllReduce
+(gemm_allreduce.py, H800 path).
+
+Math: A is column-sharded ((M, K/w) per device), B is row-sharded
+((K/w, N) per device). Each device's partial ``A_local @ B_local`` must be
+summed across devices; the result is row-scattered (GEMM-RS) or replicated
+(GEMM-AR).
+
+Fusion: one Pallas kernel computes the partial GEMM *chunk by chunk in ring
+order* — the M-chunk a device must forward first is computed first (the
+analog of the reference's rank-rotated producer tile swizzle,
+gemm_rs_threadblock_swizzle.py) — and each chunk's ring hop overlaps the
+next chunk's MXU work. GEMM-AR appends a ring all-gather of the reduced
+chunks (two-shot AllReduce epilogue, reference gemm_allreduce.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+import triton_dist_tpu.language as dl
+from triton_dist_tpu.ops.common import comm_params, resolve_interpret
+
+
+@dataclasses.dataclass
+class GEMMReduceScatterContext:
+    """Analog of the reference's ``create_gemm_rs_context``
+    (gemm_reduce_scatter.py): config only — symmetric staging buffers become
+    kernel scratch."""
+    mesh: Mesh
+    axis: str = "tp"
+    acc_dtype: jnp.dtype = jnp.float32
+    interpret: bool | None = None
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_gemm_rs_context(mesh: Mesh | None = None, axis: str = "tp",
+                           acc_dtype=jnp.float32,
+                           interpret: bool | None = None
+                           ) -> GEMMReduceScatterContext:
+    if mesh is None:
+        from triton_dist_tpu.runtime.dist import get_mesh
+        mesh = get_mesh()
+    return GEMMReduceScatterContext(mesh=mesh, axis=axis,
+                                    acc_dtype=acc_dtype, interpret=interpret)
+
+
+def _gemm_rs_kernel(x_ref, w_ref, o_ref, send_buf, recv_buf, send_sem,
+                    recv_sem, *, axis: str, world: int, rows: int,
+                    acc_dtype, all_gather_epilogue: bool,
+                    ag_sems=None):
+    """Producer GEMM in ring order fused with ring reduce-scatter.
+
+    Step s computes the partial for chunk (me-s-1) — exactly the chunk this
+    device must forward at step s — adds the travelling partial received at
+    step s-1, and sends. The send of step s overlaps the MXU work of step
+    s+1. Per-step buffers/semaphores (see ops/reduce_scatter.py for the
+    FIFO-reordering race this avoids)."""
+    me = lax.axis_index(axis)
+    right = lax.rem(me + 1, world)
+
+    def partial_chunk(idx):
+        return jnp.dot(
+            x_ref[pl.ds(idx * rows, rows), :], w_ref[:],
+            preferred_element_type=acc_dtype).astype(o_ref.dtype)
+
+    if world == 1:
+        o_ref[:] = partial_chunk(0)
+        return
+
+    dl.barrier_all(axis)
+
+    def rs_copy(s):
+        return dl.remote_copy(send_buf.at[s], recv_buf.at[s], right,
+                              send_sem.at[s], recv_sem.at[s], axis=axis)
+
+    def rs_step(s, _):
+        send_idx = lax.rem(me - s - 1 + world, world)
+        part = partial_chunk(send_idx)
+
+        @pl.when(s == 0)
+        def _():
+            send_buf[s] = part
+
+        @pl.when(s > 0)
+        def _():
+            rs_copy(jnp.maximum(s - 1, 0)).wait_recv()
+            send_buf[s] = part + recv_buf[jnp.maximum(s - 1, 0)]
+
+        rs_copy(s).start()
+        return _
+
+    lax.fori_loop(0, world - 1, rs_step, None)
+    rs_copy(world - 2).wait_recv()
+    reduced = recv_buf[world - 2] + partial_chunk(me)
+
+    if not all_gather_epilogue:
+        o_ref[:] = reduced
+    else:
+        o_ref[pl.ds(me * rows, rows), :] = reduced
+        ag_send_sem, ag_recv_sem = ag_sems
+
+        def ag_copy(idx):
+            return dl.remote_copy(
+                o_ref.at[pl.ds(idx * rows, rows), :],
+                o_ref.at[pl.ds(idx * rows, rows), :],
+                right, ag_send_sem.at[idx], ag_recv_sem.at[idx], axis=axis)
+
+        def ag_step(s, _):
+            ag_copy(lax.rem(me - s + world, world)).start()
+            ag_copy(lax.rem(me - s - 1 + world, world)).wait_recv()
+            return _
+
+        lax.fori_loop(0, world - 1, ag_step, None)
+
+        def ag_drain(s, _):
+            ag_copy(lax.rem(me - s + world, world)).wait_send()
+            return _
+
+        lax.fori_loop(0, world - 1, ag_drain, None)
+
+    def drain(s, _):
+        rs_copy(s).wait_send()
+        return _
+
+    lax.fori_loop(0, world - 1, drain, None)
+
+
+def _entry(a, b, ctx, impl, all_gather_epilogue):
+    mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    m, k_loc = a.shape
+    _, n = b.shape
+    assert m % world == 0
+    rows = m // world
+    out_rows = m if all_gather_epilogue else rows
+    out_spec = P() if all_gather_epilogue else P(axis)
+
+    if impl == "xla":
+        def body(xs, ws):
+            part = jnp.dot(xs, ws, preferred_element_type=ctx.acc_dtype
+                           ).astype(xs.dtype)
+            if all_gather_epilogue:
+                return lax.psum(part, axis)
+            return lax.psum_scatter(part, axis, scatter_dimension=0,
+                                    tiled=True)
+        f = jax.shard_map(body, mesh=mesh, in_specs=(P(None, axis), P(axis)),
+                          out_specs=out_spec, check_vma=False)
+        return f(a, b)
+
+    interpret = resolve_interpret(ctx.interpret)
+    scratch = [pltpu.VMEM((world - 1, rows, n), a.dtype),
+               pltpu.VMEM((world - 1, rows, n), a.dtype),
+               pltpu.SemaphoreType.DMA((world - 1,)),
+               pltpu.SemaphoreType.DMA((world - 1,))]
+    if all_gather_epilogue:
+        scratch += [pltpu.SemaphoreType.DMA((world,)),
+                    pltpu.SemaphoreType.DMA((world,))]
+
+        def kernel(x_ref, w_ref, o_ref, sb, rb, ss, rs, ags, agr):
+            _gemm_rs_kernel(x_ref, w_ref, o_ref, sb, rb, ss, rs,
+                            axis=axis, world=world, rows=rows,
+                            acc_dtype=ctx.acc_dtype,
+                            all_gather_epilogue=True, ag_sems=(ags, agr))
+    else:
+        kernel = functools.partial(
+            _gemm_rs_kernel, axis=axis, world=world, rows=rows,
+            acc_dtype=ctx.acc_dtype, all_gather_epilogue=False)
+
+    def body(xs, ws):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((out_rows, n), a.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=scratch,
+            compiler_params=comm_params(collective_id=5),
+            interpret=interpret,
+        )(xs, ws)
+
+    f = jax.shard_map(body, mesh=mesh, in_specs=(P(None, axis), P(axis)),
+                      out_specs=out_spec, check_vma=False)
+    return f(a, b)
+
+
+def gemm_rs(a: jax.Array, b: jax.Array,
+            ctx: GEMMReduceScatterContext | None = None,
+            impl: str = "pallas") -> jax.Array:
+    """reduce_scatter(a @ b) over the axis (reference ``gemm_rs_op``
+    gemm_reduce_scatter.py:508).
+
+    a: (M, K) column-sharded; b: (K, N) row-sharded. Returns (M, N)
+    row-sharded (device i holds rows [i*M/w, (i+1)*M/w))."""
+    ctx = ctx or create_gemm_rs_context()
+    return _entry(a, b, ctx, impl, all_gather_epilogue=False)
+
+
+def gemm_ar(a: jax.Array, b: jax.Array,
+            ctx: GEMMReduceScatterContext | None = None,
+            impl: str = "pallas") -> jax.Array:
+    """allreduce(a @ b): GEMM fused with two-shot AllReduce — the
+    small-batch decode path (reference gemm_allreduce.py, e2e_dense.md
+    GEMM-AR rows). Returns (M, N) replicated."""
+    ctx = ctx or create_gemm_rs_context()
+    return _entry(a, b, ctx, impl, all_gather_epilogue=True)
