@@ -1,0 +1,48 @@
+"""TP_MLP layer vs single-device golden (reference test/nvidia/test_tp_mlp.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.layers import TPMLP
+
+H, I, M = 64, 128, 16
+
+
+def golden(params, x):
+    wg = np.asarray(params["w_gate"], np.float32)
+    wu = np.asarray(params["w_up"], np.float32)
+    wd = np.asarray(params["w_down"], np.float32)
+    xf = np.asarray(x, np.float32)
+    gate = xf @ wg
+    act = (gate / (1 + np.exp(-gate))) * (xf @ wu)
+    return act.astype(np.float32) @ wd
+
+
+@pytest.fixture()
+def mlp(mesh8):
+    return TPMLP(H, I, mesh=mesh8, dtype=jnp.float32)
+
+
+@pytest.fixture()
+def setup(mlp, key):
+    params = mlp.init(key)
+    x = jax.random.normal(jax.random.PRNGKey(7), (M, H), jnp.float32)
+    return params, x, golden(params, x)
+
+
+@pytest.mark.parametrize("mode", ["xla", "ag_rs", "xla_ar", "gemm_ar"])
+def test_tp_mlp_modes(mlp, setup, mode):
+    params, x, ref = setup
+    out = mlp(params, x, mode=mode)
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_modes_agree(mlp, setup):
+    params, x, _ = setup
+    a = mlp(params, x, mode="xla")
+    b = mlp(params, x, mode="ag_rs")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-5)

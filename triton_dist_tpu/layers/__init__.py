@@ -1,0 +1,29 @@
+"""Model layers (reference L6: python/triton_dist/layers/nvidia/).
+
+Each layer is a thin module object owning config + op contexts, with pure
+functional forwards over pytree params — the idiomatic JAX shape of the
+reference's ``TP_MLP`` (tp_mlp.py:52) / ``TP_Attn`` (tp_attn.py:79)
+torch modules.
+
+Forward-mode names map to the reference's per-layer ``set_fwd`` modes
+(models/dense.py:216):
+
+- ``"xla"``      ≙ ``torch`` (NCCL): shard_map + lax collectives golden.
+- ``"ag_rs"``    ≙ ``triton_dist``: fused AG-GEMM + GEMM-RS, activations
+                  row(M)-sharded between layers.
+- ``"gemm_ar"``  ≙ ``triton_dist_gemm_ar``: replicated activations, fused
+                  GEMM-AllReduce output projection (small-batch decode).
+- ``"xla_ar"``   ≙ ``torch`` golden for the replicated layout.
+"""
+
+from triton_dist_tpu.layers.common import (  # noqa: F401
+    rms_norm,
+    precompute_rope_cache,
+    apply_rope,
+    col_parallel_matmul,
+    shard_param,
+)
+from triton_dist_tpu.layers.tp_mlp import TPMLP  # noqa: F401
+from triton_dist_tpu.layers.tp_attn import TPAttn  # noqa: F401
+
+FWD_MODES = ("xla", "ag_rs", "gemm_ar", "xla_ar")

@@ -1,0 +1,82 @@
+"""Shared layer math: RMSNorm, rotary embeddings, sharded matmul helpers.
+
+Reference analogs: ``layer_norm`` (layers/nvidia/tp_attn.py:60, flashinfer
+rmsnorm), ``_set_cos_sin_cache`` (tp_attn.py:69), ``shard_local``
+(tp_mlp.py:38). On TPU the norms and rope stay as jnp ops — XLA fuses them
+into neighbouring kernels; hand-writing them in Pallas would only block
+fusion.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm in fp32 accumulation (reference layer_norm, tp_attn.py:60)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * lax.rsqrt(var + eps)
+    return (normed * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def precompute_rope_cache(head_dim: int, max_len: int,
+                          theta: float = 1e6) -> tuple[jax.Array, jax.Array]:
+    """(cos, sin) tables of shape (max_len, head_dim//2), fp32
+    (reference ``_set_cos_sin_cache`` tp_attn.py:69-75)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                           dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               position_ids: jax.Array) -> jax.Array:
+    """Neox-style (rotate-half) rotary embedding.
+
+    x: (B, S, H, D); position_ids: (B, S). Matches HF Qwen3 /
+    flashinfer.apply_rope_with_cos_sin_cache (reference tp_attn.py:166)."""
+    c = cos[position_ids][:, :, None, :]  # (B, S, 1, D/2)
+    s = sin[position_ids][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+def shard_param(x: jax.Array, mesh: Mesh, spec: P) -> jax.Array:
+    """Place a (host) array with a named sharding — the analog of the
+    reference's ``shard_local`` (tp_mlp.py:38), except JAX slices the
+    global array per device instead of each rank slicing by hand."""
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def col_parallel_matmul(x: jax.Array, w: jax.Array, mesh: Mesh,
+                        axis: str = "tp") -> jax.Array:
+    """x replicated (M, K) @ w column-sharded (K, N) -> (M, N) col-sharded.
+
+    The local GEMM of the reference's replicated-activation modes
+    (tp_attn.py torch_fwd / gemm-ar path)."""
+    f = jax.shard_map(
+        lambda xs, ws: jnp.dot(xs, ws, preferred_element_type=jnp.float32
+                               ).astype(xs.dtype),
+        mesh=mesh, in_specs=(P(), P(None, axis)),
+        out_specs=P(None, axis), check_vma=False)
+    return f(x, w)
+
+
+def row_parallel_matmul_ar(x: jax.Array, w: jax.Array, mesh: Mesh,
+                           axis: str = "tp") -> jax.Array:
+    """x col-sharded (M, K) @ w row-sharded (K, N) + psum -> replicated.
+
+    XLA golden for the fused ``gemm_ar`` path."""
+    def body(xs, ws):
+        part = jnp.dot(xs, ws, preferred_element_type=jnp.float32
+                       ).astype(xs.dtype)
+        return lax.psum(part, axis)
+    f = jax.shard_map(body, mesh=mesh, in_specs=(P(None, axis), P(axis)),
+                      out_specs=P(), check_vma=False)
+    return f(x, w)

@@ -1,0 +1,184 @@
+"""Tensor-parallel attention (reference ``TP_Attn``, layers/nvidia/tp_attn.py:79).
+
+QKV projections column-parallel (sharded over heads), output projection
+row-parallel. GQA with Qwen3-style per-head q/k RMSNorm and rotary
+embeddings. The fused path shares one all-gather across the three QKV
+GEMMs (``ag_gemm_multi``) and fuses the output projection with the
+ReduceScatter / AllReduce (reference ``dist_triton_fwd`` tp_attn.py:215).
+
+The attention core itself is a shard_map over the head axis — heads are
+fully local under TP, so no collective appears between the QKV and O
+projections (same property as the reference, which calls single-GPU flash
+attention on the local heads).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.layers.common import (
+    apply_rope, col_parallel_matmul, rms_norm, shard_param)
+from triton_dist_tpu.ops.allgather_gemm import (
+    create_ag_gemm_context, ag_gemm_multi)
+from triton_dist_tpu.ops.gemm_reduce_scatter import (
+    create_gemm_rs_context, gemm_rs, gemm_ar)
+
+
+class TPAttn:
+    """GQA attention under TP. No QKV bias (Qwen3 dropped it)."""
+
+    def __init__(self, hidden_size: int, num_heads: int, num_kv_heads: int,
+                 head_dim: int, mesh: Mesh | None = None, axis: str = "tp",
+                 dtype=jnp.bfloat16, fwd_mode: str = "ag_rs",
+                 impl: str = "pallas", qk_norm: bool = True,
+                 rms_eps: float = 1e-6):
+        if mesh is None:
+            from triton_dist_tpu.runtime.dist import get_mesh
+            mesh = get_mesh()
+        self.mesh, self.axis = mesh, axis
+        self.hidden_size = hidden_size
+        self.num_heads, self.num_kv_heads = num_heads, num_kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype
+        self.fwd_mode = fwd_mode
+        self.impl = impl
+        self.qk_norm = qk_norm
+        self.rms_eps = rms_eps
+        world = mesh.shape[axis]
+        assert num_heads % world == 0, (num_heads, world)
+        assert num_kv_heads % world == 0, (num_kv_heads, world)
+        self.ag_ctx = create_ag_gemm_context(mesh, axis)
+        self.rs_ctx = create_gemm_rs_context(mesh, axis)
+
+    def set_fwd(self, mode: str):
+        self.fwd_mode = mode
+
+    # -- params ------------------------------------------------------------
+    def init(self, key: jax.Array) -> dict:
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        h, d = self.hidden_size, self.head_dim
+        nq, nkv = self.num_heads * d, self.num_kv_heads * d
+        scale = h ** -0.5
+        params = {
+            "w_q": jax.random.normal(kq, (h, nq), self.dtype) * scale,
+            "w_k": jax.random.normal(kk, (h, nkv), self.dtype) * scale,
+            "w_v": jax.random.normal(kv, (h, nkv), self.dtype) * scale,
+            "w_o": jax.random.normal(ko, (nq, h), self.dtype) * (nq ** -0.5),
+        }
+        if self.qk_norm:
+            params["q_norm"] = jnp.ones((d,), self.dtype)
+            params["k_norm"] = jnp.ones((d,), self.dtype)
+        return self.shard_params(params)
+
+    def shard_params(self, params: dict) -> dict:
+        m, ax = self.mesh, self.axis
+        out = {
+            "w_q": shard_param(params["w_q"], m, P(None, ax)),
+            "w_k": shard_param(params["w_k"], m, P(None, ax)),
+            "w_v": shard_param(params["w_v"], m, P(None, ax)),
+            "w_o": shard_param(params["w_o"], m, P(ax, None)),
+        }
+        for name in ("q_norm", "k_norm"):
+            if name in params:
+                out[name] = shard_param(params[name], m, P())
+        return out
+
+    # -- forward -----------------------------------------------------------
+    def __call__(self, params: dict, x: jax.Array, position_ids: jax.Array,
+                 rope_cache: tuple[jax.Array, jax.Array],
+                 kv_cache: tuple[jax.Array, jax.Array],
+                 offset: jax.Array, mode: str | None = None):
+        """One attention block.
+
+        Args:
+          x: (M, H) activations, M = B*S. Row-sharded over tp for
+            {xla, ag_rs}; replicated for {xla_ar, gemm_ar}.
+          position_ids: (B, S) absolute positions.
+          rope_cache: (cos, sin) tables (T_max, D/2).
+          kv_cache: (k, v) each (B, T, num_kv_heads, D), head-sharded.
+          offset: scalar int32 — write position into the cache.
+        Returns:
+          (out, (k_cache, v_cache)): out has the same layout as x.
+        """
+        mode = mode or self.fwd_mode
+        impl = "xla" if mode in ("xla", "xla_ar") else self.impl
+        sharded = mode in ("xla", "ag_rs")
+        b, s = position_ids.shape
+        d = self.head_dim
+
+        if sharded:
+            q, k, v = ag_gemm_multi(
+                x, [params["w_q"], params["w_k"], params["w_v"]],
+                self.ag_ctx, impl=impl)
+        else:
+            q = col_parallel_matmul(x, params["w_q"], self.mesh, self.axis)
+            k = col_parallel_matmul(x, params["w_k"], self.mesh, self.axis)
+            v = col_parallel_matmul(x, params["w_v"], self.mesh, self.axis)
+
+        q = q.reshape(b, s, self.num_heads, d)
+        k = k.reshape(b, s, self.num_kv_heads, d)
+        v = v.reshape(b, s, self.num_kv_heads, d)
+
+        # Per-head RMSNorm before rope (Qwen3; reference tp_attn.py:196-200).
+        if self.qk_norm:
+            q = rms_norm(q, params["q_norm"], self.rms_eps)
+            k = rms_norm(k, params["k_norm"], self.rms_eps)
+        cos, sin = rope_cache
+        q = apply_rope(q, cos, sin, position_ids)
+        k = apply_rope(k, cos, sin, position_ids)
+
+        attn, new_cache = self._attention(q, k, v, kv_cache, offset)
+        attn = attn.reshape(b * s, self.num_heads * d)
+
+        if sharded:
+            out = gemm_rs(attn, params["w_o"], self.rs_ctx, impl=impl)
+        else:
+            out = gemm_ar(attn, params["w_o"], self.rs_ctx, impl=impl)
+        return out, new_cache
+
+    def _attention(self, q, k, v, kv_cache, offset):
+        """Cached GQA attention, shard_mapped over the head axis.
+
+        Equivalent role to the reference's flash-attn call on local heads
+        (tp_attn.py:215 dist_triton_fwd); the Pallas flash/SP kernels
+        (ops/flash_decode.py) plug in here for long-context paths."""
+        axis = self.axis
+        groups = self.num_heads // self.num_kv_heads
+        core = functools.partial(_attention_core, groups=groups)
+        spec = P(None, None, axis, None)
+        f = jax.shard_map(
+            core, mesh=self.mesh,
+            in_specs=(spec, spec, spec, spec, spec, P()),
+            out_specs=(spec, spec, spec), check_vma=False)
+        out, ck, cv = f(q, k, v, kv_cache[0], kv_cache[1],
+                        jnp.asarray(offset, jnp.int32))
+        return out, (ck, cv)
+
+
+def _attention_core(q, k, v, cache_k, cache_v, offset, *, groups: int):
+    """Single-device cached causal GQA (fp32 softmax).
+
+    q: (B, S, hq, D); k/v: (B, S, hkv, D); cache: (B, T, hkv, D).
+    Query i sits at absolute position offset+i and attends to cache
+    positions j <= offset+i."""
+    b, s, hq, d = q.shape
+    t = cache_k.shape[1]
+    hkv = cache_k.shape[2]
+    cache_k = lax.dynamic_update_slice(cache_k, k, (0, offset, 0, 0))
+    cache_v = lax.dynamic_update_slice(cache_v, v, (0, offset, 0, 0))
+
+    qg = q.reshape(b, s, hkv, groups, d).astype(jnp.float32)
+    kf = cache_k.astype(jnp.float32)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, kf) * (d ** -0.5)
+    q_pos = offset + jnp.arange(s)[:, None]
+    mask = jnp.arange(t)[None, :] <= q_pos  # (S, T)
+    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs,
+                     cache_v.astype(jnp.float32))
+    return out.reshape(b, s, hq, d).astype(q.dtype), cache_k, cache_v

@@ -1,0 +1,130 @@
+"""Tensor-parallel MLP (reference ``TP_MLP``, layers/nvidia/tp_mlp.py:52).
+
+Column-parallel gate/up projections + row-parallel down projection. The
+fused path feeds ONE all-gather of the activations to both the gate and up
+GEMMs (``ag_gemm_multi``) and reduces the down projection with the fused
+GEMM-RS / GEMM-AR kernels — the reference's ``dist_triton_fwd``
+(tp_mlp.py:147) and ``gemm_ar`` modes.
+
+Weight convention: JAX-style ``(in_features, out_features)``; gate/up are
+column-sharded ``P(None, tp)``, down is row-sharded ``P(tp, None)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.layers.common import (
+    col_parallel_matmul, row_parallel_matmul_ar, shard_param)
+from triton_dist_tpu.ops.allgather_gemm import (
+    create_ag_gemm_context, ag_gemm_multi)
+from triton_dist_tpu.ops.gemm_reduce_scatter import (
+    create_gemm_rs_context, gemm_rs, gemm_ar)
+
+
+class TPMLP:
+    """SwiGLU MLP: ``down( silu(x@gate) * (x@up) )`` under TP."""
+
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 mesh: Mesh | None = None, axis: str = "tp",
+                 dtype=jnp.bfloat16, fwd_mode: str = "ag_rs",
+                 impl: str = "pallas"):
+        if mesh is None:
+            from triton_dist_tpu.runtime.dist import get_mesh
+            mesh = get_mesh()
+        self.mesh, self.axis = mesh, axis
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.dtype = dtype
+        self.fwd_mode = fwd_mode
+        self.impl = impl
+        world = mesh.shape[axis]
+        assert intermediate_size % world == 0
+        assert hidden_size % world == 0
+        # Context objects (reference _init_ctx, tp_mlp.py:116): on TPU these
+        # carry tuning knobs only — symmetric workspaces live in the kernel.
+        self.ag_ctx = create_ag_gemm_context(mesh, axis)
+        self.rs_ctx = create_gemm_rs_context(mesh, axis)
+
+    def set_fwd(self, mode: str):
+        self.fwd_mode = mode
+
+    # -- params ------------------------------------------------------------
+    def init(self, key: jax.Array) -> dict:
+        kg, ku, kd = jax.random.split(key, 3)
+        h, i = self.hidden_size, self.intermediate_size
+        scale = h ** -0.5
+        params = {
+            "w_gate": jax.random.normal(kg, (h, i), self.dtype) * scale,
+            "w_up": jax.random.normal(ku, (h, i), self.dtype) * scale,
+            "w_down": jax.random.normal(kd, (i, h), self.dtype) * (i ** -0.5),
+        }
+        return self.shard_params(params)
+
+    def shard_params(self, params: dict) -> dict:
+        m, ax = self.mesh, self.axis
+        return {
+            "w_gate": shard_param(params["w_gate"], m, P(None, ax)),
+            "w_up": shard_param(params["w_up"], m, P(None, ax)),
+            "w_down": shard_param(params["w_down"], m, P(ax, None)),
+        }
+
+    # -- forwards ----------------------------------------------------------
+    def __call__(self, params: dict, x: jax.Array,
+                 mode: str | None = None) -> jax.Array:
+        """x: (M, H). Row-sharded for {xla, ag_rs}; replicated for
+        {xla_ar, gemm_ar}. Output has the same layout as the input."""
+        mode = mode or self.fwd_mode
+        if mode == "ag_rs":
+            return self._fused_fwd(params, x, reduce="rs")
+        if mode == "gemm_ar":
+            return self._fused_fwd(params, x, reduce="ar")
+        if mode == "xla":
+            return self._xla_fwd(params, x)
+        if mode == "xla_ar":
+            return self._xla_ar_fwd(params, x)
+        raise ValueError(f"unknown fwd mode {mode!r}")
+
+    def _fused_fwd(self, params, x, reduce: str):
+        if reduce == "rs":
+            gate, up = ag_gemm_multi(
+                x, [params["w_gate"], params["w_up"]], self.ag_ctx,
+                impl=self.impl)
+        else:
+            gate = col_parallel_matmul(x, params["w_gate"], self.mesh,
+                                       self.axis)
+            up = col_parallel_matmul(x, params["w_up"], self.mesh, self.axis)
+        act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+        if reduce == "rs":
+            return gemm_rs(act, params["w_down"], self.rs_ctx, impl=self.impl)
+        return gemm_ar(act, params["w_down"], self.rs_ctx, impl=self.impl)
+
+    def _xla_fwd(self, params, x):
+        """shard_map golden with the ag_rs layout (row-sharded x)."""
+        axis = self.axis
+
+        def body(xs, wg, wu, wd):
+            ag = lax.all_gather(xs, axis, tiled=True)
+            gate = jnp.dot(ag, wg, preferred_element_type=jnp.float32)
+            up = jnp.dot(ag, wu, preferred_element_type=jnp.float32)
+            act = (jax.nn.silu(gate) * up).astype(xs.dtype)
+            part = jnp.dot(act, wd, preferred_element_type=jnp.float32
+                           ).astype(xs.dtype)
+            return lax.psum_scatter(part, axis, scatter_dimension=0,
+                                    tiled=True)
+        f = jax.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(axis), P(None, axis), P(None, axis), P(axis)),
+            out_specs=P(axis), check_vma=False)
+        return f(x, params["w_gate"], params["w_up"], params["w_down"])
+
+    def _xla_ar_fwd(self, params, x):
+        """Replicated-activation golden (reference torch_fwd NCCL AR)."""
+        gate = col_parallel_matmul(x, params["w_gate"], self.mesh, self.axis)
+        up = col_parallel_matmul(x, params["w_up"], self.mesh, self.axis)
+        act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+        return row_parallel_matmul_ar(act, params["w_down"], self.mesh,
+                                      self.axis)

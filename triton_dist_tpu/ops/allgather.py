@@ -269,7 +269,7 @@ def all_gather(x: jax.Array, ctx: AllGatherContext | None = None,
             in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
             out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
             scratch_shapes=scratch,
-            compiler_params=comm_params(collective_id=1),
+            compiler_params=comm_params(collective_id=1, world=world),
             interpret=interpret,
         )(xs)
 

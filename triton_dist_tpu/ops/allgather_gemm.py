@@ -69,14 +69,24 @@ def create_ag_gemm_context(mesh: Mesh | None = None, axis: str = "tp",
                                 return_gathered=return_gathered)
 
 
-def _ag_gemm_kernel(x_ref, w_ref, ag_ref, c_ref, send_sem, recv_sem, *,
-                    axis: str, world: int, rows: int, acc_dtype):
-    """Ring AG of A chunks fused with per-chunk GEMM.
+def _ag_gemm_kernel(x_ref, *rest, axis: str, world: int, rows: int,
+                    acc_dtype, n_b: int):
+    """Ring AG of A chunks fused with per-chunk GEMM(s).
 
     Per step: start forwarding the freshest chunk (DMA on ICI), then run
     the MXU on it (overlap), then wait for the next chunk's arrival — the
     wait is the reference's ``dl.wait(ready_ptr + rank, ...)``
-    (allgather_gemm.py:236)."""
+    (allgather_gemm.py:236).
+
+    Supports ``n_b`` weight matrices sharing the gathered A (one AG feeding
+    several GEMMs — the QKV / gate+up projections of a TP transformer
+    layer, reference tp_attn.py wqkv concat / tp_mlp.py gate_up concat).
+    On TPU separate B operands beat a concatenated one because each B keeps
+    a clean column sharding."""
+    w_refs = rest[:n_b]
+    ag_ref = rest[n_b]
+    c_refs = rest[n_b + 1:2 * n_b + 1]
+    send_sem, recv_sem = rest[2 * n_b + 1:2 * n_b + 3]
     me = lax.axis_index(axis)
     right = lax.rem(me + 1, world)
 
@@ -91,9 +101,10 @@ def _ag_gemm_kernel(x_ref, w_ref, ag_ref, c_ref, send_sem, recv_sem, *,
             right, send_sem.at[idx], recv_sem.at[idx], axis=axis)
 
     def gemm_chunk(idx):
-        c_ref[pl.ds(idx * rows, rows), :] = jnp.dot(
-            ag_ref[pl.ds(idx * rows, rows), :], w_ref[:],
-            preferred_element_type=acc_dtype).astype(c_ref.dtype)
+        for w_ref, c_ref in zip(w_refs, c_refs):
+            c_ref[pl.ds(idx * rows, rows), :] = jnp.dot(
+                ag_ref[pl.ds(idx * rows, rows), :], w_ref[:],
+                preferred_element_type=acc_dtype).astype(c_ref.dtype)
 
     if world == 1:
         gemm_chunk(me)
@@ -122,6 +133,69 @@ def _ag_gemm_kernel(x_ref, w_ref, ag_ref, c_ref, send_sem, recv_sem, *,
     lax.fori_loop(0, world - 1, drain, None)
 
 
+def ag_gemm_multi(a: jax.Array, bs,
+                  ctx: AllGatherGEMMContext | None = None,
+                  impl: str = "pallas"):
+    """[C_i = allgather(a) @ b_i] sharing one fused all-gather.
+
+    Args:
+      a: (M, K) row-sharded over ``ctx.axis``.
+      bs: sequence of (K, N_i), each column-sharded over ``ctx.axis``.
+    Returns:
+      list of C_i (M, N_i) column-sharded; with ``ctx.return_gathered``
+      also the gathered A as the last element.
+    """
+    ctx = ctx or create_ag_gemm_context()
+    mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    bs = list(bs)
+    n_b = len(bs)
+    m, k = a.shape
+    for b in bs:
+        assert b.shape[0] == k and b.shape[1] % world == 0
+    assert m % world == 0
+    rows = m // world
+    c_spec = [P(None, axis)] * n_b
+    out_specs = tuple(c_spec) + ((P(axis),) if ctx.return_gathered else ())
+
+    if impl == "xla":
+        def body(xs, *ws):
+            ag = lax.all_gather(xs, axis, tiled=True)
+            cs = [jnp.dot(ag, w, preferred_element_type=ctx.acc_dtype
+                          ).astype(xs.dtype) for w in ws]
+            return tuple(cs) + ((ag,) if ctx.return_gathered else ())
+        f = jax.shard_map(body, mesh=mesh,
+                          in_specs=(P(axis),) + (P(None, axis),) * n_b,
+                          out_specs=out_specs, check_vma=False)
+        return list(f(a, *bs))
+
+    interpret = resolve_interpret(ctx.interpret)
+    kernel = functools.partial(_ag_gemm_kernel, axis=axis, world=world,
+                               rows=rows, acc_dtype=ctx.acc_dtype, n_b=n_b)
+
+    def body(xs, *ws):
+        out = pl.pallas_call(
+            kernel,
+            out_shape=tuple(
+                [jax.ShapeDtypeStruct((m, k), a.dtype)] +
+                [jax.ShapeDtypeStruct((m, b.shape[1] // world), a.dtype)
+                 for b in bs]),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * (1 + n_b),
+            out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)]
+                            * (1 + n_b)),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((world,)),
+                            pltpu.SemaphoreType.DMA((world,))],
+            compiler_params=comm_params(collective_id=4, world=world),
+            interpret=interpret,
+        )(xs, *ws)
+        ag, cs = out[0], out[1:]
+        return tuple(cs) + ((ag,) if ctx.return_gathered else ())
+
+    f = jax.shard_map(body, mesh=mesh,
+                      in_specs=(P(axis),) + (P(None, axis),) * n_b,
+                      out_specs=out_specs, check_vma=False)
+    return list(f(a, *bs))
+
+
 def ag_gemm(a: jax.Array, b: jax.Array,
             ctx: AllGatherGEMMContext | None = None,
             impl: str = "pallas"):
@@ -135,45 +209,7 @@ def ag_gemm(a: jax.Array, b: jax.Array,
       C: (M, N) column-sharded; with ``ctx.return_gathered`` also the
       gathered A (stacked per device: (w*M, K) sharded).
     """
-    ctx = ctx or create_ag_gemm_context()
-    mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
-    m, k = a.shape
-    k2, n = b.shape
-    assert k == k2 and m % world == 0 and n % world == 0
-    rows = m // world
-    out_specs = (P(None, axis), P(axis)) if ctx.return_gathered \
-        else P(None, axis)
-
-    if impl == "xla":
-        def body(xs, ws):
-            ag = lax.all_gather(xs, axis, tiled=True)
-            c = jnp.dot(ag, ws, preferred_element_type=ctx.acc_dtype
-                        ).astype(xs.dtype)
-            return (c, ag) if ctx.return_gathered else c
-        f = jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P(None, axis)),
-                          out_specs=out_specs, check_vma=False)
-        return f(a, b)
-
-    interpret = resolve_interpret(ctx.interpret)
-    kernel = functools.partial(_ag_gemm_kernel, axis=axis, world=world,
-                               rows=rows, acc_dtype=ctx.acc_dtype)
-
-    def body(xs, ws):
-        ag, c = pl.pallas_call(
-            kernel,
-            out_shape=(jax.ShapeDtypeStruct((m, k), a.dtype),
-                       jax.ShapeDtypeStruct((m, n // world), a.dtype)),
-            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
-                      pl.BlockSpec(memory_space=pltpu.VMEM)],
-            out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
-                       pl.BlockSpec(memory_space=pltpu.VMEM)),
-            scratch_shapes=[pltpu.SemaphoreType.DMA((world,)),
-                            pltpu.SemaphoreType.DMA((world,))],
-            compiler_params=comm_params(collective_id=4),
-            interpret=interpret,
-        )(xs, ws)
-        return (c, ag) if ctx.return_gathered else c
-
-    f = jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P(None, axis)),
-                      out_specs=out_specs, check_vma=False)
-    return f(a, b)
+    out = ag_gemm_multi(a, [b], ctx, impl)
+    if len(out) == 2:
+        return out[0], out[1]
+    return out[0]

@@ -254,7 +254,7 @@ def all_reduce(x: jax.Array, ctx: AllReduceContext | None = None,
             in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
             out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
             scratch_shapes=scratch,
-            compiler_params=comm_params(collective_id=3),
+            compiler_params=comm_params(collective_id=3, world=world),
             interpret=interpret,
         )(xs[0])
         return r[None] if stacked else r

@@ -29,12 +29,19 @@ def resolve_interpret(interpret: bool | None):
     return False
 
 
-def comm_params(collective_id: int = 0,
-                vmem_limit_bytes: int | None = None) -> pltpu.CompilerParams:
+def comm_params(collective_id: int | None = 0,
+                vmem_limit_bytes: int | None = None,
+                world: int | None = None) -> pltpu.CompilerParams:
     """CompilerParams for kernels that communicate: side effects must be kept
     (DMA-only kernels would be DCE'd) and a collective_id is required for the
-    global barrier semaphore."""
-    kwargs = dict(has_side_effects=True, collective_id=collective_id)
+    global barrier semaphore.
+
+    At ``world == 1`` kernels skip ``dl.barrier_all`` so no barrier semaphore
+    exists — Mosaic then rejects a ``collective_id`` ("has to be unspecified
+    ... when not using a custom barrier")."""
+    kwargs = dict(has_side_effects=True)
+    if world != 1 and collective_id is not None:
+        kwargs["collective_id"] = collective_id
     if vmem_limit_bytes is not None:
         kwargs["vmem_limit_bytes"] = vmem_limit_bytes
     return pltpu.CompilerParams(**kwargs)

@@ -144,7 +144,7 @@ def _gemm_rs_kernel(x_ref, w_ref, o_ref, send_buf, recv_buf, send_sem,
 
 def _entry(a, b, ctx, impl, all_gather_epilogue):
     mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
-    m, k_loc = a.shape
+    m = a.shape[0]
     _, n = b.shape
     assert m % world == 0
     rows = m // world
@@ -190,7 +190,7 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
                       pl.BlockSpec(memory_space=pltpu.VMEM)],
             out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
             scratch_shapes=scratch,
-            compiler_params=comm_params(collective_id=5),
+            compiler_params=comm_params(collective_id=5, world=world),
             interpret=interpret,
         )(xs, ws)
 
@@ -216,6 +216,17 @@ def gemm_ar(a: jax.Array, b: jax.Array,
             impl: str = "pallas") -> jax.Array:
     """allreduce(a @ b): GEMM fused with two-shot AllReduce — the
     small-batch decode path (reference gemm_allreduce.py, e2e_dense.md
-    GEMM-AR rows). Returns (M, N) replicated."""
+    GEMM-AR rows). Returns (M, N) replicated.
+
+    M smaller than / not divisible by the world size (decode batches) is
+    zero-padded to a ring-chunkable M and sliced back — the analog of the
+    reference's tile-padded GEMM grids."""
     ctx = ctx or create_gemm_rs_context()
+    m = a.shape[0]
+    world = ctx.world_size
+    if m % world != 0:
+        pad = world - m % world
+        a = jnp.concatenate(
+            [a, jnp.zeros((pad, a.shape[1]), a.dtype)], axis=0)
+        return _entry(a, b, ctx, impl, all_gather_epilogue=True)[:m]
     return _entry(a, b, ctx, impl, all_gather_epilogue=True)

@@ -228,7 +228,7 @@ def reduce_scatter(x: jax.Array, ctx: ReduceScatterContext | None = None,
             in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
             out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
             scratch_shapes=scratch,
-            compiler_params=comm_params(collective_id=2),
+            compiler_params=comm_params(collective_id=2, world=world),
             interpret=interpret,
         )(xs[0])
 
