@@ -1,0 +1,247 @@
+"""MoE / EP tests: routing utils, LL all-to-all, EP dispatch/combine,
+grouped GEMM, MoE-RS, and the TP-MoE layer vs a dense golden.
+
+Mirrors the reference's test spine (SURVEY.md §4): correctness vs a
+brute-force golden on an 8-device mesh — test_all_to_all.py,
+test_ep_a2a.py, test_ag_moe.py, test_moe_reduce_rs.py, test_tp_moe.py
+collapsed into one single-process suite.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.ops.moe_utils import (
+    topk_routing, dispatch_layout, scatter_to_slabs)
+from triton_dist_tpu.ops.all_to_all import (
+    create_all_to_all_context, fast_all_to_all)
+from triton_dist_tpu.ops.group_gemm import (
+    grouped_matmul, grouped_expert_ffn, create_ag_group_gemm_context,
+    ag_group_gemm)
+from triton_dist_tpu.ops.moe_reduce_rs import (
+    create_moe_rs_context, moe_reduce_rs)
+from triton_dist_tpu.layers.ep_a2a import EPAll2AllLayer
+from triton_dist_tpu.layers.tp_moe import TPMoE
+
+
+def dense_moe_golden(x, w_router, w_gate, w_up, w_down, topk,
+                     norm_topk_prob=True):
+    """Brute-force per-token MoE (fp32): the NCCL-golden analog."""
+    x32 = np.asarray(x, np.float32)
+    logits = x32 @ np.asarray(w_router, np.float32)
+    e = logits.shape[-1]
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    idx = np.argsort(-probs, axis=-1, kind="stable")[:, :topk]
+    w = np.take_along_axis(probs, idx, axis=-1)
+    if norm_topk_prob:
+        w /= w.sum(-1, keepdims=True)
+    out = np.zeros_like(x32)
+    for t in range(x.shape[0]):
+        for k in range(topk):
+            ex = idx[t, k]
+            g = x32[t] @ np.asarray(w_gate[ex], np.float32)
+            u = x32[t] @ np.asarray(w_up[ex], np.float32)
+            act = (g / (1 + np.exp(-g))) * u
+            out[t] += w[t, k] * (act @ np.asarray(w_down[ex], np.float32))
+    return out
+
+
+def test_topk_routing():
+    logits = jnp.array([[1.0, 3.0, 2.0, -1.0]])
+    w, idx = topk_routing(logits, 2)
+    assert idx.tolist() == [[1, 2]]
+    np.testing.assert_allclose(np.asarray(w).sum(), 1.0, rtol=1e-6)
+
+
+def test_dispatch_layout_positions():
+    idx = jnp.array([[0, 3], [1, 3], [0, 2]], jnp.int32)  # E=4, world=2
+    meta = dispatch_layout(idx, num_experts=4, world=2, capacity=4)
+    # dest = expert // 2
+    assert meta["dest"].tolist() == [[0, 1], [0, 1], [0, 1]]
+    # positions are unique per destination and dense from 0
+    assert meta["send_counts"].tolist() == [3, 3]
+    for r in range(2):
+        pos = np.asarray(meta["pos"])[np.asarray(meta["dest"]) == r]
+        assert sorted(pos.tolist()) == [0, 1, 2]
+    assert bool(np.all(np.asarray(meta["valid"])))
+
+
+def test_dispatch_layout_capacity_drop():
+    idx = jnp.zeros((5, 1), jnp.int32)  # all to rank 0
+    meta = dispatch_layout(idx, num_experts=2, world=2, capacity=3)
+    assert int(meta["send_counts"][0]) == 3
+    assert int(np.asarray(meta["valid"]).sum()) == 3
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_fast_all_to_all(mesh8, impl):
+    world, cap, h = 8, 16, 128
+    ctx = create_all_to_all_context(mesh8, "tp", capacity=cap)
+    key = jax.random.PRNGKey(0)
+    buf = jax.random.normal(key, (world * world, cap, h), jnp.float32)
+    counts = jax.random.randint(jax.random.PRNGKey(1), (world * world,),
+                                0, cap + 1, jnp.int32)
+    sharded = jax.device_put(buf, NamedSharding(mesh8, P("tp")))
+    counts = jax.device_put(counts, NamedSharding(mesh8, P("tp")))
+
+    recv, rcounts = fast_all_to_all(sharded, counts, ctx, impl=impl)
+    recv = np.asarray(recv).reshape(world, world, cap, h)
+    rcounts = np.asarray(rcounts).reshape(world, world)
+    sent = np.asarray(buf).reshape(world, world, cap, h)
+    scounts = np.asarray(counts).reshape(world, world)
+    for dst in range(world):
+        for src in range(world):
+            assert rcounts[dst, src] == scounts[src, dst]
+            n = rcounts[dst, src]
+            # only live rows are defined
+            np.testing.assert_array_equal(recv[dst, src, :n],
+                                          sent[src, dst, :n])
+
+
+def test_grouped_matmul_matches_loop(key):
+    t, kdim, n, e = 32, 16, 24, 4
+    x = jax.random.normal(key, (t, kdim), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(7), (e, kdim, n), jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(8), (t,), 0, e, jnp.int32)
+    out = grouped_matmul(x, w, ids, e)
+    ref = np.stack([np.asarray(x[i]) @ np.asarray(w[int(ids[i])])
+                    for i in range(t)])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_grouped_expert_ffn_sentinel_masked(key):
+    t, h, i, e = 16, 8, 12, 3
+    x = jax.random.normal(key, (t, h), jnp.float32)
+    wg = jax.random.normal(jax.random.PRNGKey(1), (e, h, i), jnp.float32)
+    wu = jax.random.normal(jax.random.PRNGKey(2), (e, h, i), jnp.float32)
+    wd = jax.random.normal(jax.random.PRNGKey(3), (e, i, h), jnp.float32)
+    ids = jnp.concatenate([jnp.zeros((8,), jnp.int32),
+                           jnp.full((8,), e, jnp.int32)])  # half invalid
+    out = grouped_expert_ffn(x, wg, wu, wd, ids, e)
+    # valid rows match a manual swiglu through expert 0
+    g = np.asarray(x[:8]) @ np.asarray(wg[0])
+    u = np.asarray(x[:8]) @ np.asarray(wu[0])
+    ref = ((g / (1 + np.exp(-g))) * u) @ np.asarray(wd[0])
+    np.testing.assert_allclose(np.asarray(out[:8]), ref, rtol=1e-4,
+                               atol=1e-4)
+
+
+@pytest.mark.parametrize("impl", ["xla", "ring"])
+def test_ag_group_gemm(mesh8, impl, key):
+    world, rows, kdim, n, e = 8, 4, 16, 256, 4
+    m = world * rows
+    x = jax.random.normal(key, (m, kdim), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(5), (e, kdim, n), jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(6), (m,), 0, e, jnp.int32)
+    xs = jax.device_put(x, NamedSharding(mesh8, P("tp")))
+    ws = jax.device_put(w, NamedSharding(mesh8, P(None, None, "tp")))
+    ids_s = jax.device_put(ids, NamedSharding(mesh8, P("tp")))
+    ctx = create_ag_group_gemm_context(mesh8, "tp")
+    out = ag_group_gemm(xs, ws, ids_s, e, ctx, impl=impl)
+    ref = np.stack([np.asarray(x[i]) @ np.asarray(w[int(ids[i])])
+                    for i in range(m)])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("impl", ["xla", "ring"])
+def test_moe_reduce_rs(mesh8, impl, key):
+    world, rows, i, h, e, topk = 8, 4, 32, 16, 4, 2
+    t = world * rows
+    act = jax.random.normal(key, (t * topk, i), jnp.float32)
+    wd = jax.random.normal(jax.random.PRNGKey(2), (e, i, h), jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(3), (t * topk,), 0, e,
+                             jnp.int32)
+    wts = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(4), (t, topk)), axis=-1)
+    ctx = create_moe_rs_context(mesh8, "tp", num_experts=e, topk=topk)
+    act_s = jax.device_put(act, NamedSharding(mesh8, P(None, "tp")))
+    wd_s = jax.device_put(wd, NamedSharding(mesh8, P(None, "tp", None)))
+    out = moe_reduce_rs(act_s, wd_s, ids, wts, ctx, impl=impl)
+    # golden: full-I down-proj, weighted reduce (no sharding)
+    pair = np.stack([np.asarray(act[i_]) @ np.asarray(wd[int(ids[i_])])
+                     for i_ in range(t * topk)]).reshape(t, topk, h)
+    ref = (pair * np.asarray(wts)[..., None]).sum(1)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_ep_dispatch_combine_roundtrip(mesh8, impl, key):
+    """Identity expert: combine(dispatch(x)) == x (weights sum to 1)."""
+    world, rows, h, e, topk = 8, 8, 128, 16, 2
+    t = world * rows
+    layer = EPAll2AllLayer(max_tokens=rows, hidden=h, topk=topk,
+                           num_experts=e, mesh=mesh8, axis="tp",
+                           dtype=jnp.float32, impl=impl)
+    x = jax.random.normal(key, (t, h), jnp.float32)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (t, topk), 0, e,
+                             jnp.int32)
+    wts = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(2), (t, topk)), axis=-1)
+    xs = jax.device_put(x, NamedSharding(mesh8, P("tp")))
+    idx_s = jax.device_put(idx, NamedSharding(mesh8, P("tp")))
+    wts_s = jax.device_put(wts, NamedSharding(mesh8, P("tp")))
+
+    tokens, local_expert, handle = layer.dispatch(xs, idx_s)
+    assert tokens.shape == (world * world * layer.capacity, h)
+    out = layer.combine(tokens, wts_s, handle)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_ep_moe_vs_dense(mesh8, key):
+    """Full EP MoE: dispatch → grouped expert FFN (per-rank expert shard)
+    → combine, vs the brute-force dense golden."""
+    world, rows, h, i, e, topk = 8, 4, 16, 24, 16, 2
+    t = world * rows
+    epr = e // world
+    x = jax.random.normal(key, (t, h), jnp.float32) * 0.5
+    wr = jax.random.normal(jax.random.PRNGKey(1), (h, e), jnp.float32)
+    wg = jax.random.normal(jax.random.PRNGKey(2), (e, h, i), jnp.float32)
+    wu = jax.random.normal(jax.random.PRNGKey(3), (e, h, i), jnp.float32)
+    wd = jax.random.normal(jax.random.PRNGKey(4), (e, i, h), jnp.float32)
+
+    logits = x @ wr
+    wts, idx = topk_routing(logits, topk)
+
+    layer = EPAll2AllLayer(max_tokens=rows, hidden=h, topk=topk,
+                           num_experts=e, mesh=mesh8, axis="tp",
+                           dtype=jnp.float32, impl="xla")
+    sh = lambda a, spec: jax.device_put(a, NamedSharding(mesh8, spec))
+    tokens, local_expert, handle = layer.dispatch(sh(x, P("tp")),
+                                                  sh(idx, P("tp")))
+
+    # Expert compute per rank on its expert shard (E/world experts).
+    from jax import shard_map
+    from triton_dist_tpu.ops.group_gemm import grouped_expert_ffn as ffn
+
+    def local_ffn(tok, le, g, u, d):
+        return ffn(tok, g, u, d, le, epr)
+    out_tok = jax.shard_map(
+        local_ffn, mesh=mesh8,
+        in_specs=(P("tp"), P("tp"), P("tp"), P("tp"), P("tp")),
+        out_specs=P("tp"), check_vma=False)(
+        tokens, local_expert,
+        sh(wg, P("tp")), sh(wu, P("tp")), sh(wd, P("tp")))
+
+    out = layer.combine(out_tok, sh(wts, P("tp")), handle)
+    ref = dense_moe_golden(x, wr, wg, wu, wd, topk)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("mode", ["xla", "ag_rs"])
+def test_tp_moe_vs_dense(mesh8, mode, key):
+    world, rows, h, i, e, topk = 8, 4, 16, 32, 4, 2
+    t = world * rows
+    layer = TPMoE(hidden_size=h, intermediate_size=i, num_experts=e,
+                  topk=topk, mesh=mesh8, axis="tp", dtype=jnp.float32)
+    params = layer.init(key)
+    full = {k: np.asarray(jax.device_get(v)) for k, v in params.items()}
+    x = jax.random.normal(jax.random.PRNGKey(9), (t, h), jnp.float32) * 0.5
+    xs = jax.device_put(x, NamedSharding(mesh8, P("tp")))
+    out = layer(params, xs, mode=mode)
+    ref = dense_moe_golden(x, full["w_router"], full["w_gate"],
+                           full["w_up"], full["w_down"], topk)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-3)

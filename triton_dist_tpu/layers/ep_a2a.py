@@ -1,0 +1,179 @@
+"""Expert-parallel AllToAll dispatch/combine layer.
+
+TPU-native redesign of the reference's ``EPAll2AllLayer``
+(python/triton_dist/layers/nvidia/ep_a2a_layer.py:40-248: preprocess
+computes splits/offsets, dispatch pushes tokens to the ranks owning their
+experts, combine reverses; double-buffered symmetric buffers) over our
+``fast_all_to_all`` op (ops/all_to_all.py ≙ low_latency_all_to_all.py).
+
+Static-shape contract: every (token, k) pair gets a slot in a
+``(world, capacity)`` rank-major send layout (ops/moe_utils.dispatch_layout
+≙ the reference's send-request generation + recv-offset computation,
+ep_a2a.py:244). Payload rides the Pallas LL a2a; int32 sideband metadata
+(local expert id) rides a tiny XLA all-to-all, like the reference's splits
+pre-exchange.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.ops.all_to_all import (
+    AllToAllContext, create_all_to_all_context, fast_all_to_all)
+from triton_dist_tpu.ops.moe_utils import (
+    dispatch_layout, scatter_to_slabs, topk_reduce)
+
+
+@dataclasses.dataclass
+class DispatchHandle:
+    """State carried from dispatch to combine (the reference stashes it on
+    the module: num_dispatch_token_cur_rank etc., ep_a2a_layer.py:100)."""
+    dest: jax.Array        # (T, K) global, row-sharded
+    pos: jax.Array         # (T, K)
+    valid: jax.Array       # (T, K)
+    recv_counts: jax.Array  # (world*world,) row-sharded
+
+
+class EPAll2AllLayer:
+    """dispatch(x, indices) → tokens grouped for local expert compute;
+    combine(expert_out, weights, handle) → per-token outputs."""
+
+    def __init__(self, max_tokens: int, hidden: int, topk: int,
+                 num_experts: int, mesh: Mesh | None = None,
+                 axis: str = "ep", capacity: int | None = None,
+                 dtype=jnp.bfloat16, impl: str = "pallas"):
+        if mesh is None:
+            from triton_dist_tpu.runtime.dist import get_mesh
+            mesh = get_mesh()
+        self.mesh, self.axis = mesh, axis
+        self.world = mesh.shape[axis]
+        assert num_experts % self.world == 0
+        self.max_tokens = max_tokens
+        self.hidden = hidden
+        self.topk = topk
+        self.num_experts = num_experts
+        self.experts_per_rank = num_experts // self.world
+        # Worst case: every pair this rank routes lands on one peer
+        # (reference sizes send_buf the same way: max_tokens * topk rows,
+        # ep_a2a_layer.py:70-90).
+        cap = capacity or max_tokens * topk
+        cap = max(8, -(-cap // 8) * 8)  # sublane-align for chunked DMA
+        self.capacity = cap
+        self.dtype = dtype
+        self.impl = impl
+        self.a2a_ctx: AllToAllContext = create_all_to_all_context(
+            mesh, axis, capacity=cap)
+
+    # -- helpers -----------------------------------------------------------
+    def _meta_a2a(self, arr: jax.Array) -> jax.Array:
+        """XLA all-to-all for small int sideband arrays (local shape
+        (world, ...) → transposed slabs)."""
+        axis = self.axis
+
+        def body(a):
+            return lax.all_to_all(a, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)
+        return jax.shard_map(body, mesh=self.mesh, in_specs=P(axis),
+                             out_specs=P(axis), check_vma=False)(arr)
+
+    # -- dispatch ----------------------------------------------------------
+    def dispatch(self, x: jax.Array, exp_indices: jax.Array):
+        """Route token rows to the ranks owning their experts.
+
+        Args:
+          x: (T, H) row-sharded over ``axis`` (T = world * tokens_per_rank).
+          exp_indices: (T, topk) int32 global expert ids, row-sharded.
+
+        Returns (tokens, local_expert, handle):
+          tokens: (world*capacity, H) per device (global leading dim
+            world²*capacity, sharded) — received pair rows.
+          local_expert: matching (world*capacity,) int32 per device;
+            invalid slots hold ``experts_per_rank`` (sentinel sorted last
+            by grouped compute).
+          handle: state for :meth:`combine`.
+        """
+        world, cap = self.world, self.capacity
+        axis = self.axis
+
+        def local_pack(xs, ids):
+            meta = dispatch_layout(ids, self.num_experts, world, cap)
+            buf, extras = scatter_to_slabs(
+                xs, meta, world, cap,
+                extra={"local_expert": meta["local_expert"]})
+            return (buf, extras["local_expert"], meta["send_counts"],
+                    meta["dest"], meta["pos"], meta["valid"])
+
+        pack = jax.shard_map(
+            local_pack, mesh=self.mesh, in_specs=(P(axis), P(axis)),
+            out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis)),
+            check_vma=False)
+        send_buf, send_exp, send_counts, dest, pos, valid = pack(
+            x, exp_indices)
+
+        recv_buf, recv_counts = fast_all_to_all(
+            send_buf, send_counts, self.a2a_ctx, impl=self.impl)
+        recv_exp = self._meta_a2a(send_exp)
+
+        def local_unpack(rb, re, rc):
+            # Mask slots past each slab's live count; sentinel expert id.
+            slot = lax.broadcasted_iota(jnp.int32, (world, cap), 1)
+            live = slot < rc[:, None]
+            exp = jnp.where(live, re, self.experts_per_rank)
+            return rb.reshape(world * cap, -1), exp.reshape(-1)
+
+        unpack = jax.shard_map(
+            local_unpack, mesh=self.mesh,
+            in_specs=(P(axis), P(axis), P(axis)),
+            out_specs=(P(axis), P(axis)), check_vma=False)
+        tokens, local_expert = unpack(recv_buf, recv_exp, recv_counts)
+
+        handle = DispatchHandle(dest=dest, pos=pos, valid=valid,
+                                recv_counts=recv_counts)
+        return tokens, local_expert, handle
+
+    # -- combine -----------------------------------------------------------
+    def combine(self, expert_out: jax.Array, weights: jax.Array,
+                handle: DispatchHandle) -> jax.Array:
+        """Return processed pair rows to their source ranks and reduce over
+        top-k (reference combine: same kernel reversed + topk reduce,
+        ep_a2a_layer.py:200-248).
+
+        Args:
+          expert_out: (world*capacity, H) per device — processed rows in
+            dispatch slot order (global sharded like dispatch's output).
+          weights: (T, topk) routing weights, row-sharded.
+        Returns:
+          (T, H) row-sharded combined outputs.
+        """
+        world, cap = self.world, self.capacity
+        axis = self.axis
+
+        def reshape_slabs(eo):
+            return eo.reshape(world, cap, -1)
+        slabs = jax.shard_map(reshape_slabs, mesh=self.mesh,
+                              in_specs=P(axis), out_specs=P(axis),
+                              check_vma=False)(expert_out)
+
+        # Reverse exchange: slab j goes back to rank j (counts are what we
+        # received in dispatch).
+        back_buf, _ = fast_all_to_all(slabs, handle.recv_counts,
+                                      self.a2a_ctx, impl=self.impl)
+
+        def local_gather(bb, dest, pos, valid, wts):
+            t, k = dest.shape
+            flat = bb.reshape(world * cap, -1)
+            slot = dest.reshape(-1) * cap + pos.reshape(-1)
+            rows = flat[jnp.minimum(slot, world * cap - 1)]
+            rows = jnp.where(valid.reshape(-1)[:, None], rows, 0)
+            return topk_reduce(rows.reshape(t, k, -1), wts)
+
+        gather = jax.shard_map(
+            local_gather, mesh=self.mesh,
+            in_specs=(P(axis),) * 5, out_specs=P(axis), check_vma=False)
+        return gather(back_buf, handle.dest, handle.pos, handle.valid,
+                      weights)

@@ -1,0 +1,125 @@
+"""Tensor-parallel MoE layer (reference ``TP_MoE``,
+python/triton_dist/layers/nvidia/tp_moe.py: AG-MoE grouped GEMM +
+MoE-ReduceScatter kernels around a softmax-topk router).
+
+Sharding: every expert's gate/up weights are column-sharded over the TP
+axis ((E, H, I/w)), down weights row-sharded ((E, I/w, H)) — the dense
+TP_MLP recipe applied per expert. Activations stay row(M)-sharded between
+layers, like the ag_rs dense path.
+
+Fused path ("ag_rs"): Pallas all-gather of the token rows + routing ids
+(ops/allgather ≙ the AG producer of allgather_group_gemm.py), pair
+expansion, grouped gate/up via ``ragged_dot`` (ops/group_gemm), then the
+ring-overlapped ``moe_reduce_rs`` (ops/moe_reduce_rs ≙ moe_reduce_rs.py
+:546).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.layers.common import shard_param
+from triton_dist_tpu.ops.allgather import (
+    create_allgather_context, all_gather)
+from triton_dist_tpu.ops.group_gemm import grouped_matmul
+from triton_dist_tpu.ops.moe_reduce_rs import (
+    create_moe_rs_context, moe_reduce_rs)
+from triton_dist_tpu.ops.moe_utils import topk_routing
+
+
+class TPMoE:
+    """Qwen3-MoE-style sparse FFN under tensor parallelism."""
+
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 num_experts: int, topk: int, mesh: Mesh | None = None,
+                 axis: str = "tp", dtype=jnp.bfloat16,
+                 fwd_mode: str = "ag_rs", impl: str = "pallas",
+                 norm_topk_prob: bool = True):
+        if mesh is None:
+            from triton_dist_tpu.runtime.dist import get_mesh
+            mesh = get_mesh()
+        self.mesh, self.axis = mesh, axis
+        self.world = mesh.shape[axis]
+        assert intermediate_size % self.world == 0
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_experts = num_experts
+        self.topk = topk
+        self.dtype = dtype
+        self.fwd_mode = fwd_mode
+        self.impl = impl
+        self.norm_topk_prob = norm_topk_prob
+        self.ag_ctx = create_allgather_context(mesh, axis)
+        self.rs_ctx = create_moe_rs_context(mesh, axis, num_experts, topk)
+
+    def set_fwd(self, mode: str):
+        self.fwd_mode = mode
+
+    # -- params ------------------------------------------------------------
+    def init(self, key: jax.Array) -> dict:
+        kr, kg, ku, kd = jax.random.split(key, 4)
+        h, i, e = self.hidden_size, self.intermediate_size, self.num_experts
+        params = {
+            "w_router": jax.random.normal(kr, (h, e), jnp.float32) * h**-0.5,
+            "w_gate": jax.random.normal(kg, (e, h, i), self.dtype) * h**-0.5,
+            "w_up": jax.random.normal(ku, (e, h, i), self.dtype) * h**-0.5,
+            "w_down": jax.random.normal(kd, (e, i, h), self.dtype) * i**-0.5,
+        }
+        return self.shard_params(params)
+
+    def shard_params(self, params: dict) -> dict:
+        m, ax = self.mesh, self.axis
+        return {
+            "w_router": shard_param(params["w_router"], m, P()),
+            "w_gate": shard_param(params["w_gate"], m, P(None, None, ax)),
+            "w_up": shard_param(params["w_up"], m, P(None, None, ax)),
+            "w_down": shard_param(params["w_down"], m, P(None, ax, None)),
+        }
+
+    # -- forward -----------------------------------------------------------
+    def __call__(self, params: dict, x: jax.Array,
+                 mode: str | None = None) -> jax.Array:
+        """x: (M, H) row-sharded over the TP axis; returns the same layout."""
+        mode = mode or self.fwd_mode
+        if mode not in ("ag_rs", "xla"):
+            raise ValueError(f"unknown fwd mode {mode!r}")
+        m, h = x.shape
+        k = self.topk
+
+        # Router runs on local rows (replicated weights — reference computes
+        # routing before the AG too, tp_moe.py).
+        logits = x.astype(jnp.float32) @ params["w_router"]
+        weights, indices = topk_routing(logits, k, self.norm_topk_prob)
+
+        impl = "xla" if mode == "xla" else self.impl
+        # Fused/collective all-gather of tokens and routing ids.
+        ag_x = all_gather(x, self.ag_ctx, impl=impl)
+        ag_idx = self._ag_meta(indices)
+        ag_w = self._ag_meta(weights)
+
+        # Pair expansion: one row per (token, expert) pair.
+        pair_ids = ag_idx.reshape(-1)                       # (M_g*k,)
+        pair_x = jnp.repeat(ag_x, k, axis=0)                # (M_g*k, H)
+
+        gate = grouped_matmul(pair_x, params["w_gate"], pair_ids,
+                              self.num_experts)
+        up = grouped_matmul(pair_x, params["w_up"], pair_ids,
+                            self.num_experts)
+        act = (jax.nn.silu(gate.astype(jnp.float32)) *
+               up.astype(jnp.float32)).astype(x.dtype)
+
+        rs_impl = "xla" if mode == "xla" else "ring"
+        return moe_reduce_rs(act, params["w_down"], pair_ids, ag_w,
+                             self.rs_ctx, impl=rs_impl)
+
+    def _ag_meta(self, arr: jax.Array) -> jax.Array:
+        """All-gather small routing metadata (XLA collective)."""
+        axis = self.axis
+
+        def body(a):
+            return lax.all_gather(a, axis, tiled=True)
+        return jax.shard_map(body, mesh=self.mesh, in_specs=P(axis),
+                             out_specs=P(), check_vma=False)(arr)

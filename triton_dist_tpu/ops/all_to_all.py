@@ -1,0 +1,230 @@
+"""Low-latency AllToAll for expert-parallel dispatch/combine.
+
+TPU-native redesign of the reference's LL AllToAll
+(python/triton_dist/kernels/nvidia/low_latency_all_to_all.py: single kernel
+doing per-peer ``putmem_nbi_block`` of tokens + splits with
+``putmem_signal`` / ``signal_wait_until`` :36-120, context + host entry
+``fast_all_to_all`` :127-258) and the train-style dispatch/combine
+(ep_a2a.py:37-244).
+
+Data model (static shapes — SURVEY.md §7 "Dynamic shapes in EP"): each
+device holds a rank-major send buffer ``(world, capacity, H)`` where slab
+``p`` carries the ``send_counts[p]`` rows destined for rank ``p``. The
+exchange transposes slabs: after the op, recv slab ``j`` holds the rows
+rank ``j`` sent here.
+
+The Pallas path sends each slab in row chunks and only transmits the
+chunks that contain live rows — the TPU analog of the reference sending
+exactly ``splits[expert]`` tokens per peer rather than the whole MAX_M
+buffer. Chunk arrival is signalled per (src, chunk) DMA semaphore
+(putmem_signal ≙ remote copy's recv semaphore). Counts are exchanged
+first via a (tiny) XLA all-to-all — the analog of the reference's splits
+pre-exchange (`get_ag_splits_and_recv_offset_for_dispatch`,
+ep_a2a.py:244).
+
+The reference double-buffers by call parity (low_latency_all_to_all.py:
+140-143) because its symmetric buffers persist across calls; on TPU each
+``pallas_call`` owns its buffers and semaphores start/finish at zero, so
+the parity protocol collapses — documented design decision.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+import triton_dist_tpu.language as dl
+from triton_dist_tpu.ops.common import cdiv, comm_params, resolve_interpret
+
+
+def _default_chunk_rows(capacity: int) -> int:
+    """Largest divisor of ``capacity`` that is ≤128 and sublane-aligned
+    (8). Falls back to the full slab when capacity is small/odd."""
+    for c in (128, 64, 32, 16, 8):
+        if capacity % c == 0:
+            return c
+    return capacity
+
+
+@dataclasses.dataclass
+class AllToAllContext:
+    """Analog of the reference's ``create_all_to_all_context``
+    (low_latency_all_to_all.py:127): capacity and chunking config; the
+    symmetric send/recv buffers and signal arrays live in the kernel."""
+    mesh: Mesh
+    axis: str = "ep"
+    capacity: int = 128          # max rows per (src, dst) pair
+    chunk_rows: int | None = None
+    interpret: bool | None = None
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    def resolve_chunk(self) -> int:
+        return self.chunk_rows or _default_chunk_rows(self.capacity)
+
+
+def create_all_to_all_context(mesh: Mesh | None = None, axis: str = "ep",
+                              capacity: int = 128,
+                              chunk_rows: int | None = None,
+                              interpret: bool | None = None
+                              ) -> AllToAllContext:
+    if mesh is None:
+        from triton_dist_tpu.runtime.dist import get_mesh
+        mesh = get_mesh()
+    return AllToAllContext(mesh=mesh, axis=axis, capacity=capacity,
+                           chunk_rows=chunk_rows, interpret=interpret)
+
+
+def _a2a_kernel(send_counts_ref, recv_counts_ref, send_ref, recv_ref,
+                send_sem, recv_sem, *, axis: str, world: int, capacity: int,
+                chunk: int):
+    """Per-device body: push live chunks of each slab to its peer.
+
+    Per peer p: ``n = cdiv(send_counts[p], chunk)`` chunk DMAs
+    ``send[p, c*chunk : (c+1)*chunk] → peer_p.recv[me, ...]`` (reference
+    ``putmem_nbi_block`` per expert range, low_latency_all_to_all.py:52-99).
+    Then wait ``cdiv(recv_counts[j], chunk)`` arrivals per source j
+    (reference ``signal_wait_until`` :108-118). Per-(slab, chunk)
+    semaphore slots — no FIFO assumption across chunks.
+    """
+    me = lax.axis_index(axis)
+    n_chunks = capacity // chunk
+
+    # Self slab: plain VMEM copy, no DMA (reference skips rank==me too).
+    recv_ref[me] = send_ref[me]
+    if world == 1:
+        return
+    # Peers' recv buffers must exist before remote writes land.
+    dl.barrier_all(axis)
+
+    def chunk_copy(p, c):
+        # dst slab on peer p is indexed by *our* rank; semaphore slot
+        # (me→slab, c) on the receiver.
+        return dl.remote_copy(
+            send_ref.at[p, pl.ds(c * chunk, chunk), :],
+            recv_ref.at[me, pl.ds(c * chunk, chunk), :],
+            p, send_sem.at[p, c], recv_sem.at[me, c], axis=axis)
+
+    def send_to(i, _):
+        p = lax.rem(me + i, world)
+        live = cdiv_dyn(send_counts_ref[p], chunk)
+
+        def one(c, _):
+            @pl.when(c < live)
+            def _():
+                chunk_copy(p, c).start()
+            return _
+        lax.fori_loop(0, n_chunks, one, None)
+        return _
+
+    def cdiv_dyn(a, b):
+        return lax.div(a + (b - 1), b)
+
+    lax.fori_loop(1, world, send_to, None)
+
+    def wait_from(i, _):
+        j = lax.rem(me - i + world, world)
+        live = cdiv_dyn(recv_counts_ref[j], chunk)
+
+        def one(c, _):
+            @pl.when(c < live)
+            def _():
+                # Mirror descriptor for the incoming DMA from j.
+                dl.remote_copy(
+                    send_ref.at[j, pl.ds(c * chunk, chunk), :],
+                    recv_ref.at[j, pl.ds(c * chunk, chunk), :],
+                    me, send_sem.at[j, c], recv_sem.at[j, c],
+                    axis=axis).wait_recv()
+            return _
+        lax.fori_loop(0, n_chunks, one, None)
+        return _
+
+    lax.fori_loop(1, world, wait_from, None)
+
+    def drain(i, _):
+        p = lax.rem(me + i, world)
+        live = cdiv_dyn(send_counts_ref[p], chunk)
+
+        def one(c, _):
+            @pl.when(c < live)
+            def _():
+                chunk_copy(p, c).wait_send()
+            return _
+        lax.fori_loop(0, n_chunks, one, None)
+        return _
+
+    lax.fori_loop(1, world, drain, None)
+
+
+def fast_all_to_all(send_buf: jax.Array, send_counts: jax.Array,
+                    ctx: AllToAllContext | None = None,
+                    impl: str = "pallas"):
+    """Exchange rank-major slabs (functional entry, reference
+    ``fast_all_to_all`` low_latency_all_to_all.py:198).
+
+    Args:
+      send_buf: (world, capacity, H) per device — slab p goes to rank p.
+        Sharded as the *local* buffer of each device (global shape
+        (world*world, capacity, H) with leading dim sharded).
+      send_counts: (world,) int32 per device (global (world*world,)).
+
+    Returns:
+      (recv_buf, recv_counts) with the same layouts; recv slab j came from
+      rank j. Rows past ``recv_counts[j]`` in a slab are undefined (the
+      reference leaves stale data there too — consumers mask by splits).
+    """
+    ctx = ctx or create_all_to_all_context()
+    mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    capacity = ctx.capacity
+    chunk = ctx.resolve_chunk()
+    assert capacity % chunk == 0
+    assert send_buf.shape[0] == world * world and send_buf.shape[1] == capacity
+
+    if impl == "xla" or world == 1:
+        def body(buf, counts):
+            rb = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
+                                tiled=True)
+            rc = lax.all_to_all(counts, axis, split_axis=0, concat_axis=0,
+                                tiled=True)
+            return rb, rc
+        f = jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P(axis)),
+                          out_specs=(P(axis), P(axis)), check_vma=False)
+        return f(send_buf, send_counts)
+
+    interpret = resolve_interpret(ctx.interpret)
+    kernel = functools.partial(_a2a_kernel, axis=axis, world=world,
+                               capacity=capacity, chunk=chunk)
+    n_chunks = capacity // chunk
+
+    def body(buf, counts, rcounts):
+        recv = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(buf.shape, buf.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((world, n_chunks)),
+                            pltpu.SemaphoreType.DMA((world, n_chunks))],
+            compiler_params=comm_params(collective_id=6, world=world),
+            interpret=interpret,
+        )(counts, rcounts, buf)
+        return recv
+
+    def outer(buf, counts):
+        rcounts = lax.all_to_all(counts, axis, split_axis=0, concat_axis=0,
+                                 tiled=True)
+        return body(buf, counts, rcounts), rcounts
+
+    f = jax.shard_map(outer, mesh=mesh, in_specs=(P(axis), P(axis)),
+                      out_specs=(P(axis), P(axis)), check_vma=False)
+    return f(send_buf, send_counts)

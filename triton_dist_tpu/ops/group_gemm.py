@@ -1,0 +1,155 @@
+"""Grouped (per-expert) GEMM building blocks for MoE.
+
+TPU-native redesign of the reference's AG-MoE grouped GEMM
+(python/triton_dist/kernels/nvidia/allgather_group_gemm.py:608
+``ag_group_gemm``: AllGather + group GEMM whose tile schedule follows the
+token→expert alignment from csrc/lib/moe_utils.cu:61) and the expert
+compute inside MoE-RS (moe_reduce_rs.py:167 gather-grouped GEMM producer).
+
+On TPU the token→block alignment machinery collapses into
+``jax.lax.ragged_dot``: tokens sorted by expert + ``group_sizes`` is the
+native grouped-GEMM form XLA tiles onto the MXU (see ops/moe_utils.py
+``sort_by_group``). What remains of the reference's design is the
+*overlap*: the ring variant interleaves ``ppermute`` hops of the token
+shards with per-chunk ragged dots so ICI transfers ride under MXU work —
+the collective-matmul schedule XLA's latency-hiding scheduler can overlap
+(the analog of the reference's producer-AG + consumer-group-GEMM split).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.ops.moe_utils import sort_by_group
+
+
+def grouped_matmul(tokens: jax.Array, w: jax.Array, expert_ids: jax.Array,
+                   num_experts: int, acc_dtype=jnp.float32) -> jax.Array:
+    """out[i] = tokens[i] @ w[expert_ids[i]] with static shapes.
+
+    Sort-by-expert + ``ragged_dot`` + unsort (the whole
+    ``moe_ag_scatter_align_block_size`` pipeline in three ops). Rows with
+    ``expert_ids == num_experts`` (invalid/padding) produce garbage rows
+    that callers must mask — they are routed through group 0 weights.
+    """
+    sorted_tokens, group_sizes, unsort = sort_by_group(
+        tokens, expert_ids, num_experts)
+    # ragged_dot requires sum(group_sizes) == rows; padding rows (sentinel
+    # group) sit past the last real group and read as group 0 — masked by
+    # callers via `valid`.
+    pad = tokens.shape[0] - jnp.sum(group_sizes)
+    group_sizes = group_sizes.at[num_experts - 1].add(pad)
+    out = lax.ragged_dot(
+        sorted_tokens, w, group_sizes,
+        preferred_element_type=acc_dtype).astype(tokens.dtype)
+    return out[unsort]
+
+
+def grouped_expert_ffn(tokens: jax.Array, w_gate: jax.Array, w_up: jax.Array,
+                       w_down: jax.Array, expert_ids: jax.Array,
+                       num_experts: int) -> jax.Array:
+    """Per-expert SwiGLU FFN over a flat token list (the expert compute of
+    Qwen3-MoE, reference models/qwen_moe.py:50-108).
+
+    w_gate/w_up: (E, H, I), w_down: (E, I, H); expert_ids: (T,) int32 with
+    ``num_experts`` as the invalid sentinel.
+    """
+    sorted_tokens, group_sizes, unsort = sort_by_group(
+        tokens, expert_ids, num_experts)
+    pad = tokens.shape[0] - jnp.sum(group_sizes)
+    group_sizes = group_sizes.at[num_experts - 1].add(pad)
+    gate = lax.ragged_dot(sorted_tokens, w_gate, group_sizes,
+                          preferred_element_type=jnp.float32)
+    up = lax.ragged_dot(sorted_tokens, w_up, group_sizes,
+                        preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(tokens.dtype)
+    down = lax.ragged_dot(act, w_down, group_sizes,
+                          preferred_element_type=jnp.float32)
+    return down.astype(tokens.dtype)[unsort]
+
+
+@dataclasses.dataclass
+class AGGroupGEMMContext:
+    """Analog of ``create_ag_group_gemm_context``
+    (allgather_group_gemm.py): mesh/axis + schedule choice."""
+    mesh: Mesh
+    axis: str = "tp"
+    ring: bool = True   # ring-overlap schedule vs one-shot AG
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_ag_group_gemm_context(mesh: Mesh | None = None, axis: str = "tp",
+                                 ring: bool = True) -> AGGroupGEMMContext:
+    if mesh is None:
+        from triton_dist_tpu.runtime.dist import get_mesh
+        mesh = get_mesh()
+    return AGGroupGEMMContext(mesh=mesh, axis=axis, ring=ring)
+
+
+def ag_group_gemm(x: jax.Array, w: jax.Array, expert_ids: jax.Array,
+                  num_experts: int, ctx: AGGroupGEMMContext | None = None,
+                  impl: str = "ring") -> jax.Array:
+    """C = group_gemm(allgather(x), w) — TP-MoE first projection
+    (reference ``ag_group_gemm`` allgather_group_gemm.py:608).
+
+    Args:
+      x: (M, K) row-sharded over ``ctx.axis``; one expert id per row.
+      w: (E, K, N) with N column-sharded over ``ctx.axis``.
+      expert_ids: (M,) int32 row→expert, row-sharded like x.
+    Returns:
+      (M, N/world) per device — full gathered M rows against the local
+      N-shard, column-sharded overall.
+
+    ``impl="ring"``: w-1 ``ppermute`` hops; chunk s's ragged dot runs
+    while chunk s+1 is in flight (collective matmul — the overlap the
+    reference gets from its producer/consumer split).
+    ``impl="xla"``: one-shot all-gather golden.
+    """
+    ctx = ctx or create_ag_group_gemm_context()
+    mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    m, k = x.shape
+    assert w.ndim == 3 and w.shape[1] == k
+
+    def oneshot(xs, ids, ws):
+        ag = lax.all_gather(xs, axis, tiled=True)
+        ag_ids = lax.all_gather(ids, axis, tiled=True)
+        return grouped_matmul(ag, ws, ag_ids, num_experts)
+
+    def ring(xs, ids, ws):
+        me = lax.axis_index(axis)
+        rows = xs.shape[0]
+        out = jnp.zeros((rows * world, ws.shape[-1]), xs.dtype)
+
+        def step(s, carry):
+            out, cur_x, cur_ids = carry
+            src = lax.rem(me - s + world, world)
+            # Launch the next hop first so XLA can overlap it with the dot.
+            perm = [(i, (i + 1) % world) for i in range(world)]
+            nxt_x = lax.ppermute(cur_x, axis, perm)
+            nxt_ids = lax.ppermute(cur_ids, axis, perm)
+            chunk_out = grouped_matmul(cur_x, ws, cur_ids, num_experts)
+            out = lax.dynamic_update_slice(out, chunk_out,
+                                           (src * rows, jnp.int32(0)))
+            return out, nxt_x, nxt_ids
+
+        out, last_x, last_ids = lax.fori_loop(
+            0, world - 1, step, (out, xs, ids))
+        src = lax.rem(me - (world - 1) + world, world)
+        chunk_out = grouped_matmul(last_x, ws, last_ids, num_experts)
+        out = lax.dynamic_update_slice(out, chunk_out,
+                                       (src * rows, jnp.int32(0)))
+        return out
+
+    body = oneshot if (impl == "xla" or world == 1) else ring
+    f = jax.shard_map(body, mesh=mesh,
+                      in_specs=(P(axis), P(axis), P(None, None, axis)),
+                      out_specs=P(None, axis), check_vma=False)
+    return f(x, expert_ids, w)

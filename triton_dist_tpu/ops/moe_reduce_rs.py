@@ -1,0 +1,122 @@
+"""Fused MoE second-projection + topk-reduce + ReduceScatter.
+
+TPU-native redesign of the reference's MoE-RS
+(python/triton_dist/kernels/nvidia/moe_reduce_rs.py: grouped GEMM producer
+gathering rows by top-k assignment :167, topk-reduce kernels :293/:380,
+dispatcher ``moe_reduce_rs`` :546).
+
+Math: per device, activations ``act`` (T*topk, I/w) hold one row per
+(token, k) pair against the local intermediate shard; ``w_down``
+(E, I/w, H). The op computes the per-pair down-projection (grouped GEMM),
+reduces over top-k with routing weights, and reduce-scatters the
+rank-partial sums so each device ends with its T/w token rows.
+
+``impl="ring"`` is the overlapped schedule: the ring reduce-scatter is
+interleaved with per-row-block grouped dots — block c's MXU work happens
+at the step its accumulator passes through this rank, so every ICI hop
+rides under compute (the reference's producer GEMM + ring-reduce consumer
+split, moe_reduce_rs.py:380-546, re-expressed as a collective matmul).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.ops.group_gemm import grouped_matmul
+from triton_dist_tpu.ops.moe_utils import topk_reduce
+
+
+@dataclasses.dataclass
+class MoEReduceRSContext:
+    """Analog of ``create_moe_rs_context`` (moe_reduce_rs.py): mesh/axis +
+    topology; workspaces collapse into the traced program."""
+    mesh: Mesh
+    axis: str = "tp"
+    num_experts: int = 8
+    topk: int = 2
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_moe_rs_context(mesh: Mesh | None = None, axis: str = "tp",
+                          num_experts: int = 8, topk: int = 2
+                          ) -> MoEReduceRSContext:
+    if mesh is None:
+        from triton_dist_tpu.runtime.dist import get_mesh
+        mesh = get_mesh()
+    return MoEReduceRSContext(mesh=mesh, axis=axis, num_experts=num_experts,
+                              topk=topk)
+
+
+def moe_reduce_rs(act: jax.Array, w_down: jax.Array, expert_ids: jax.Array,
+                  weights: jax.Array, ctx: MoEReduceRSContext,
+                  impl: str = "ring") -> jax.Array:
+    """out = reduce_scatter( topk_reduce( grouped_gemm(act, w_down) ) ).
+
+    Args:
+      act: (T*topk, I) with I sharded over ``ctx.axis`` (each device holds
+        its I/w slice of every pair row).
+      w_down: (E, I, H), I sharded the same way.
+      expert_ids: (T*topk,) int32, replicated.
+      weights: (T, topk) routing weights, replicated.
+    Returns:
+      (T/w, H) row-sharded token outputs (reference ``moe_reduce_rs``
+      :546 returns the same layout).
+    """
+    mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    tk = act.shape[0]
+    t, topk = weights.shape
+    assert tk == t * topk
+    assert t % world == 0
+    rows = t // world
+    n_exp = ctx.num_experts
+
+    def pair_down(a_shard, wd, ids):
+        """(T*topk, I/w) → per-token rank-partial (T, H)."""
+        partial = grouped_matmul(a_shard, wd, ids, n_exp)
+        return topk_reduce(partial.reshape(t, topk, -1), weights)
+
+    def oneshot(a_shard, wd, ids, wts):
+        del wts
+        tok = pair_down(a_shard, wd, ids)
+        return lax.psum_scatter(tok, axis, scatter_dimension=0, tiled=True)
+
+    def ring(a_shard, wd, ids, wts):
+        me = lax.axis_index(axis)
+        h = wd.shape[-1]
+        perm = [(i, (i + 1) % world) for i in range(world)]
+
+        def block_partial(c):
+            """Rank-partial down-proj of token row block c ((T/w, H))."""
+            sl_act = lax.dynamic_slice_in_dim(
+                a_shard.reshape(t, topk, -1), c * rows, rows, 0
+            ).reshape(rows * topk, -1)
+            sl_ids = lax.dynamic_slice_in_dim(
+                ids.reshape(t, topk), c * rows, rows, 0).reshape(-1)
+            sl_w = lax.dynamic_slice_in_dim(wts, c * rows, rows, 0)
+            part = grouped_matmul(sl_act, wd, sl_ids, n_exp)
+            return topk_reduce(part.reshape(rows, topk, h), sl_w)
+
+        def step(s, acc):
+            c = lax.rem(me + world - 1 - s, world)
+            nxt = lax.ppermute(acc, axis, perm)  # overlaps the dots below
+            mine = block_partial(c).astype(jnp.float32)
+            return jnp.where(s == 0, mine, nxt + mine)
+
+        acc = lax.fori_loop(0, world, step,
+                            jnp.zeros((rows, h), jnp.float32))
+        return acc.astype(act.dtype)
+
+    body = oneshot if (impl == "xla" or world == 1) else ring
+    f = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis, None), P(), P()),
+        out_specs=P(axis), check_vma=False)
+    return f(act, w_down, expert_ids, weights)
