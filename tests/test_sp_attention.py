@@ -1,0 +1,96 @@
+"""SP attention tests: distributed flash-decode (split-KV + cross-rank
+combine) and sequence-parallel prefill attention (AG-KV and ring),
+vs a full-attention golden.
+
+Mirrors the reference's test_sp_decode_attn.py /
+test_sp_ag_attention_{intra,inter}_node.py (SURVEY.md §4) on the
+single-process 8-device mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.ops.flash_decode import (
+    create_flash_decode_context, gqa_fwd_batch_decode)
+from triton_dist_tpu.ops.sp_attention import (
+    create_sp_attention_context, sp_ag_attention, zigzag_reorder,
+    zigzag_restore)
+
+
+def attention_golden(q, k, v, causal, q_offset=0):
+    """Brute-force fp32 GQA attention. q: (B, Sq, Hq, D), k/v: (B, T, Hkv, D).
+    Query i is at absolute position q_offset + i."""
+    b, sq, hq, d = q.shape
+    t, hkv = k.shape[1], k.shape[2]
+    g = hq // hkv
+    qf = np.asarray(q, np.float32).reshape(b, sq, hkv, g, d)
+    kf = np.asarray(k, np.float32)
+    vf = np.asarray(v, np.float32)
+    scores = np.einsum("bskgd,btkd->bkgst", qf, kf) / np.sqrt(d)
+    if causal:
+        mask = (q_offset + np.arange(sq))[:, None] >= np.arange(t)[None, :]
+        scores = np.where(mask[None, None, None], scores, -np.inf)
+    scores -= scores.max(-1, keepdims=True)
+    p = np.exp(scores)
+    p /= p.sum(-1, keepdims=True)
+    out = np.einsum("bkgst,btkd->bskgd", p, vf)
+    return out.reshape(b, sq, hq, d)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_flash_decode(mesh8, impl, key):
+    b, hq, hkv, d, t = 2, 8, 4, 32, 64
+    kv_len = 41  # partial cache: spans rank 0..5 of the 8-way shard
+    q = jax.random.normal(key, (b, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, t, hkv, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, t, hkv, d), jnp.float32)
+    ctx = create_flash_decode_context(mesh8, "tp")
+    ks = jax.device_put(k, NamedSharding(mesh8, P(None, "tp")))
+    vs = jax.device_put(v, NamedSharding(mesh8, P(None, "tp")))
+    out = gqa_fwd_batch_decode(q, ks, vs, jnp.int32(kv_len), ctx, impl=impl)
+    ref = attention_golden(q[:, None], k[:, :kv_len], v[:, :kv_len],
+                           causal=False)[:, 0]
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_decode_single_rank_kv(mesh8, key):
+    """kv_len entirely inside rank 0's shard — other ranks contribute 0."""
+    b, hq, hkv, d, t = 1, 4, 2, 16, 64
+    kv_len = 5
+    q = jax.random.normal(key, (b, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(3), (b, t, hkv, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(4), (b, t, hkv, d), jnp.float32)
+    ctx = create_flash_decode_context(mesh8, "tp")
+    ks = jax.device_put(k, NamedSharding(mesh8, P(None, "tp")))
+    vs = jax.device_put(v, NamedSharding(mesh8, P(None, "tp")))
+    out = gqa_fwd_batch_decode(q, ks, vs, jnp.int32(kv_len), ctx,
+                               impl="xla")
+    ref = attention_golden(q[:, None], k[:, :kv_len], v[:, :kv_len],
+                           causal=False)[:, 0]
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("impl", ["xla", "ring", "pallas"])
+@pytest.mark.parametrize("causal", [True, False])
+def test_sp_prefill_attention(mesh8, impl, causal, key):
+    b, s, hq, hkv, d = 2, 64, 4, 2, 16
+    q = jax.random.normal(key, (b, s, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(5), (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(6), (b, s, hkv, d), jnp.float32)
+    ctx = create_sp_attention_context(mesh8, "tp", causal=causal)
+    sh = NamedSharding(mesh8, P(None, "tp"))
+    out = sp_ag_attention(jax.device_put(q, sh), jax.device_put(k, sh),
+                          jax.device_put(v, sh), ctx, impl=impl)
+    ref = attention_golden(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=3e-4, atol=3e-4)
+
+
+def test_zigzag_roundtrip(key):
+    x = jax.random.normal(key, (2, 32, 3), jnp.float32)
+    z = zigzag_reorder(x, world=4)
+    r = zigzag_restore(z, world=4)
+    np.testing.assert_array_equal(np.asarray(r), np.asarray(x))
+    assert not np.array_equal(np.asarray(z), np.asarray(x))

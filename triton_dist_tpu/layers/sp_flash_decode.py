@@ -1,0 +1,81 @@
+"""Sequence-parallel attention layers.
+
+TPU-native analogs of the reference's ``SpFlashDecodeLayer``
+(python/triton_dist/layers/nvidia/sp_flash_decode_layer.py: binds the
+flash-decode context + kernels to a module API over a sequence-sharded KV
+cache) and the SP prefill wrapper around the AG-attention kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.ops.flash_decode import (
+    create_flash_decode_context, gqa_fwd_batch_decode)
+from triton_dist_tpu.ops.sp_attention import (
+    create_sp_attention_context, sp_ag_attention)
+
+
+class SpFlashDecodeLayer:
+    """Decode attention over a sequence-sharded KV cache.
+
+    Owns the cache layout: (B, T, Hkv, D) with T sharded over the SP axis.
+    ``append`` writes the new token's K/V at the decode offset (the write
+    lands on the one shard owning that position); ``__call__`` runs the
+    distributed flash-decode.
+    """
+
+    def __init__(self, batch: int, max_seq: int, num_kv_heads: int,
+                 head_dim: int, mesh: Mesh | None = None, axis: str = "sp",
+                 dtype=jnp.bfloat16, impl: str = "pallas"):
+        if mesh is None:
+            from triton_dist_tpu.runtime.dist import get_mesh
+            mesh = get_mesh()
+        self.mesh, self.axis = mesh, axis
+        world = mesh.shape[axis]
+        assert max_seq % world == 0
+        self.batch, self.max_seq = batch, max_seq
+        self.num_kv_heads, self.head_dim = num_kv_heads, head_dim
+        self.dtype = dtype
+        self.impl = impl
+        self.ctx = create_flash_decode_context(mesh, axis)
+        self._kv_sharding = NamedSharding(mesh, P(None, axis))
+
+    def init_cache(self):
+        shape = (self.batch, self.max_seq, self.num_kv_heads, self.head_dim)
+        z = jnp.zeros(shape, self.dtype)
+        return (jax.device_put(z, self._kv_sharding),
+                jax.device_put(z, self._kv_sharding))
+
+    def append(self, kv_cache, k_new: jax.Array, v_new: jax.Array,
+               offset: jax.Array):
+        """Write (B, 1, Hkv, D) new entries at ``offset``. XLA turns the
+        dynamic-update-slice into a write on the owning shard."""
+        ck, cv = kv_cache
+        off = jnp.asarray(offset, jnp.int32)
+        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype),
+                                          (0, off, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype),
+                                          (0, off, 0, 0))
+        return ck, cv
+
+    def __call__(self, q: jax.Array, kv_cache, kv_len) -> jax.Array:
+        """q: (B, Hq, D) replicated; returns (B, Hq, D)."""
+        ck, cv = kv_cache
+        return gqa_fwd_batch_decode(q, ck, cv, kv_len, self.ctx,
+                                    impl=self.impl)
+
+
+class SpAttentionLayer:
+    """Prefill SP attention wrapper (ring / AG-KV), sequence-sharded IO."""
+
+    def __init__(self, mesh: Mesh | None = None, axis: str = "sp",
+                 causal: bool = True, impl: str = "ring"):
+        self.ctx = create_sp_attention_context(mesh, axis, causal=causal)
+        self.impl = impl
+
+    def __call__(self, q: jax.Array, k: jax.Array, v: jax.Array
+                 ) -> jax.Array:
+        return sp_ag_attention(q, k, v, self.ctx, impl=self.impl)

@@ -1,0 +1,220 @@
+"""Distributed flash-decode: split-KV GQA decode with cross-rank
+partial-softmax combine.
+
+TPU-native redesign of the reference's distributed flash-decode
+(python/triton_dist/kernels/nvidia/flash_decode.py: split-KV batch decode
+kernels :130-393, intra-rank combine :393-482, **inter-rank combine**
+merging (m, l, acc) partial softmax states through symmetric buffers
+:482-566; host wrappers :763-1130; scaling claim 1→32 GPUs README.md:203).
+
+Design: the KV cache is sequence-sharded over the SP axis. Each device
+computes an *unnormalized* flash partial over its shard:
+
+    m = max_t s_t,   l = Σ_t e^{s_t - m},   a = Σ_t e^{s_t - m} v_t
+
+and the cross-rank combine is the associative log-sum-exp merge
+
+    out = Σ_r a_r e^{m_r - m*} / Σ_r l_r e^{m_r - m*},  m* = max_r m_r.
+
+``impl="xla"``: partials via one batched einsum; merge via ``pmax`` +
+``psum`` (3 scalar-sized collectives — the reference needs a second
+kernel + symmetric buffers for the same merge).
+``impl="pallas"``: one kernel per device — computes its partial, pushes
+(a, l, m) to every peer by remote DMA (the symmetric-buffer exchange,
+flash_decode.py:482-566), waits, and merges locally.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+import triton_dist_tpu.language as dl
+from triton_dist_tpu.ops.common import comm_params, resolve_interpret
+
+_NEG = -1e30
+
+
+@dataclasses.dataclass
+class FlashDecodeContext:
+    """Analog of the reference's flash-decode context/workspace
+    (flash_decode.py:763-850): axis + combine buffers (kernel-owned)."""
+    mesh: Mesh
+    axis: str = "sp"
+    interpret: bool | None = None
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_flash_decode_context(mesh: Mesh | None = None, axis: str = "sp",
+                                interpret: bool | None = None
+                                ) -> FlashDecodeContext:
+    if mesh is None:
+        from triton_dist_tpu.runtime.dist import get_mesh
+        mesh = get_mesh()
+    return FlashDecodeContext(mesh=mesh, axis=axis, interpret=interpret)
+
+
+def _local_partials(q, k, v, first_pos, kv_len, groups: int):
+    """Unnormalized flash partial over one KV shard.
+
+    q: (B, Hq, D); k/v: (B, T, Hkv, D); positions of the shard are
+    ``first_pos + [0, T)``; only positions < ``kv_len`` are live.
+    Returns a (B, K, G, D), l (B, K, G), m (B, K, G) in fp32.
+    """
+    b, hq, d = q.shape
+    t, hkv = k.shape[1], k.shape[2]
+    qg = q.reshape(b, hkv, groups, d).astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    scores = jnp.einsum("bkgd,btkd->bkgt", qg, kf) * (d ** -0.5)
+    live = (first_pos + jnp.arange(t)) < kv_len              # (T,)
+    scores = jnp.where(live[None, None, None, :], scores, _NEG)
+    m = jnp.max(scores, axis=-1)
+    p = jnp.exp(scores - m[..., None]) * live[None, None, None, :]
+    l = jnp.sum(p, axis=-1)
+    a = jnp.einsum("bkgt,btkd->bkgd", p, v.astype(jnp.float32))
+    return a, l, m
+
+
+def _merge(a, l, m):
+    """Merge per-rank partials stacked on the leading axis (w, B, K, G, ...)."""
+    m_star = jnp.max(m, axis=0, keepdims=True)
+    scale = jnp.exp(m - m_star)
+    num = jnp.sum(a * scale[..., None], axis=0)
+    den = jnp.sum(l * scale, axis=0)
+    return num / jnp.maximum(den, 1e-20)[..., None]
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, abuf, lbuf, mbuf,
+                   send_sem, recv_sem, *, axis: str, world: int,
+                   groups: int, t_loc: int):
+    """Single-program distributed decode: local partial → full-mesh push of
+    (a, l, m) into per-rank slots of the combine buffers → wait → merge.
+
+    The combine buffers are the analog of the reference's symmetric
+    reduce buffers (flash_decode.py:482-566); `abuf[r]` holds rank r's
+    partial after the exchange.
+    """
+    me = lax.axis_index(axis)
+    kv_len = len_ref[0]
+    a, l, m = _local_partials(q_ref[:], k_ref[:], v_ref[:],
+                              me * t_loc, kv_len, groups)
+    abuf[me] = a
+    lbuf[me] = l
+    mbuf[me] = m
+    if world > 1:
+        # Peers' buffers must exist before remote writes land.
+        dl.barrier_all(axis)
+
+        def copies(p):
+            peer = lax.rem(me + p, world)
+            return [dl.remote_copy(ref.at[me], ref.at[me], peer,
+                                   send_sem.at[peer, i], recv_sem.at[me, i],
+                                   axis=axis)
+                    for i, ref in enumerate((abuf, lbuf, mbuf))]
+
+        def send(p, _):
+            for c in copies(p):
+                c.start()
+            return _
+        lax.fori_loop(1, world, send, None)
+
+        def wait(p, _):
+            src = lax.rem(me - p + world, world)
+            for i, ref in enumerate((abuf, lbuf, mbuf)):
+                dl.remote_copy(ref.at[src], ref.at[src], me,
+                               send_sem.at[src, i], recv_sem.at[src, i],
+                               axis=axis).wait_recv()
+            return _
+        lax.fori_loop(1, world, wait, None)
+
+        def drain(p, _):
+            for c in copies(p):
+                c.wait_send()
+            return _
+        lax.fori_loop(1, world, drain, None)
+
+    out = _merge(abuf[:], lbuf[:], mbuf[:])
+    b = q_ref.shape[0]
+    o_ref[:] = out.reshape(b, -1, out.shape[-1]).astype(o_ref.dtype)
+
+
+def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
+                         cache_v: jax.Array, kv_len: jax.Array,
+                         ctx: FlashDecodeContext | None = None,
+                         impl: str = "pallas") -> jax.Array:
+    """Decode-time GQA over a sequence-sharded KV cache (functional entry,
+    reference ``gqa_fwd_batch_decode`` flash_decode.py:763).
+
+    Args:
+      q: (B, Hq, D) current-step queries, replicated over the SP axis.
+      cache_k/cache_v: (B, T, Hkv, D) with T sequence-sharded over
+        ``ctx.axis`` (each device holds T/w positions).
+      kv_len: scalar int32 — number of live positions (decode offset + 1).
+    Returns:
+      (B, Hq, D) attention outputs, replicated.
+    """
+    ctx = ctx or create_flash_decode_context()
+    mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    b, hq, d = q.shape
+    t, hkv = cache_k.shape[1], cache_k.shape[2]
+    assert t % world == 0
+    t_loc = t // world
+    groups = hq // hkv
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+
+    if impl == "xla" or world == 1:
+        def body(qs, ks, vs, n):
+            me = lax.axis_index(axis)
+            a, l, m = _local_partials(qs, ks, vs, me * t_loc, n[0], groups)
+            m_star = lax.pmax(m, axis)
+            scale = jnp.exp(m - m_star)
+            num = lax.psum(a * scale[..., None], axis)
+            den = lax.psum(l * scale, axis)
+            out = num / jnp.maximum(den, 1e-20)[..., None]
+            return out.reshape(b, hq, d).astype(qs.dtype)
+
+        f = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(None, axis), P(None, axis), P()),
+            out_specs=P(), check_vma=False)
+        return f(q, cache_k, cache_v, kv_len.reshape(1))
+
+    interpret = resolve_interpret(ctx.interpret)
+    kernel = functools.partial(_decode_kernel, axis=axis, world=world,
+                               groups=groups, t_loc=t_loc)
+
+    def body(qs, ks, vs, n):
+        out, *_ = pl.pallas_call(
+            kernel,
+            out_shape=(jax.ShapeDtypeStruct((b, hq, d), q.dtype),
+                       jax.ShapeDtypeStruct((world, b, hkv, groups, d),
+                                            jnp.float32),
+                       jax.ShapeDtypeStruct((world, b, hkv, groups),
+                                            jnp.float32),
+                       jax.ShapeDtypeStruct((world, b, hkv, groups),
+                                            jnp.float32)),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3 +
+                     [pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 4),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((world, 3)),
+                            pltpu.SemaphoreType.DMA((world, 3))],
+            compiler_params=comm_params(collective_id=7, world=world),
+            interpret=interpret,
+        )(qs, ks, vs, n)
+        return out
+
+    f = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(None, axis), P(None, axis), P()),
+        out_specs=P(), check_vma=False)
+    return f(q, cache_k, cache_v, kv_len.reshape(1))

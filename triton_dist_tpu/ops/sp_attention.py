@@ -1,0 +1,235 @@
+"""Sequence-parallel attention for long-context prefill.
+
+TPU-native redesign of the reference's SP AG-attention
+(python/triton_dist/kernels/nvidia/sp_ag_attention_inter_node.py: KV
+allgather producer :115-257 overlapped with a flash-attn consumer waiting
+per-KV-shard signals :259-499; intra-node zigzag variant
+sp_ag_attention_intra_node.py) — plus **ring attention**, which the
+reference lacks (SURVEY.md §5 flags it as the ICI-natural extension): on a
+torus each ppermute hop rides one neighbor link, KV is never materialized
+in full, and the online-softmax merge makes the schedule exact.
+
+Three implementations:
+
+- ``impl="ring"``  — ring attention: rotate the KV shard w-1 times; each
+  step folds one shard into the running (m, l, acc) online-softmax state
+  while the next shard is in flight (collective matmul schedule — XLA
+  overlaps the ppermute with the einsums).
+- ``impl="xla"``   — AG-KV golden: one ``all_gather`` of KV + a single
+  masked attention pass (the reference's semantic baseline).
+- ``impl="pallas"``— AG-KV with the fused Pallas ring all-gather
+  (ops/allgather) producing KV, then the same local pass; the analog of
+  the reference's copy-engine-AG + consumer split.
+
+Causal masking uses global positions (query block r holds positions
+``r*S_loc + [0, S_loc)``), so all variants are exact for causal and full
+attention. Load imbalance of causal ring attention is noted: the zigzag
+batch reorder of the intra-node reference variant is a host-side
+permutation of the sequence dimension, exposed as ``zigzag_reorder`` /
+``zigzag_restore`` helpers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.ops.allgather import (
+    AllGatherContext, create_allgather_context, all_gather)
+
+_NEG = -1e30
+
+
+@dataclasses.dataclass
+class SpAttentionContext:
+    """Analog of ``create_sp_ag_attention_context``
+    (sp_ag_attention_inter_node.py): axis + AG workspace config."""
+    mesh: Mesh
+    axis: str = "sp"
+    causal: bool = True
+    interpret: bool | None = None
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_sp_attention_context(mesh: Mesh | None = None, axis: str = "sp",
+                                causal: bool = True,
+                                interpret: bool | None = None
+                                ) -> SpAttentionContext:
+    if mesh is None:
+        from triton_dist_tpu.runtime.dist import get_mesh
+        mesh = get_mesh()
+    return SpAttentionContext(mesh=mesh, axis=axis, causal=causal,
+                              interpret=interpret)
+
+
+def _chunk_scores(q, k, q_first, k_first, causal: bool):
+    """Masked scores of one (Q block, KV block) pair.
+
+    q: (B, K, G, Sq, D) fp32; k: (B, T, K, D); returns (B, K, G, Sq, T).
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bkgsd,btkd->bkgst", q,
+                        k.astype(jnp.float32)) * (d ** -0.5)
+    if causal:
+        sq, t = scores.shape[-2], scores.shape[-1]
+        q_pos = q_first + jnp.arange(sq)[:, None]
+        k_pos = k_first + jnp.arange(t)[None, :]
+        scores = jnp.where(q_pos >= k_pos, scores, _NEG)
+    return scores
+
+
+def _online_update(state, scores, v):
+    """Fold one KV block into the (m, l, acc) online-softmax state."""
+    m, l, acc = state
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+    p = jnp.exp(scores - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l = l * corr + jnp.sum(p, axis=-1)
+    acc = acc * corr[..., None] + jnp.einsum(
+        "bkgst,btkd->bkgsd", p, v.astype(jnp.float32))
+    return m_new, l, acc
+
+
+def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    ctx: SpAttentionContext | None = None,
+                    impl: str = "ring") -> jax.Array:
+    """Sequence-parallel (self-)attention (functional entry, reference
+    ``fused_sp_ag_attn_inter_node`` sp_ag_attention_inter_node.py:504).
+
+    Args:
+      q: (B, S, Hq, D), S sequence-sharded over ``ctx.axis``.
+      k/v: (B, S, Hkv, D), sharded the same way.
+    Returns:
+      (B, S, Hq, D) outputs, sequence-sharded like q.
+    """
+    ctx = ctx or create_sp_attention_context()
+    mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    causal = ctx.causal
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    groups = hq // hkv
+    assert s % world == 0
+    s_loc = s // world
+
+    def finish(state, qs_dtype):
+        m, l, acc = state
+        out = acc / jnp.maximum(l, 1e-20)[..., None]
+        # (B, K, G, S, D) → (B, S, Hq, D)
+        return out.transpose(0, 3, 1, 2, 4).reshape(
+            b, s_loc, hq, d).astype(qs_dtype)
+
+    def local_q(qs):
+        # (B, S_loc, Hq, D) → (B, K, G, S_loc, D) fp32
+        return qs.reshape(b, s_loc, hkv, groups, d
+                          ).transpose(0, 2, 3, 1, 4).astype(jnp.float32)
+
+    def ag_body(qs, ks, vs):
+        me = lax.axis_index(axis)
+        kg = lax.all_gather(ks, axis, axis=1, tiled=True)
+        vg = lax.all_gather(vs, axis, axis=1, tiled=True)
+        qf = local_q(qs)
+        scores = _chunk_scores(qf, kg, me * s_loc, 0, causal)
+        m = jnp.max(scores, axis=-1)
+        p = jnp.exp(scores - m[..., None])
+        l = jnp.sum(p, axis=-1)
+        acc = jnp.einsum("bkgst,btkd->bkgsd", p, vg.astype(jnp.float32))
+        return finish((m, l, acc), qs.dtype)
+
+    def ring_body(qs, ks, vs):
+        me = lax.axis_index(axis)
+        qf = local_q(qs)
+        perm = [(i, (i + 1) % world) for i in range(world)]
+        state = (jnp.full((b, hkv, groups, s_loc), _NEG, jnp.float32),
+                 jnp.zeros((b, hkv, groups, s_loc), jnp.float32),
+                 jnp.zeros((b, hkv, groups, s_loc, d), jnp.float32))
+
+        def step(i, carry):
+            state, kc, vc = carry
+            src = lax.rem(me - i + world, world)
+            # Next hop first — XLA overlaps it with this step's einsums.
+            kn = lax.ppermute(kc, axis, perm)
+            vn = lax.ppermute(vc, axis, perm)
+            scores = _chunk_scores(qf, kc, me * s_loc, src * s_loc, causal)
+            state = _online_update(state, scores, vc)
+            return state, kn, vn
+
+        state, kc, vc = lax.fori_loop(0, world - 1, step, (state, ks, vs))
+        src = lax.rem(me - (world - 1) + world, world)
+        scores = _chunk_scores(qf, kc, me * s_loc, src * s_loc, causal)
+        state = _online_update(state, scores, vc)
+        return finish(state, qs.dtype)
+
+    if impl in ("xla", "ring") or world == 1:
+        body = ag_body if (impl == "xla" or world == 1) else ring_body
+        f = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+            out_specs=P(None, axis), check_vma=False)
+        return f(q, k, v)
+
+    if impl == "pallas":
+        # Fused Pallas ring AG of KV (the copy-engine producer analog),
+        # then one local masked pass.
+        ag_ctx = create_allgather_context(mesh, axis,
+                                          interpret=ctx.interpret)
+        # Flatten KV to 2-D row-sharded layout for the AG kernel.
+        kf = k.transpose(1, 0, 2, 3).reshape(s, b * hkv * d)
+        vf = v.transpose(1, 0, 2, 3).reshape(s, b * hkv * d)
+        kg = all_gather(kf, ag_ctx, impl="pallas")
+        vg = all_gather(vf, ag_ctx, impl="pallas")
+        kg = kg.reshape(s, b, hkv, d).transpose(1, 0, 2, 3)
+        vg = vg.reshape(s, b, hkv, d).transpose(1, 0, 2, 3)
+
+        def body(qs, kgs, vgs):
+            me = lax.axis_index(axis)
+            qf = local_q(qs)
+            scores = _chunk_scores(qf, kgs, me * s_loc, 0, causal)
+            m = jnp.max(scores, axis=-1)
+            p = jnp.exp(scores - m[..., None])
+            l = jnp.sum(p, axis=-1)
+            acc = jnp.einsum("bkgst,btkd->bkgsd", p,
+                             vgs.astype(jnp.float32))
+            return finish((m, l, acc), qs.dtype)
+
+        f = jax.shard_map(body, mesh=mesh,
+                          in_specs=(P(None, axis), P(), P()),
+                          out_specs=P(None, axis), check_vma=False)
+        return f(q, kg, vg)
+
+    raise ValueError(f"unknown impl {impl!r}")
+
+
+def zigzag_reorder(x: jax.Array, world: int, seq_axis: int = 1) -> jax.Array:
+    """Zigzag sequence permutation for causal load balance (the reference's
+    intra-node zigzag batch schedule, sp_ag_attention_intra_node.py):
+    shard r gets chunks (r, 2w-1-r) so early and late positions pair up."""
+    s = x.shape[seq_axis]
+    assert s % (2 * world) == 0
+    c = s // (2 * world)
+    idx = []
+    for r in range(world):
+        idx.extend(range(r * c, (r + 1) * c))
+        idx.extend(range((2 * world - 1 - r) * c, (2 * world - r) * c))
+    return jnp.take(x, jnp.array(idx), axis=seq_axis)
+
+
+def zigzag_restore(x: jax.Array, world: int, seq_axis: int = 1) -> jax.Array:
+    """Inverse of :func:`zigzag_reorder`."""
+    s = x.shape[seq_axis]
+    c = s // (2 * world)
+    idx = []
+    for r in range(world):
+        idx.extend(range(r * c, (r + 1) * c))
+        idx.extend(range((2 * world - 1 - r) * c, (2 * world - r) * c))
+    inv = [0] * s
+    for new, old in enumerate(
+            [i for blk in idx for i in ([blk] if isinstance(blk, int) else blk)]):
+        inv[old] = new
+    return jnp.take(x, jnp.array(inv), axis=seq_axis)
