@@ -1,0 +1,77 @@
+"""Pipeline-parallel communication layer.
+
+TPU-native analog of the reference's ``CommOp``
+(python/triton_dist/layers/nvidia/p2p.py:43-131: N symmetric buffers with
+per-pp-rank set/wait signals so a producer stage can run ahead of its
+consumer). On TPU the signal protocol collapses into dataflow — a
+``pp_shift`` is ordered by SSA use — so ``CommOp`` keeps the *API* (ring
+of in-flight buffers, send/recv pairing) while the synchronization is
+compiler-managed.
+
+A microbatched GPipe-style schedule built on this layer lives in
+``pipeline_schedule`` (the reference stops at p2p + test; SURVEY.md §2.9
+"PP: partial — no scheduler", so the schedule is an extension).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from triton_dist_tpu.ops.p2p import P2PContext, create_p2p_context, pp_shift
+
+
+class CommOp:
+    """Ring of ``num_buffers`` in-flight pipeline hops (API parity with
+    layers/nvidia/p2p.py:43; the buffer count bounds producer run-ahead
+    in the reference — here it bounds how many shifts are outstanding)."""
+
+    def __init__(self, num_buffers: int = 2, mesh: Mesh | None = None,
+                 axis: str = "pp", impl: str = "pallas"):
+        self.ctx: P2PContext = create_p2p_context(mesh, axis)
+        self.num_buffers = num_buffers
+        self.impl = impl
+        self._in_flight: list[jax.Array] = []
+
+    def send(self, x: jax.Array, delta: int = 1) -> None:
+        """Issue a hop; blocks (joins the oldest) when the ring is full."""
+        if len(self._in_flight) >= self.num_buffers:
+            self._in_flight.pop(0)
+        self._in_flight.append(pp_shift(x, self.ctx, delta=delta,
+                                        impl=self.impl))
+
+    def recv(self) -> jax.Array:
+        """Consume the oldest outstanding hop."""
+        return self._in_flight.pop(0)
+
+
+def pipeline_forward(stage_fn, x: jax.Array, mesh: Mesh | None = None,
+                     axis: str = "pp", impl: str = "xla") -> jax.Array:
+    """Forward pass through a w-stage pipeline over the pp axis.
+
+    ``stage_fn(stage_idx, h)`` applies stage ``stage_idx`` to block ``h``
+    (SPMD: every device applies its own stage each tick). ``x``:
+    (w*rows, F) sharded over pp; stage 0's shard carries the input. Each
+    tick = apply + shift, so after w ticks the stage-0 block has passed
+    stages 0..w-1; the result sits in stage 0's shard again (w shifts =
+    full wrap). Microbatch schedulers (1F1B etc.) compose this tick —
+    the reference stops at p2p + test (SURVEY.md §2.9 "PP: partial").
+    """
+    from jax.sharding import PartitionSpec as P
+
+    ctx = create_p2p_context(mesh, axis)
+    world = ctx.world_size
+
+    def apply(h):
+        def body(hs):
+            me = lax.axis_index(axis)
+            return stage_fn(me, hs)
+        return jax.shard_map(body, mesh=ctx.mesh, in_specs=P(axis),
+                             out_specs=P(axis), check_vma=False)(h)
+
+    h = x
+    for _ in range(world):
+        h = pp_shift(apply(h), ctx, delta=1, impl=impl)
+    return h

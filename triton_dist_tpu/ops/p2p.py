@@ -1,0 +1,113 @@
+"""Point-to-point pipeline-parallel transfers.
+
+TPU-native redesign of the reference's PP p2p kernels
+(python/triton_dist/kernels/nvidia/p2p.py: ``p2p_copy_kernel`` push :31 /
+pull :54 — one-sided copies between pp ranks' symmetric buffers, with
+per-rank set/wait signals).
+
+On an ICI mesh a pipeline hop is a neighbor transfer:
+
+- ``impl="xla"``    — ``lax.ppermute`` shift along the pp axis (XLA
+  schedules it asynchronously; this is the idiomatic path).
+- ``impl="pallas"`` — explicit remote DMA kernel: each device pushes its
+  buffer to the next stage and waits the incoming DMA's recv semaphore
+  (the signal set/wait protocol of the reference collapses into the DMA
+  semaphore pair).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+import triton_dist_tpu.language as dl
+from triton_dist_tpu.ops.common import comm_params, resolve_interpret
+
+
+@dataclasses.dataclass
+class P2PContext:
+    mesh: Mesh
+    axis: str = "pp"
+    interpret: bool | None = None
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def create_p2p_context(mesh: Mesh | None = None, axis: str = "pp",
+                       interpret: bool | None = None) -> P2PContext:
+    if mesh is None:
+        from triton_dist_tpu.runtime.dist import get_mesh
+        mesh = get_mesh()
+    return P2PContext(mesh=mesh, axis=axis, interpret=interpret)
+
+
+def _shift_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis: str,
+                  world: int, delta: int):
+    """Push local buffer to rank (me+delta); receive from (me-delta)."""
+    me = lax.axis_index(axis)
+    dst = lax.rem(me + delta + world, world)
+    src = lax.rem(me - delta + world, world)
+    dl.barrier_all(axis)
+    dl.remote_copy(x_ref.at[:], o_ref.at[:], dst, send_sem, recv_sem,
+                   axis=axis).start()
+    # Mirror descriptor: wait for the DMA arriving from src.
+    dl.remote_copy(x_ref.at[:], o_ref.at[:], me, send_sem, recv_sem,
+                   axis=axis).wait_recv()
+    dl.remote_copy(x_ref.at[:], o_ref.at[:], dst, send_sem, recv_sem,
+                   axis=axis).wait_send()
+
+
+def pp_shift(x: jax.Array, ctx: P2PContext | None = None, delta: int = 1,
+             impl: str = "pallas") -> jax.Array:
+    """Shift per-stage activations one pipeline hop (functional entry;
+    reference ``p2p_copy_kernel`` push, p2p.py:31).
+
+    Args:
+      x: (stages, ...) with the leading dim sharded over the pp axis —
+        each stage's activation block.
+      delta: +1 forward (stage i → i+1), -1 backward.
+    Returns:
+      same layout; stage i now holds what stage i-delta had. The wrap
+      entry (stage 0 for delta=+1) carries stage w-1's buffer — pipeline
+      schedulers treat it as the bubble slot.
+    """
+    ctx = ctx or create_p2p_context()
+    mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    if world == 1:
+        return x
+
+    if impl == "xla":
+        perm = [(i, (i + delta) % world) for i in range(world)]
+
+        def body(xs):
+            return lax.ppermute(xs, axis, perm)
+        return jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+                             out_specs=P(axis), check_vma=False)(x)
+
+    interpret = resolve_interpret(ctx.interpret)
+    kernel = functools.partial(_shift_kernel, axis=axis, world=world,
+                               delta=delta)
+
+    def body(xs):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(xs.shape, xs.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.SemaphoreType.DMA,
+                            pltpu.SemaphoreType.DMA],
+            compiler_params=comm_params(collective_id=8, world=world),
+            interpret=interpret,
+        )(xs)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+                         out_specs=P(axis), check_vma=False)(x)
