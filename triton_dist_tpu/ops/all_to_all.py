@@ -41,7 +41,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
-from triton_dist_tpu.ops.common import cdiv, comm_params, resolve_interpret
+from triton_dist_tpu.ops.common import cdiv, comm_params, resolve_interpret, sync_interpret
 
 
 def _default_chunk_rows(capacity: int) -> int:
@@ -227,4 +227,4 @@ def fast_all_to_all(send_buf: jax.Array, send_counts: jax.Array,
 
     f = jax.shard_map(outer, mesh=mesh, in_specs=(P(axis), P(axis)),
                       out_specs=(P(axis), P(axis)), check_vma=False)
-    return f(send_buf, send_counts)
+    return sync_interpret(f(send_buf, send_counts), interpret)

@@ -37,7 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
-from triton_dist_tpu.ops.common import comm_params, resolve_interpret
+from triton_dist_tpu.ops.common import comm_params, resolve_interpret, sync_interpret
 
 
 class AllGatherMethod(enum.Enum):
@@ -275,4 +275,4 @@ def all_gather(x: jax.Array, ctx: AllGatherContext | None = None,
 
     f = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
                       out_specs=out_spec, check_vma=False)
-    return f(x)
+    return sync_interpret(f(x), interpret)

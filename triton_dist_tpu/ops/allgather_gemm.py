@@ -34,7 +34,8 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
-from triton_dist_tpu.ops.common import comm_params, resolve_interpret
+from triton_dist_tpu.ops.common import (
+    comm_params, resolve_interpret, sync_interpret)
 
 
 @dataclasses.dataclass
@@ -193,7 +194,7 @@ def ag_gemm_multi(a: jax.Array, bs,
     f = jax.shard_map(body, mesh=mesh,
                       in_specs=(P(axis),) + (P(None, axis),) * n_b,
                       out_specs=out_specs, check_vma=False)
-    return list(f(a, *bs))
+    return list(sync_interpret(f(a, *bs), interpret))
 
 
 def ag_gemm(a: jax.Array, b: jax.Array,

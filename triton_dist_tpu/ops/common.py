@@ -29,6 +29,26 @@ def resolve_interpret(interpret: bool | None):
     return False
 
 
+def sync_interpret(out, interpret) -> object:
+    """Block on eager interpret-mode results before returning.
+
+    JAX dispatches asynchronously: an interpreted multi-device kernel may
+    still be executing (its device programs + io_callbacks occupying CPU
+    client pool threads) when the caller dispatches follow-on
+    computations into the same pool — on low-core hosts the queued work
+    can starve the in-flight kernel's device programs: a resource
+    deadlock (observed: TP_Attn xla-then-ag_rs hang). Compiled TPU
+    kernels don't need this; under jit tracing outputs are Tracers and
+    are passed through untouched.
+    """
+    if not interpret:
+        return out
+    leaves = jax.tree_util.tree_leaves(out)
+    if any(isinstance(leaf, jax.core.Tracer) for leaf in leaves):
+        return out
+    return jax.block_until_ready(out)
+
+
 def comm_params(collective_id: int | None = 0,
                 vmem_limit_bytes: int | None = None,
                 world: int | None = None) -> pltpu.CompilerParams:

@@ -37,7 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
-from triton_dist_tpu.ops.common import comm_params, resolve_interpret
+from triton_dist_tpu.ops.common import comm_params, resolve_interpret, sync_interpret
 
 _NEG = -1e30
 
@@ -217,4 +217,5 @@ def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
         body, mesh=mesh,
         in_specs=(P(), P(None, axis), P(None, axis), P()),
         out_specs=P(), check_vma=False)
-    return f(q, cache_k, cache_v, kv_len.reshape(1))
+    return sync_interpret(f(q, cache_k, cache_v, kv_len.reshape(1)),
+                          interpret)

@@ -32,7 +32,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
-from triton_dist_tpu.ops.common import comm_params, resolve_interpret
+from triton_dist_tpu.ops.common import comm_params, resolve_interpret, sync_interpret
 
 
 @dataclasses.dataclass
@@ -196,7 +196,7 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
 
     f = jax.shard_map(body, mesh=mesh, in_specs=(P(None, axis), P(axis)),
                       out_specs=out_spec, check_vma=False)
-    return f(a, b)
+    return sync_interpret(f(a, b), interpret)
 
 
 def gemm_rs(a: jax.Array, b: jax.Array,

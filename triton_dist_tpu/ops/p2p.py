@@ -28,7 +28,8 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
-from triton_dist_tpu.ops.common import comm_params, resolve_interpret
+from triton_dist_tpu.ops.common import (
+    comm_params, resolve_interpret, sync_interpret)
 
 
 @dataclasses.dataclass
@@ -109,5 +110,6 @@ def pp_shift(x: jax.Array, ctx: P2PContext | None = None, delta: int = 1,
             interpret=interpret,
         )(xs)
 
-    return jax.shard_map(body, mesh=mesh, in_specs=P(axis),
-                         out_specs=P(axis), check_vma=False)(x)
+    out = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+                        out_specs=P(axis), check_vma=False)(x)
+    return sync_interpret(out, interpret)

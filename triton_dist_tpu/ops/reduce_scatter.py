@@ -33,7 +33,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
-from triton_dist_tpu.ops.common import comm_params, resolve_interpret
+from triton_dist_tpu.ops.common import comm_params, resolve_interpret, sync_interpret
 
 
 class ReduceScatterMethod(enum.Enum):
@@ -234,4 +234,4 @@ def reduce_scatter(x: jax.Array, ctx: ReduceScatterContext | None = None,
 
     f = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
                       out_specs=P(axis), check_vma=False)
-    return f(x)
+    return sync_interpret(f(x), interpret)

@@ -40,7 +40,10 @@ def ensure_cpu_shim() -> str | None:
 
 def maybe_reexec_with_shim() -> None:
     """Re-exec the current process with LD_PRELOAD=libcpushim.so (no-op when
-    already loaded, on multi-core hosts, or if the shim can't be built)."""
+    already loaded, on multi-core hosts, disabled via TDT_NO_CPU_SHIM=1, or
+    if the shim can't be built)."""
+    if os.environ.get("TDT_NO_CPU_SHIM"):
+        return
     if os.cpu_count() and os.cpu_count() >= 8:
         return
     so = ensure_cpu_shim()
