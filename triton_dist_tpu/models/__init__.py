@@ -1,0 +1,61 @@
+"""Models + engine (reference L7: python/triton_dist/models/).
+
+``AutoLLM.from_pretrained`` (reference models/__init__.py:33) dispatches
+on the HF config's ``model_type``/MoE fields to ``DenseLLM`` or
+``Qwen3MoE`` and loads safetensors weights when present.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.models.dense import DenseLLM
+from triton_dist_tpu.models.qwen_moe import Qwen3MoE
+from triton_dist_tpu.models.kv_cache import KVCacheManager
+from triton_dist_tpu.models.engine import Engine, sample_token
+
+__all__ = ["ModelConfig", "DenseLLM", "Qwen3MoE", "KVCacheManager",
+           "Engine", "sample_token", "AutoLLM"]
+
+
+def _load_safetensors_state(model_dir: str) -> dict:
+    """Read all ``*.safetensors`` shards into one name→array dict
+    (the reference loads via HF from_pretrained; we read directly —
+    no torch needed on the load path)."""
+    from safetensors import safe_open  # ships with transformers
+
+    state = {}
+    files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no safetensors under {model_dir}")
+    for path in files:
+        with safe_open(path, framework="np") as f:
+            for name in f.keys():
+                state[name] = f.get_tensor(name)
+    return state
+
+
+class AutoLLM:
+    """Dispatching loader (reference ``AutoLLM.from_pretrained``,
+    models/__init__.py:33-64)."""
+
+    @staticmethod
+    def build(config: ModelConfig, mesh=None, axis: str = "tp",
+              fwd_mode: str = "ag_rs", impl: str = "pallas"):
+        cls = Qwen3MoE if config.is_moe else DenseLLM
+        return cls(config, mesh=mesh, axis=axis, fwd_mode=fwd_mode,
+                   impl=impl)
+
+    @staticmethod
+    def from_pretrained(model_dir: str, mesh=None, axis: str = "tp",
+                        fwd_mode: str = "ag_rs", impl: str = "pallas"):
+        """Build the model from a local HF checkpoint dir and load + shard
+        its weights. Returns (model, params)."""
+        config = ModelConfig.from_hf_config(model_dir)
+        model = AutoLLM.build(config, mesh=mesh, axis=axis,
+                              fwd_mode=fwd_mode, impl=impl)
+        state = _load_safetensors_state(model_dir)
+        params = model.load_hf_state_dict(state)
+        return model, params

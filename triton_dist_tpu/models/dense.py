@@ -1,0 +1,184 @@
+"""Qwen3-class dense decoder under tensor parallelism.
+
+TPU-native redesign of the reference's ``DenseLLM``
+(python/triton_dist/models/dense.py:117-241: HF-weight-loading TP model,
+per-layer ``set_fwd(mode)``, ``init_triton_dist_ctx`` allocating the fused
+op contexts). Model math follows HF Qwen3: pre-norm decoder blocks with
+GQA attention (per-head q/k RMSNorm) + SwiGLU MLP, rotary embeddings,
+tied/untied LM head.
+
+Functional shape: the module owns config + layer objects (which own the
+fused-op contexts); parameters are a pytree; ``forward`` threads the KV
+cache through. ``jax.jit`` of ``forward`` is the CUDA-graph analog
+(SURVEY.md §7 stage 7).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.layers.common import (
+    precompute_rope_cache, rms_norm, shard_param)
+from triton_dist_tpu.layers.tp_attn import TPAttn
+from triton_dist_tpu.layers.tp_mlp import TPMLP
+from triton_dist_tpu.models.config import ModelConfig
+
+
+class DenseLLM:
+    """TP Qwen3 decoder (reference models/dense.py:117)."""
+
+    def __init__(self, config: ModelConfig, mesh: Mesh | None = None,
+                 axis: str = "tp", fwd_mode: str = "ag_rs",
+                 impl: str = "pallas"):
+        if mesh is None:
+            from triton_dist_tpu.runtime.dist import get_mesh
+            mesh = get_mesh()
+        self.config = config
+        self.mesh, self.axis = mesh, axis
+        self.fwd_mode = fwd_mode
+        c = config
+        # One module per role, reused across layers (all layers share
+        # shapes; params differ per layer).
+        self.attn = TPAttn(c.hidden_size, c.num_attention_heads,
+                           c.num_key_value_heads, c.head_dim, mesh=mesh,
+                           axis=axis, dtype=c.dtype, fwd_mode=fwd_mode,
+                           impl=impl, rms_eps=c.rms_norm_eps)
+        self.mlp = TPMLP(c.hidden_size, c.intermediate_size, mesh=mesh,
+                         axis=axis, dtype=c.dtype, fwd_mode=fwd_mode,
+                         impl=impl)
+        self.rope_cache = precompute_rope_cache(
+            c.head_dim, c.max_position_embeddings, c.rope_theta)
+
+    def set_fwd(self, mode: str):
+        """Switch all layers' forward mode (reference per-layer set_fwd,
+        models/dense.py:216)."""
+        self.fwd_mode = mode
+        self.attn.set_fwd(mode)
+        self.mlp.set_fwd(mode)
+
+    # -- params ------------------------------------------------------------
+    def init(self, key: jax.Array) -> dict:
+        c = self.config
+        keys = jax.random.split(key, c.num_hidden_layers + 2)
+        layers = []
+        for i in range(c.num_hidden_layers):
+            ka, km = jax.random.split(keys[i])
+            layers.append({
+                "attn": self.attn.init(ka),
+                "mlp": self.mlp.init(km),
+                "ln_attn": jnp.ones((c.hidden_size,), c.dtype),
+                "ln_mlp": jnp.ones((c.hidden_size,), c.dtype),
+            })
+        embed = (jax.random.normal(keys[-2], (c.vocab_size, c.hidden_size),
+                                   c.dtype) * 0.02)
+        params = {
+            "embed": embed,
+            "layers": layers,
+            "final_norm": jnp.ones((c.hidden_size,), c.dtype),
+            "lm_head": (embed if c.tie_word_embeddings else
+                        jax.random.normal(keys[-1],
+                                          (c.vocab_size, c.hidden_size),
+                                          c.dtype) * 0.02),
+        }
+        return self.shard_params(params)
+
+    def shard_params(self, params: dict) -> dict:
+        m = self.mesh
+        out = {
+            "embed": shard_param(params["embed"], m, P()),
+            "final_norm": shard_param(params["final_norm"], m, P()),
+            "lm_head": shard_param(params["lm_head"], m, P()),
+            "layers": [],
+        }
+        for lp in params["layers"]:
+            out["layers"].append({
+                "attn": self.attn.shard_params(lp["attn"]),
+                "mlp": self.mlp.shard_params(lp["mlp"]),
+                "ln_attn": shard_param(lp["ln_attn"], m, P()),
+                "ln_mlp": shard_param(lp["ln_mlp"], m, P()),
+            })
+        return out
+
+    # -- forward -----------------------------------------------------------
+    def forward(self, params: dict, input_ids: jax.Array, kv_caches,
+                offset, mode: str | None = None):
+        """input_ids: (B, S) int32; kv_caches: [(k, v)] * L; offset: scalar
+        write position. Returns (logits (B, S, V), new_caches).
+
+        The reference's ``inference`` (dense.py:200-241). Activation
+        layout: row-sharded (M=B*S over tp) for {xla, ag_rs} — requires
+        B*S % world == 0; replicated for {xla_ar, gemm_ar} (decode).
+        """
+        c = self.config
+        mode = mode or self.fwd_mode
+        b, s = input_ids.shape
+        offset = jnp.asarray(offset, jnp.int32)
+        position_ids = offset + jnp.tile(
+            jnp.arange(s, dtype=jnp.int32)[None], (b, 1))
+
+        x = params["embed"][input_ids].reshape(b * s, c.hidden_size)
+        new_caches = []
+        for lp, cache in zip(params["layers"], kv_caches):
+            h = rms_norm(x, lp["ln_attn"], c.rms_norm_eps)
+            a, cache = self.attn(lp["attn"], h, position_ids,
+                                 self.rope_cache, cache, offset, mode=mode)
+            x = x + a
+            h = rms_norm(x, lp["ln_mlp"], c.rms_norm_eps)
+            x = x + self.mlp(lp["mlp"], h, mode=mode)
+            new_caches.append(cache)
+
+        x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+        logits = jnp.dot(x.astype(jnp.float32),
+                         params["lm_head"].T.astype(jnp.float32))
+        return logits.reshape(b, s, c.vocab_size), new_caches
+
+    # -- HF weights --------------------------------------------------------
+    def load_hf_state_dict(self, state: dict) -> dict:
+        """Map a HF Qwen3 state dict (name → array) to our params pytree
+        and shard (the reference shards at load, dense.py:150-168,
+        tp_mlp.py:72-96). Accepts numpy/jnp arrays or anything
+        np.asarray-able (torch tensors via ``.numpy()``)."""
+        c = self.config
+
+        def get(name):
+            a = state[name]
+            if hasattr(a, "detach"):
+                a = a.detach().cpu().numpy()
+            return jnp.asarray(np.asarray(a), c.dtype)
+
+        def lin(name):
+            # HF nn.Linear keeps (out, in); we use (in, out).
+            return get(name).T
+
+        layers = []
+        for i in range(c.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers.append({
+                "attn": {
+                    "w_q": lin(p + "self_attn.q_proj.weight"),
+                    "w_k": lin(p + "self_attn.k_proj.weight"),
+                    "w_v": lin(p + "self_attn.v_proj.weight"),
+                    "w_o": lin(p + "self_attn.o_proj.weight"),
+                    "q_norm": get(p + "self_attn.q_norm.weight"),
+                    "k_norm": get(p + "self_attn.k_norm.weight"),
+                },
+                "mlp": {
+                    "w_gate": lin(p + "mlp.gate_proj.weight"),
+                    "w_up": lin(p + "mlp.up_proj.weight"),
+                    "w_down": lin(p + "mlp.down_proj.weight"),
+                },
+                "ln_attn": get(p + "input_layernorm.weight"),
+                "ln_mlp": get(p + "post_attention_layernorm.weight"),
+            })
+        embed = get("model.embed_tokens.weight")
+        params = {
+            "embed": embed,
+            "layers": layers,
+            "final_norm": get("model.norm.weight"),
+            "lm_head": (embed if c.tie_word_embeddings else
+                        get("lm_head.weight")),
+        }
+        return self.shard_params(params)

@@ -1,0 +1,94 @@
+"""Inference engine: prefill + jit-compiled decode loop.
+
+TPU-native redesign of the reference ``Engine``
+(python/triton_dist/models/engine.py:113-190: prefill with the torch path,
+switch layers to the fused mode, capture the decode step in a CUDA graph,
+then replay per token). On TPU the CUDA-graph capture is ``jax.jit`` of
+the whole decode step (SURVEY.md §7 stage 7: "CUDA graph ≙ jit-compiled
+decode step — XLA gives this for free"): one compiled program containing
+every layer's fused kernels, replayed per token with no launch overhead.
+
+Backends mirror the reference's (engine.py:116):
+``xla_ar`` ≙ torch, ``ag_rs`` ≙ triton_dist, ``gemm_ar`` ≙
+triton_dist_gemm_ar (replicated small-batch decode).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.models.kv_cache import KVCacheManager
+
+
+def sample_token(logits: jax.Array, key: jax.Array | None = None,
+                 temperature: float = 0.0, top_k: int = 0) -> jax.Array:
+    """Greedy / temperature / top-k sampling (reference sampling utils,
+    models/utils.py). logits: (B, V) → (B,) int32."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    assert key is not None
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+class Engine:
+    """Serve loop around a DenseLLM / Qwen3MoE model."""
+
+    def __init__(self, model, batch: int, max_seq: int,
+                 prefill_mode: str = "xla_ar", decode_mode: str = "gemm_ar",
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
+        self.model = model
+        c = model.config
+        self.kv = KVCacheManager(
+            c.num_hidden_layers, batch, max_seq, c.num_key_value_heads,
+            c.head_dim, mesh=model.mesh, axis=model.axis, dtype=c.dtype)
+        self.prefill_mode = prefill_mode
+        self.decode_mode = decode_mode
+        self.temperature = temperature
+        self.top_k = top_k
+        self.key = jax.random.PRNGKey(seed)
+        self._decode_step = None
+
+    # -- decode step (jit once = graph capture, engine.py:75-105) ----------
+    def _build_decode_step(self):
+        model, mode = self.model, self.decode_mode
+
+        @jax.jit
+        def step(params, caches, token, offset, key):
+            logits, caches = model.forward(params, token[:, None], caches,
+                                           offset, mode=mode)
+            nxt = sample_token(logits[:, -1], key, self.temperature,
+                               self.top_k)
+            return nxt, caches
+        return step
+
+    def serve(self, params, input_ids: jax.Array, gen_len: int) -> jax.Array:
+        """Prefill ``input_ids`` (B, S) then generate ``gen_len`` tokens.
+        Returns (B, S + gen_len) (reference ``Engine.serve``
+        engine.py:113-190)."""
+        b, s = input_ids.shape
+        self.kv.reset()
+        caches = self.kv.init()
+
+        logits, caches = self.model.forward(
+            params, input_ids, caches, 0, mode=self.prefill_mode)
+        self.kv.inc_offset(s)
+        token = sample_token(logits[:, -1], self.key, self.temperature,
+                             self.top_k)
+
+        if self._decode_step is None:
+            self._decode_step = self._build_decode_step()
+        out = [input_ids, token[:, None]]
+        for _ in range(gen_len - 1):
+            self.key, sub = jax.random.split(self.key)
+            token, caches = self._decode_step(
+                params, caches, token, jnp.int32(self.kv.offset), sub)
+            self.kv.inc_offset(1)
+            out.append(token[:, None])
+        return jnp.concatenate(out, axis=1)

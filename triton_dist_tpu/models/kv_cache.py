@@ -1,0 +1,56 @@
+"""KV cache (reference ``KV_Cache``,
+python/triton_dist/models/kv_cache.py: per-layer (B, T, Hkv, D) tensors +
+a host-side offset with ``inc_offset``).
+
+Functional JAX shape: the cache is a pytree (list of per-layer (k, v)
+pairs) threaded through the forward; ``KVCacheManager`` owns allocation,
+sharding, and the offset bookkeeping the reference keeps on the module.
+Head-sharded over TP by default (each rank caches its local heads — same
+as the reference, which caches after the column-parallel KV projection);
+``seq_shard=True`` shards the T dim instead for SP decode
+(ops/flash_decode.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class KVCacheManager:
+    def __init__(self, num_layers: int, batch: int, max_seq: int,
+                 num_kv_heads: int, head_dim: int,
+                 mesh: Mesh | None = None, axis: str = "tp",
+                 dtype=jnp.bfloat16, seq_shard: bool = False):
+        if mesh is None:
+            from triton_dist_tpu.runtime.dist import get_mesh
+            mesh = get_mesh()
+        self.mesh, self.axis = mesh, axis
+        self.num_layers = num_layers
+        self.batch, self.max_seq = batch, max_seq
+        self.num_kv_heads, self.head_dim = num_kv_heads, head_dim
+        self.dtype = dtype
+        self.seq_shard = seq_shard
+        spec = P(None, axis) if seq_shard else P(None, None, axis)
+        self.sharding = NamedSharding(mesh, spec)
+        self.offset = 0  # host-side write position (reference kv_offset)
+
+    def init(self):
+        """Allocate the cache pytree: [(k, v)] * L."""
+        shape = (self.batch, self.max_seq, self.num_kv_heads, self.head_dim)
+        z = jnp.zeros(shape, self.dtype)
+        return [
+            (jax.device_put(z, self.sharding),
+             jax.device_put(z, self.sharding))
+            for _ in range(self.num_layers)
+        ]
+
+    def inc_offset(self, n: int) -> int:
+        """Advance the write position (reference ``inc_offset``)."""
+        self.offset += n
+        assert self.offset <= self.max_seq, "KV cache overflow"
+        return self.offset
+
+    def reset(self):
+        self.offset = 0
