@@ -1,0 +1,142 @@
+"""Mega runtime tests (reference mega_triton_kernel/test/: per-op tests +
+models/test_qwen3.py comparing the megakernel against torch references,
+SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.mega import ModelBuilder, MegaQwen3, TaskGraph
+from triton_dist_tpu.mega import native
+from triton_dist_tpu.models import DenseLLM, ModelConfig
+from triton_dist_tpu.models.kv_cache import KVCacheManager
+
+
+# -- native scheduler --------------------------------------------------------
+
+def test_native_lib_builds():
+    assert native.have_native(), "C++ scheduler failed to build"
+
+
+@pytest.mark.parametrize("policy", ["round_robin", "zigzag", "least_loaded"])
+def test_schedule_native_matches_python(policy):
+    costs = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5]
+    a = native.schedule(11, 4, policy, costs=costs)
+    b = native._schedule_py(11, 4, policy, costs=costs)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_zigzag_pattern():
+    out = native.schedule(8, 3, "zigzag")
+    assert out.tolist() == [0, 1, 2, 2, 1, 0, 0, 1]
+
+
+def test_toposort_and_cycles():
+    edges = [(0, 2), (1, 2), (2, 3)]
+    order = native.toposort(4, edges)
+    assert order.tolist() == [0, 1, 2, 3]
+    with pytest.raises(ValueError):
+        native.toposort(2, [(0, 1), (1, 0)])
+    py = native._toposort_py(4, np.asarray(edges, np.int32))
+    np.testing.assert_array_equal(order, py)
+
+
+def test_wavefronts():
+    # diamond: 0 -> {1,2} -> 3
+    n, wave = native.wavefronts(4, [(0, 1), (0, 2), (1, 3), (2, 3)])
+    assert n == 3
+    assert wave.tolist() == [0, 1, 1, 2]
+    n2, wave2 = native._wavefronts_py(
+        4, np.asarray([(0, 1), (0, 2), (1, 3), (2, 3)], np.int32))
+    assert n2 == n and wave2.tolist() == wave.tolist()
+
+
+# -- task graph --------------------------------------------------------------
+
+def test_task_graph_executor():
+    g = TaskGraph()
+    g.add("mul", lambda a, b: a * b, ["x", "y"], ["xy"])
+    g.add("add", lambda a, b: a + b, ["xy", "z"], ["out"])
+    g.add("neg", lambda a: -a, ["x"], ["nx"])
+    run = g.make_executor(["x", "y", "z"], ["out", "nx"])
+    out, nx = run(jnp.float32(3), jnp.float32(4), jnp.float32(5))
+    assert float(out) == 17.0 and float(nx) == -3.0
+    assert g.edges().tolist() == [[0, 1]]
+    assert "3 tasks" in g.summary()
+
+
+def test_task_graph_ssa_violation():
+    g = TaskGraph()
+    g.add("a", lambda x: x, ["i"], ["o"])
+    with pytest.raises(ValueError):
+        g.add("b", lambda x: x, ["i"], ["o"])
+
+
+def test_queue_assignment_costs():
+    g = TaskGraph()
+    for i in range(6):
+        g.add("op", lambda x: x, ["i"], [f"o{i}"] if i else ["o0"],
+              cost=i + 1) if False else None
+    g2 = TaskGraph()
+    for i in range(6):
+        g2.add("op", lambda x: x, ["i"], [f"b{i}"], cost=i + 1)
+    q = g2.queue_assignment(2, "least_loaded")
+    assert len(q) == 6 and set(q.tolist()) <= {0, 1}
+
+
+# -- qwen3 mega step ---------------------------------------------------------
+
+def test_mega_qwen3_matches_dense(mesh8, key):
+    cfg = ModelConfig(hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=8,
+                      num_key_value_heads=8, head_dim=8, vocab_size=128,
+                      max_position_embeddings=32, dtype=jnp.float32)
+    model = DenseLLM(cfg, mesh=mesh8, axis="tp", impl="xla")
+    params = model.init(key)
+    kv = KVCacheManager(cfg.num_hidden_layers, 2, 16,
+                        cfg.num_key_value_heads, cfg.head_dim, mesh=mesh8,
+                        axis="tp", dtype=cfg.dtype)
+    caches = kv.init()
+    token = jnp.array([[5], [7]], jnp.int32)
+
+    ref, ref_caches = model.forward(params, token, caches, 0,
+                                    mode="gemm_ar")
+    mega = MegaQwen3(model, decode_mode="gemm_ar")
+    out, new_caches = mega.step(params, token, caches, 0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
+    for (rk, rv), (nk, nv) in zip(ref_caches, new_caches):
+        np.testing.assert_allclose(np.asarray(rk), np.asarray(nk))
+        np.testing.assert_allclose(np.asarray(rv), np.asarray(nv))
+    # graph structure sanity: tasks per layer + embed + final norm + head
+    n_waves, _ = mega.graph.waves()
+    # embed + final norm + lm head, plus 9 tasks per layer
+    assert len(mega.graph.tasks) == 3 + 9 * cfg.num_hidden_layers
+    assert n_waves >= 6
+
+
+def test_mega_decode_loop(mesh8, key):
+    """Multi-step decode through the mega step matches DenseLLM decode."""
+    cfg = ModelConfig(hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=8,
+                      num_key_value_heads=8, head_dim=8, vocab_size=64,
+                      max_position_embeddings=32, dtype=jnp.float32)
+    model = DenseLLM(cfg, mesh=mesh8, axis="tp", impl="xla")
+    params = model.init(key)
+    kv = KVCacheManager(1, 1, 8, 8, 8, mesh=mesh8, axis="tp",
+                        dtype=cfg.dtype)
+    mega = MegaQwen3(model, decode_mode="gemm_ar")
+
+    c1 = kv.init()
+    c2 = kv.init()
+    tok = jnp.array([[3]], jnp.int32)
+    t1 = t2 = tok
+    for step in range(3):
+        ref, c1 = model.forward(params, t1, c1, step, mode="gemm_ar")
+        out, c2 = mega.step(params, t2, c2, step)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        t1 = jnp.argmax(ref[:, -1], -1).astype(jnp.int32)[:, None]
+        t2 = jnp.argmax(out[:, -1], -1).astype(jnp.int32)[:, None]
+        np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))

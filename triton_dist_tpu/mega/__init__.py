@@ -1,0 +1,10 @@
+"""Mega runtime: whole-decoder-step fusion (reference L8:
+python/triton_dist/mega_triton_kernel/ — task graph + scheduler +
+persistent MEGA_TRITON_KERNEL). On TPU the task graph compiles into one
+jitted XLA program (see mega/task_graph.py for the design translation);
+scheduling/dependency resolution is native C++ (csrc/scheduler).
+"""
+
+from triton_dist_tpu.mega.task_graph import Task, TaskGraph  # noqa: F401
+from triton_dist_tpu.mega.builder import ModelBuilder  # noqa: F401
+from triton_dist_tpu.mega.qwen3 import MegaQwen3  # noqa: F401

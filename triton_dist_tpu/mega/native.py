@@ -1,0 +1,162 @@
+"""ctypes bindings for the native scheduler (csrc/scheduler/scheduler.cc).
+
+Reference analog: the mega runtime's scheduler + ModelBuilder dependency
+resolution (mega_triton_kernel/core/scheduler.py:40-95,
+models/model_builder.py) — kept native like the reference's csrc/
+components. Falls back to pure-Python implementations when no compiler is
+available (results are bit-identical; tests assert so).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "csrc", "scheduler",
+                    "scheduler.cc")
+_SO = os.path.join(os.path.dirname(_SRC), "libtdtsched.so")
+_LIB = None
+_TRIED = False
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    src, so = os.path.abspath(_SRC), os.path.abspath(_SO)
+    try:
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            subprocess.run(
+                ["g++", "-shared", "-fPIC", "-O2", "-o", so, src],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(so)
+        lib.tdt_toposort.restype = ctypes.c_int32
+        lib.tdt_wavefronts.restype = ctypes.c_int32
+        _LIB = lib
+    except (OSError, subprocess.CalledProcessError):
+        _LIB = None
+    return _LIB
+
+
+def have_native() -> bool:
+    return _load() is not None
+
+
+def _i32(a):
+    return np.ascontiguousarray(a, np.int32)
+
+
+def schedule(n_tasks: int, n_queues: int, policy: str = "round_robin",
+             costs=None) -> np.ndarray:
+    """Assign tasks to queues. Policies: round_robin | zigzag |
+    least_loaded (reference ROUND_ROBIN / ZIG_ZAG, scheduler.py:86)."""
+    lib = _load()
+    out = np.empty(n_tasks, np.int32)
+    if lib is not None:
+        p = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        if policy == "round_robin":
+            lib.tdt_schedule_round_robin(n_tasks, n_queues, p)
+        elif policy == "zigzag":
+            lib.tdt_schedule_zigzag(n_tasks, n_queues, p)
+        elif policy == "least_loaded":
+            c = (np.ascontiguousarray(costs, np.int64)
+                 .ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+                 if costs is not None else None)
+            lib.tdt_schedule_least_loaded(n_tasks, n_queues, c, p)
+        else:
+            raise ValueError(policy)
+        return out
+    return _schedule_py(n_tasks, n_queues, policy, costs)
+
+
+def _schedule_py(n_tasks, n_queues, policy, costs=None) -> np.ndarray:
+    out = np.empty(n_tasks, np.int32)
+    if policy == "round_robin":
+        out[:] = np.arange(n_tasks) % n_queues
+    elif policy == "zigzag":
+        r = np.arange(n_tasks) % (2 * n_queues)
+        out[:] = np.where(r < n_queues, r, 2 * n_queues - 1 - r)
+    elif policy == "least_loaded":
+        load = np.zeros(n_queues, np.int64)
+        c = (np.asarray(costs, np.int64) if costs is not None
+             else np.ones(n_tasks, np.int64))
+        for i in range(n_tasks):
+            q = int(np.argmin(load))
+            out[i] = q
+            load[q] += c[i]
+    else:
+        raise ValueError(policy)
+    return out
+
+
+def toposort(n_tasks: int, edges) -> np.ndarray:
+    """Stable topological order (ties by task id). edges: (E, 2) int
+    (src, dst). Raises on cycles."""
+    edges = _i32(np.asarray(edges).reshape(-1, 2))
+    lib = _load()
+    if lib is not None:
+        out = np.empty(n_tasks, np.int32)
+        rc = lib.tdt_toposort(
+            n_tasks, len(edges),
+            edges.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != 0:
+            raise ValueError("task graph has a cycle")
+        return out
+    return _toposort_py(n_tasks, edges)
+
+
+def _toposort_py(n_tasks, edges) -> np.ndarray:
+    import heapq
+    adj = [[] for _ in range(n_tasks)]
+    indeg = [0] * n_tasks
+    for s, d in edges:
+        adj[s].append(int(d))
+        indeg[d] += 1
+    ready = [i for i in range(n_tasks) if indeg[i] == 0]
+    heapq.heapify(ready)
+    order = []
+    while ready:
+        t = heapq.heappop(ready)
+        order.append(t)
+        for d in adj[t]:
+            indeg[d] -= 1
+            if indeg[d] == 0:
+                heapq.heappush(ready, d)
+    if len(order) != n_tasks:
+        raise ValueError("task graph has a cycle")
+    return np.asarray(order, np.int32)
+
+
+def wavefronts(n_tasks: int, edges) -> tuple[int, np.ndarray]:
+    """(n_waves, wave_of_task): longest-path depth partition — fusion
+    groups for the jit executor (scoreboard-phase analog)."""
+    edges = _i32(np.asarray(edges).reshape(-1, 2))
+    lib = _load()
+    if lib is not None:
+        out = np.empty(n_tasks, np.int32)
+        n = lib.tdt_wavefronts(
+            n_tasks, len(edges),
+            edges.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if n < 0:
+            raise ValueError("task graph has a cycle")
+        return int(n), out
+    return _wavefronts_py(n_tasks, edges)
+
+
+def _wavefronts_py(n_tasks, edges) -> tuple[int, np.ndarray]:
+    order = _toposort_py(n_tasks, edges)
+    depth = np.zeros(n_tasks, np.int32)
+    adj = [[] for _ in range(n_tasks)]
+    for s, d in edges:
+        adj[s].append(int(d))
+    for t in order:
+        for d in adj[t]:
+            depth[d] = max(depth[d], depth[t] + 1)
+    return int(depth.max(initial=0)) + 1, depth

@@ -1,0 +1,96 @@
+"""Qwen3 decode step as a mega task graph.
+
+TPU-native redesign of the reference's mega-kernel Qwen3 integration
+(python/triton_dist/mega_triton_kernel/models/qwen3.py:201: records the
+whole decoder step op-by-op through ModelBuilder, then launches the
+persistent kernel each step). Here the recorded graph jits into one XLA
+program replayed per decode step; numerics match
+``DenseLLM.forward(mode="gemm_ar")`` exactly (test_mega.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.mega.builder import ModelBuilder
+from triton_dist_tpu.models.dense import DenseLLM
+
+
+class MegaQwen3:
+    """One-program decode step for a DenseLLM (reference bench target:
+    mega_triton_kernel.md decode latencies, SURVEY.md §6)."""
+
+    def __init__(self, model: DenseLLM, decode_mode: str = "gemm_ar"):
+        self.model = model
+        self.decode_mode = decode_mode
+        c = model.config
+        model.attn.set_fwd(decode_mode)
+        b = ModelBuilder(model.mesh, model.axis, impl=model.attn.impl,
+                         rms_eps=c.rms_norm_eps)
+        self.builder = b
+
+        inputs = ["ids", "pos", "offset", "rope", "embed", "final_norm",
+                  "lm_head"]
+        outputs = []
+        b.make_embedding("embed", "ids", "x0")
+        x = "x0"
+        for i in range(c.num_hidden_layers):
+            p = f"l{i}."
+            inputs += [p + "attn", p + "ln_attn", p + "w_gate", p + "w_up",
+                       p + "w_down", p + "ln_mlp", p + "ck", p + "cv"]
+            b.make_rms_norm(x, p + "ln_attn", p + "h_attn")
+            b.make_attention(model.attn, p + "h_attn", p + "attn", "pos",
+                             "rope", p + "ck", p + "cv", "offset",
+                             p + "a", p + "nk", p + "nv",
+                             name=f"attn{i}")
+            outputs += [p + "nk", p + "nv"]
+            b.make_add(x, p + "a", p + "x_mid")
+            b.make_rms_norm(p + "x_mid", p + "ln_mlp", p + "h_mlp")
+            b.make_linear_col(p + "h_mlp", p + "w_gate", p + "gate",
+                              name=f"gate{i}")
+            b.make_linear_col(p + "h_mlp", p + "w_up", p + "up",
+                              name=f"up{i}")
+            b.make_silu_mul(p + "gate", p + "up", p + "act")
+            b.make_linear_ar(p + "act", p + "w_down", p + "down",
+                             name=f"down{i}")
+            b.make_add(p + "x_mid", p + "down", p + "x_out")
+            x = p + "x_out"
+        b.make_rms_norm(x, "final_norm", "x_final")
+        b.make_lm_head("x_final", "lm_head", "logits")
+        self._input_names = inputs
+        self._output_names = ["logits"] + outputs
+        self._step = b.compile(inputs, self._output_names)
+
+    @property
+    def graph(self):
+        return self.builder.graph
+
+    def step(self, params: dict, token: jax.Array, kv_caches, offset):
+        """token: (B, 1) int32 → (logits (B, 1, V), new_caches)."""
+        c = self.model.config
+        bsz, s = token.shape
+        offset = jnp.asarray(offset, jnp.int32)
+        pos = offset + jnp.tile(jnp.arange(s, dtype=jnp.int32)[None],
+                                (bsz, 1))
+        args = {
+            "ids": token, "pos": pos, "offset": offset,
+            "rope": self.model.rope_cache,
+            "embed": params["embed"], "final_norm": params["final_norm"],
+            "lm_head": params["lm_head"],
+        }
+        for i, (lp, (ck, cv)) in enumerate(zip(params["layers"],
+                                               kv_caches)):
+            p = f"l{i}."
+            args[p + "attn"] = lp["attn"]
+            args[p + "ln_attn"] = lp["ln_attn"]
+            args[p + "ln_mlp"] = lp["ln_mlp"]
+            args[p + "w_gate"] = lp["mlp"]["w_gate"]
+            args[p + "w_up"] = lp["mlp"]["w_up"]
+            args[p + "w_down"] = lp["mlp"]["w_down"]
+            args[p + "ck"], args[p + "cv"] = ck, cv
+        out = self._step(*[args[n] for n in self._input_names])
+        logits, flat = out[0], out[1:]
+        caches = [(flat[2 * i], flat[2 * i + 1])
+                  for i in range(c.num_hidden_layers)]
+        return logits.reshape(bsz, s, c.vocab_size), caches

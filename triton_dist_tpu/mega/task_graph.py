@@ -1,0 +1,118 @@
+"""Task graph IR for the mega (fused decode step) runtime.
+
+TPU-native redesign of the reference's MegaTritonKernel task machinery
+(python/triton_dist/mega_triton_kernel/core/task_base.py:150-220:
+``TaskBase`` encoding (task_type, layer_id, task_id, tiles, deps, io
+tensors) into int32 structs; core/builder.py:62 ``TaskBuilder``).
+
+Key design translation (SURVEY.md §7 stage 8): the reference needs the
+task encoding because its persistent kernel *interprets* task structs at
+runtime and a device scoreboard orders producers/consumers
+(kernels/task_context.py). Under XLA the whole decode step compiles into
+one program, so ordering is SSA dataflow and the "scoreboard" is the
+compiler's dependence graph — the task graph here exists at *build* time:
+it records ops + buffers, resolves dependencies (native toposort /
+wavefronts, mega/native.py), and the executor emits one fused jit
+program. Launch-overhead parity with the persistent megakernel comes from
+replaying that single compiled program per step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Callable, Sequence
+
+import numpy as np
+
+from triton_dist_tpu.mega import native
+
+
+@dataclasses.dataclass
+class Task:
+    """One node (reference TaskBase: task_type ≙ op, layer_id/tag in name)."""
+    id: int
+    op: str
+    name: str
+    fn: Callable
+    inputs: tuple[str, ...]
+    outputs: tuple[str, ...]
+    meta: dict
+
+
+class TaskGraph:
+    """Append-only op recorder + dependency resolver."""
+
+    def __init__(self):
+        self.tasks: list[Task] = []
+        self._producer: dict[str, int] = {}
+
+    def add(self, op: str, fn: Callable, inputs: Sequence[str],
+            outputs: Sequence[str], name: str | None = None,
+            **meta) -> tuple[str, ...]:
+        tid = len(self.tasks)
+        task = Task(id=tid, op=op, name=name or f"{op}_{tid}", fn=fn,
+                    inputs=tuple(inputs), outputs=tuple(outputs), meta=meta)
+        for o in task.outputs:
+            if o in self._producer:
+                raise ValueError(f"buffer {o!r} written twice (SSA only)")
+            self._producer[o] = tid
+        self.tasks.append(task)
+        return task.outputs
+
+    # -- dependency resolution (reference ModelBuilder dep resolution) -----
+    def edges(self) -> np.ndarray:
+        """(E, 2) producer→consumer edges via buffer names."""
+        es = []
+        for t in self.tasks:
+            for i in t.inputs:
+                p = self._producer.get(i)
+                if p is not None and p != t.id:
+                    es.append((p, t.id))
+        return np.asarray(sorted(set(es)), np.int32).reshape(-1, 2)
+
+    def order(self) -> np.ndarray:
+        return native.toposort(len(self.tasks), self.edges())
+
+    def waves(self) -> tuple[int, np.ndarray]:
+        return native.wavefronts(len(self.tasks), self.edges())
+
+    def queue_assignment(self, n_queues: int,
+                         policy: str = "zigzag") -> np.ndarray:
+        """Static queue assignment in execution order (reference
+        ``enque_tasks`` core/scheduler.py:86). On TPU this is
+        observability/parity metadata — execution order is the fused
+        program's schedule."""
+        costs = [t.meta.get("cost", 1) for t in self.tasks]
+        return native.schedule(len(self.tasks), n_queues, policy,
+                               costs=costs)
+
+    # -- execution ---------------------------------------------------------
+    def make_executor(self, input_names: Sequence[str],
+                      output_names: Sequence[str]) -> Callable:
+        """Build ``run(*inputs) -> outputs`` executing tasks in topological
+        order — trace it under ``jax.jit`` to get the single fused
+        program (the MEGA kernel analog, core/code_generator.py:31-92)."""
+        order = [self.tasks[i] for i in self.order()]
+        input_names = tuple(input_names)
+        output_names = tuple(output_names)
+
+        def run(*args):
+            env = dict(zip(input_names, args, strict=True))
+            for t in order:
+                res = t.fn(*[env[i] for i in t.inputs])
+                if not isinstance(res, tuple):
+                    res = (res,)
+                env.update(zip(t.outputs, res, strict=True))
+            outs = tuple(env[o] for o in output_names)
+            return outs if len(outs) > 1 else outs[0]
+
+        return run
+
+    def summary(self) -> str:
+        n_waves, wave = self.waves()
+        lines = [f"TaskGraph: {len(self.tasks)} tasks, {n_waves} waves"]
+        for t in self.tasks:
+            lines.append(
+                f"  [{t.id:3d}] w{wave[t.id]:<3d} {t.op:<12s} {t.name} "
+                f"{list(t.inputs)} -> {list(t.outputs)}")
+        return "\n".join(lines)
