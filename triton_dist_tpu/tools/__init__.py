@@ -1,0 +1,17 @@
+"""Tooling (reference L9: python/triton_dist/tools/ + kernel-side aids):
+AOT export (``aot.py`` ≙ compile_aot.py), distributed-synchronized
+autotuner (``autotuner.py`` ≙ kernels/nvidia/autotuner.py), SOL perf
+models (``perf_model.py`` ≙ gemm_perf_model.py / comm_perf_model.py),
+profiling (``profiler.py`` ≙ utils.py group_profile).
+"""
+
+from triton_dist_tpu.tools.autotuner import autotune, TuneResult  # noqa: F401
+from triton_dist_tpu.tools.perf_model import (  # noqa: F401
+    ChipSpec, get_chip_spec, estimate_gemm_sol_time_ms,
+    estimate_all_gather_time_ms, estimate_reduce_scatter_time_ms,
+    estimate_all_reduce_time_ms, overlap_efficiency)
+from triton_dist_tpu.tools.profiler import (  # noqa: F401
+    group_profile, annotate, trace_files)
+from triton_dist_tpu.tools.aot import (  # noqa: F401
+    aot_export, aot_load, aot_compile_spaces, save_artifacts,
+    load_artifact)

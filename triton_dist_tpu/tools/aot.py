@@ -1,0 +1,75 @@
+"""AOT compilation/export of jitted programs.
+
+TPU-native redesign of the reference's AOT toolchain (L9:
+python/triton_dist/tools/compile_aot.py ``@aot_compile_spaces`` generating
+C sources per (kernel × config) + a CUDA-driver runtime
+triton_aot_runtime.cc, used to launch flash-decode from C++ without
+Python, flash_decode.py:979-1130).
+
+The XLA-native equivalent is ``jax.export``: a jitted function lowers to
+a serialized StableHLO artifact that any PJRT runtime (C++, Python, TF)
+can load and run without re-tracing. ``aot_compile_spaces`` maps to
+exporting one artifact per declared signature (symbolic shapes cover the
+reference's dynamic ``M`` dimension spaces).
+"""
+
+from __future__ import annotations
+
+import os
+from collections.abc import Callable, Sequence
+
+import jax
+from jax import export as jax_export
+
+
+def aot_export(fn: Callable, example_args: Sequence,
+               platforms: Sequence[str] | None = None) -> bytes:
+    """Trace + lower ``fn`` for ``example_args`` and serialize (reference
+    per-signature C source generation, compile_aot.py:61-115)."""
+    exp = jax_export.export(
+        jax.jit(fn),
+        platforms=list(platforms) if platforms else None,
+    )(*jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+        if hasattr(a, "shape") else a, tuple(example_args)))
+    return bytes(exp.serialize())
+
+
+def aot_load(blob: bytes) -> Callable:
+    """Deserialize an exported artifact into a callable (reference
+    registry.cc lookup + triton_aot_runtime launch)."""
+    exp = jax_export.deserialize(blob)
+    return exp.call
+
+
+def aot_compile_spaces(spaces: dict):
+    """Decorator declaring named export spaces (API parity with the
+    reference's ``@aot_compile_spaces``, compile_aot.py:61): each entry
+    maps a space name to example args. ``fn.aot_artifacts()`` exports
+    them all."""
+    def wrap(fn):
+        def aot_artifacts(platforms=None) -> dict[str, bytes]:
+            return {name: aot_export(fn, args, platforms=platforms)
+                    for name, args in spaces.items()}
+        fn.aot_artifacts = aot_artifacts
+        fn.aot_spaces = spaces
+        return fn
+    return wrap
+
+
+def save_artifacts(artifacts: dict[str, bytes], out_dir: str) -> list[str]:
+    """Write artifacts to ``<out_dir>/<name>.jaxexport`` (reference
+    gen_aot_code.sh output tree)."""
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    for name, blob in artifacts.items():
+        p = os.path.join(out_dir, f"{name}.jaxexport")
+        with open(p, "wb") as f:
+            f.write(blob)
+        paths.append(p)
+    return paths
+
+
+def load_artifact(path: str) -> Callable:
+    with open(path, "rb") as f:
+        return aot_load(f.read())

@@ -1,0 +1,75 @@
+"""Distributed-synchronized autotuner.
+
+TPU-native redesign of the reference's ``ContextualAutoTuner``
+(python/triton_dist/kernels/nvidia/autotuner.py:43-250: sweeps configs
+with barriers interleaved so ALL ranks pick the same config — a rank
+divergence would deadlock the fused kernels' signal protocols).
+
+Same hazard here: shard_map programs with different tuning params on
+different hosts would compile different collectives. The sweep is
+SPMD-deterministic (every process times the same candidates in the same
+order) and the winner is broadcast from process 0
+(``multihost_utils.broadcast_one_to_all``) so divergent clocks can't
+split the decision.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Callable, Sequence
+
+import jax
+import numpy as np
+
+from triton_dist_tpu.runtime.utils import perf_func
+
+_CACHE: dict = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneResult:
+    config: dict
+    avg_ms: float
+    all_ms: tuple
+
+
+def clear_cache():
+    _CACHE.clear()
+
+
+def autotune(make_fn: Callable[..., Callable], configs: Sequence[dict],
+             key: str | None = None, iters: int = 20,
+             warmup_iters: int = 5) -> TuneResult:
+    """Pick the fastest config.
+
+    Args:
+      make_fn: config-kwargs → zero-arg callable running the op (the
+        analog of re-launching the Triton kernel per config).
+      configs: candidate dicts (reference per-op config tables, e.g.
+        ``matmul_get_configs`` allgather_gemm.py:396).
+      key: cache key — one sweep per key per process (reference caches on
+        the Autotuner instance).
+    Returns the winning TuneResult (same on every process).
+    """
+    if key is not None and key in _CACHE:
+        return _CACHE[key]
+
+    times = []
+    for cfg in configs:
+        fn = make_fn(**cfg)
+        _, ms = perf_func(fn, iters=iters, warmup_iters=warmup_iters,
+                          return_output=False)
+        times.append(ms)
+
+    best = int(np.argmin(times))
+    if jax.process_count() > 1:
+        # Rank-0's choice wins everywhere (reference: synchronized sweep +
+        # identical pick; we make the agreement explicit).
+        from jax.experimental import multihost_utils
+        best = int(multihost_utils.broadcast_one_to_all(
+            np.int32(best)))
+    result = TuneResult(config=dict(configs[best]), avg_ms=times[best],
+                        all_ms=tuple(times))
+    if key is not None:
+        _CACHE[key] = result
+    return result

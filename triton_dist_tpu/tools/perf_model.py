@@ -1,0 +1,116 @@
+"""Speed-of-light performance models for TPU compute and ICI collectives.
+
+TPU-native redesign of the reference's perf models
+(python/triton_dist/kernels/nvidia/gemm_perf_model.py:232
+``estimate_gemm_sol_time_ms`` and comm_perf_model.py:94-116
+``estimate_all_gather_time_ms`` / ``estimate_reduce_scatter_time_ms``
+against probed NVLink/PCIe bandwidth). The reference budgets SMs between
+GEMM and comm with these; on TPU the analog decision is whether overlap
+is compute- or bandwidth-bound per shape (``overlap_efficiency``), which
+drives method choice (e.g. ring vs one-shot, ops/allgather.py).
+
+Chip tables are public-spec numbers; ``probe_*`` measure the live system
+(the analog of the reference's topology probes utils.py:823-967).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    name: str
+    bf16_tflops: float          # MXU peak, bf16
+    hbm_gbps: float             # HBM bandwidth GB/s
+    ici_gbps_per_link: float    # per-direction per-link ICI GB/s
+    ici_links: int              # torus links per chip
+
+
+# Public-spec table (order matters: first matching substring wins).
+CHIP_SPECS = {
+    "v6": ChipSpec("v6e", 918.0, 1640.0, 100.0, 4),
+    "v5p": ChipSpec("v5p", 459.0, 2765.0, 100.0, 6),
+    "v5e": ChipSpec("v5e", 197.0, 819.0, 50.0, 4),
+    "v4": ChipSpec("v4", 275.0, 1228.0, 50.0, 6),
+    "cpu": ChipSpec("cpu-sim", 1.0, 50.0, 10.0, 2),
+}
+
+
+def get_chip_spec(device=None) -> ChipSpec:
+    """Identify the local chip (reference topology probes)."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, spec in CHIP_SPECS.items():
+        if key in kind:
+            return spec
+    return CHIP_SPECS["cpu"]
+
+
+def estimate_gemm_sol_time_ms(m: int, n: int, k: int,
+                              spec: ChipSpec | None = None,
+                              dtype_bytes: int = 2) -> float:
+    """max(FLOP-bound, HBM-bound) GEMM time (reference
+    gemm_perf_model.py:232)."""
+    spec = spec or get_chip_spec()
+    flops = 2.0 * m * n * k
+    t_flops = flops / (spec.bf16_tflops * 1e12)
+    bytes_moved = dtype_bytes * (m * k + k * n + m * n)
+    t_mem = bytes_moved / (spec.hbm_gbps * 1e9)
+    return max(t_flops, t_mem) * 1e3
+
+
+def _ring_time_s(nbytes_per_rank: int, world: int, link_gbps: float,
+                 n_hops: int) -> float:
+    return (nbytes_per_rank * n_hops) / (link_gbps * 1e9)
+
+
+def estimate_all_gather_time_ms(nbytes_per_rank: int, world: int,
+                                spec: ChipSpec | None = None,
+                                bidir: bool = True) -> float:
+    """Ring AG over ICI: (w-1) hops of the shard per direction (reference
+    comm_perf_model.py:94)."""
+    spec = spec or get_chip_spec()
+    hops = (world - 1 + 1) // 2 if bidir else world - 1
+    return _ring_time_s(nbytes_per_rank, world,
+                        spec.ici_gbps_per_link, hops) * 1e3
+
+
+def estimate_reduce_scatter_time_ms(nbytes_per_rank: int, world: int,
+                                    spec: ChipSpec | None = None,
+                                    bidir: bool = True) -> float:
+    """Ring RS ≙ AG mirror (reference comm_perf_model.py:116)."""
+    return estimate_all_gather_time_ms(nbytes_per_rank, world, spec, bidir)
+
+
+def estimate_all_reduce_time_ms(nbytes: int, world: int,
+                                spec: ChipSpec | None = None) -> float:
+    """RS + AG decomposition."""
+    per = nbytes // max(world, 1)
+    return (estimate_all_gather_time_ms(per, world, spec)
+            + estimate_reduce_scatter_time_ms(per, world, spec))
+
+
+def overlap_efficiency(gemm_ms: float, comm_ms: float) -> float:
+    """Upper bound on fused-op gain: serial/(overlapped) time ratio. 1.0 =
+    no win, 2.0 = perfect hiding of the shorter phase (the BASELINE.md
+    ≥90% overlap-efficiency north star divides measured by this bound)."""
+    serial = gemm_ms + comm_ms
+    overlapped = max(gemm_ms, comm_ms)
+    return serial / overlapped
+
+
+def probe_matmul_tflops(m: int = 4096, n: int = 4096, k: int = 4096,
+                        dtype=None, iters: int = 10) -> float:
+    """Measured MXU throughput (the live analog of the spec table)."""
+    import jax.numpy as jnp
+    from triton_dist_tpu.runtime.utils import perf_func
+    dtype = dtype or jnp.bfloat16
+    a = jnp.ones((m, k), dtype)
+    b = jnp.ones((k, n), dtype)
+    f = jax.jit(lambda: a @ b)
+    _, ms = perf_func(f, iters=iters, warmup_iters=3, return_output=False)
+    return 2.0 * m * n * k / (ms * 1e-3) / 1e12

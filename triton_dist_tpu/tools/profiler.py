@@ -1,0 +1,70 @@
+"""Profiling helpers: per-host traces with rank-0 collection.
+
+TPU-native redesign of the reference's tracing subsystem
+(python/triton_dist/utils.py: ``group_profile`` context manager :505-592
+writing per-rank chrome traces and merging them on rank 0 via
+``gather_object`` + ``_merge_json``; ``get_torch_prof_ctx`` :262). On TPU
+the tracer is ``jax.profiler`` (XPlane/TensorBoard): each host writes its
+own trace under ``<dir>/<name>/host<idx>/``; the merge step of the
+reference collapses to pointing TensorBoard/xprof at the shared
+directory, which overlays all hosts' timelines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import os
+
+import jax
+
+
+@contextlib.contextmanager
+def group_profile(name: str = "trace", out_dir: str = "/tmp/tdt_profile",
+                  enabled: bool = True):
+    """Profile the enclosed region on every host (reference
+    ``group_profile`` utils.py:505)."""
+    if not enabled:
+        yield None
+        return
+    path = os.path.join(out_dir, name, f"host{jax.process_index()}")
+    os.makedirs(path, exist_ok=True)
+    with jax.profiler.trace(path):
+        yield path
+
+
+def trace_files(name: str = "trace",
+                out_dir: str = "/tmp/tdt_profile") -> list[str]:
+    """List the collected per-host trace artifacts (the reference merges
+    into one JSON; xprof reads the directory tree directly)."""
+    pattern = os.path.join(out_dir, name, "host*", "**", "*")
+    return sorted(p for p in glob.glob(pattern, recursive=True)
+                  if os.path.isfile(p))
+
+
+@contextlib.contextmanager
+def annotate(label: str):
+    """Named region inside a trace (reference launch_metadata hooks,
+    allgather_gemm.py:145-155)."""
+    with jax.profiler.TraceAnnotation(label):
+        yield
+
+
+def decode_profile_hook(engine, steps: int = 64, name: str = "decode",
+                        out_dir: str = "/tmp/tdt_profile"):
+    """Profile N decode steps of an Engine (reference engine.py:153-179
+    64-step decode profile). Returns the trace dir."""
+    import jax.numpy as jnp
+
+    with group_profile(name, out_dir) as path:
+        params = getattr(engine, "_profile_params")
+        caches = engine.kv.init()
+        token = jnp.zeros((engine.kv.batch,), jnp.int32)
+        if engine._decode_step is None:
+            engine._decode_step = engine._build_decode_step()
+        key = jax.random.PRNGKey(0)
+        for s in range(steps):
+            token, caches = engine._decode_step(
+                params, caches, token, jnp.int32(s), key)
+        jax.block_until_ready(token)
+    return path
