@@ -101,6 +101,29 @@ def test_fast_all_to_all(mesh8, impl):
                                           sent[src, dst, :n])
 
 
+def test_moe_align_block_size_native_matches_numpy():
+    from triton_dist_tpu.ops import moe_utils as mu
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 4, size=37).astype(np.int32)
+    assert mu._moe_native() is not None, "C++ moe_align failed to build"
+    native = mu.moe_align_block_size(ids, 4, 8)
+    # force the numpy fallback path
+    saved = mu._MOE_LIB
+    mu._MOE_LIB = None
+    try:
+        pyver = mu.moe_align_block_size(ids, 4, 8)
+    finally:
+        mu._MOE_LIB = saved
+    for k in native:
+        np.testing.assert_array_equal(native[k], pyver[k], err_msg=k)
+    # invariants: order sorts ids stably; offsets tile-aligned
+    sorted_ids = ids[native["sorted_order"]]
+    assert (np.diff(sorted_ids) >= 0).all()
+    assert (native["padded_offsets"] % 8 == 0).all()
+    assert len(native["block_expert"]) == sum(
+        -(-c // 8) for c in native["expert_counts"])
+
+
 def test_grouped_matmul_matches_loop(key):
     t, kdim, n, e = 32, 16, 24, 4
     x = jax.random.normal(key, (t, kdim), jnp.float32)

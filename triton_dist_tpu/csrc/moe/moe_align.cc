@@ -1,0 +1,64 @@
+// MoE token→block alignment for grouped-GEMM tile scheduling.
+//
+// TPU-native equivalent of the reference's CUDA host util
+// `moe_ag_scatter_align_block_size_kernel` (csrc/lib/moe_utils.cu:61) and
+// the CPU threadblock swizzle reference
+// (kernels/nvidia/threadblock_swizzle_ag_moe.cc): given per-pair expert
+// ids, produce (a) a stable expert-sorted row order, (b) per-expert row
+// segments padded up to the GEMM tile size, and (c) the block→expert map
+// a tiled grouped-GEMM kernel iterates over. Used for host-side schedule
+// planning of Pallas grouped-GEMM kernels (the XLA ragged_dot path does
+// this internally; explicit kernels need the plan). C++ like the
+// reference's; ctypes-bound (no pybind11 in this image).
+//
+// Build: g++ -shared -fPIC -O2 -o libtdtmoe.so moe_align.cc
+
+#include <cstdint>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// Inputs: n_pairs expert ids in [0, n_experts) (id == n_experts allowed =
+// invalid sentinel, sorted last, not padded).
+// Outputs:
+//   sorted_order[n_pairs]    — stable expert-ascending permutation
+//   expert_counts[n_experts] — rows per expert
+//   padded_offsets[n_experts+1] — cumulative tile-aligned row offsets
+//   block_expert[cap_blocks] — expert id per GEMM row-block (filled up to
+//                              return value; caller sizes it with
+//                              sum(ceil(count/block)) <= n_pairs +
+//                              n_experts extra blocks worst case)
+// Returns the number of blocks, or -1 if cap_blocks is too small.
+int32_t tdt_moe_align_block_size(int32_t n_pairs, const int32_t* expert_ids,
+                                 int32_t n_experts, int32_t block_size,
+                                 int32_t* sorted_order,
+                                 int32_t* expert_counts,
+                                 int32_t* padded_offsets,
+                                 int32_t* block_expert,
+                                 int32_t cap_blocks) {
+  std::vector<int32_t> counts(n_experts + 1, 0);
+  for (int32_t i = 0; i < n_pairs; ++i) counts[expert_ids[i]]++;
+
+  // stable counting sort by expert id
+  std::vector<int32_t> pos(n_experts + 2, 0);
+  for (int32_t e = 0; e <= n_experts; ++e) pos[e + 1] = pos[e] + counts[e];
+  std::vector<int32_t> cursor(pos.begin(), pos.end() - 1);
+  for (int32_t i = 0; i < n_pairs; ++i)
+    sorted_order[cursor[expert_ids[i]]++] = i;
+
+  int32_t n_blocks = 0;
+  int32_t off = 0;
+  for (int32_t e = 0; e < n_experts; ++e) {
+    expert_counts[e] = counts[e];
+    padded_offsets[e] = off;
+    int32_t blocks = (counts[e] + block_size - 1) / block_size;
+    if (n_blocks + blocks > cap_blocks) return -1;
+    for (int32_t b = 0; b < blocks; ++b) block_expert[n_blocks++] = e;
+    off += blocks * block_size;
+  }
+  padded_offsets[n_experts] = off;
+  return n_blocks;
+}
+
+}  // extern "C"

@@ -144,6 +144,75 @@ def sort_by_group(values: jax.Array, group_ids: jax.Array, num_groups: int):
     return values[order], sizes, unsort
 
 
+def moe_align_block_size(expert_ids, num_experts: int, block_size: int):
+    """Host-side grouped-GEMM tile plan (reference
+    ``moe_ag_scatter_align_block_size`` csrc/lib/moe_utils.cu:61 + CPU
+    swizzle threadblock_swizzle_ag_moe.cc): stable expert-sorted order,
+    per-expert counts, tile-padded offsets, and the block→expert map an
+    explicit tiled grouped-GEMM kernel iterates. Native C++ via ctypes
+    (csrc/moe/moe_align.cc) with a numpy fallback.
+
+    Returns dict(sorted_order, expert_counts, padded_offsets,
+    block_expert) — numpy arrays (host planning, like the reference).
+    """
+    import numpy as np
+    ids = np.ascontiguousarray(np.asarray(expert_ids).reshape(-1), np.int32)
+    n = ids.shape[0]
+    lib = _moe_native()
+    if lib is not None:
+        import ctypes
+        cap = n + num_experts
+        order = np.empty(n, np.int32)
+        counts = np.empty(num_experts, np.int32)
+        offsets = np.empty(num_experts + 1, np.int32)
+        blocks = np.empty(cap, np.int32)
+        p = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        nb = lib.tdt_moe_align_block_size(
+            n, p(ids), num_experts, block_size, p(order), p(counts),
+            p(offsets), p(blocks), cap)
+        assert nb >= 0
+        return {"sorted_order": order, "expert_counts": counts,
+                "padded_offsets": offsets, "block_expert": blocks[:nb]}
+    # numpy fallback (bit-identical; tests assert so)
+    order = np.argsort(ids, kind="stable").astype(np.int32)
+    counts = np.bincount(ids[ids < num_experts],
+                         minlength=num_experts).astype(np.int32)
+    nblk = -(-counts // block_size)
+    offsets = np.zeros(num_experts + 1, np.int32)
+    offsets[1:] = np.cumsum(nblk * block_size)
+    block_expert = np.repeat(np.arange(num_experts, dtype=np.int32), nblk)
+    return {"sorted_order": order, "expert_counts": counts,
+            "padded_offsets": offsets, "block_expert": block_expert}
+
+
+_MOE_LIB = None
+_MOE_TRIED = False
+
+
+def _moe_native():
+    global _MOE_LIB, _MOE_TRIED
+    if _MOE_TRIED:
+        return _MOE_LIB
+    _MOE_TRIED = True
+    import ctypes
+    import os
+    import subprocess
+    src = os.path.abspath(os.path.join(
+        os.path.dirname(__file__), "..", "csrc", "moe", "moe_align.cc"))
+    so = os.path.join(os.path.dirname(src), "libtdtmoe.so")
+    try:
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            subprocess.run(["g++", "-shared", "-fPIC", "-O2", "-o", so, src],
+                           check=True, capture_output=True)
+        lib = ctypes.CDLL(so)
+        lib.tdt_moe_align_block_size.restype = ctypes.c_int32
+        _MOE_LIB = lib
+    except (OSError, subprocess.CalledProcessError):
+        _MOE_LIB = None
+    return _MOE_LIB
+
+
 def topk_reduce(per_pair_out: jax.Array, weights: jax.Array) -> jax.Array:
     """Weighted sum over the top-k expert outputs per token (reference
     topk-reduce kernel, csrc/lib/moe_utils.cu:195).
