@@ -1,0 +1,76 @@
+"""Race-detector tests (framework extension — the reference has no
+sanitizer, SURVEY.md §5; we verify the fused kernels' signal protocols
+with the interpreter's vector-clock detector)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import triton_dist_tpu.language as dl
+from triton_dist_tpu.ops.common import comm_params, resolve_interpret
+from triton_dist_tpu.testing.race import race_check, races_were_found
+
+
+def test_fused_ops_race_free(mesh8, key):
+    """AG-GEMM + GEMM-RS signal protocols pass the race detector."""
+    from triton_dist_tpu.ops.allgather_gemm import (
+        create_ag_gemm_context, ag_gemm)
+
+    a = jax.device_put(jax.random.normal(key, (16, 32), jnp.float32),
+                       NamedSharding(mesh8, P("tp")))
+    b = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (32, 64), jnp.float32),
+        NamedSharding(mesh8, P(None, "tp")))
+    with race_check():
+        out = ag_gemm(a, b, create_ag_gemm_context(mesh8, "tp"),
+                      impl="pallas")
+        jax.block_until_ready(out)
+
+
+def _racy_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis, world):
+    """Deliberately broken: writes into the peer WITHOUT the peer waiting
+    on the recv semaphore before reading — a missing-wait race."""
+    me = lax.axis_index(axis)
+    right = lax.rem(me + 1, world)
+    dl.barrier_all(axis)
+    dl.remote_copy(x_ref.at[:], o_ref.at[:], right, send_sem, recv_sem,
+                   axis=axis).start()
+    # BUG: read o_ref before waiting for the incoming DMA.
+    o_ref[:] = o_ref[:] * 1.0
+    dl.remote_copy(x_ref.at[:], o_ref.at[:], me, send_sem, recv_sem,
+                   axis=axis).wait_recv()
+    dl.remote_copy(x_ref.at[:], o_ref.at[:], right, send_sem, recv_sem,
+                   axis=axis).wait_send()
+
+
+def test_detector_catches_missing_wait(mesh8):
+    world = 8
+    kernel = functools.partial(_racy_kernel, axis="tp", world=world)
+
+    def body(xs):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(xs.shape, xs.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.SemaphoreType.DMA,
+                            pltpu.SemaphoreType.DMA],
+            compiler_params=comm_params(collective_id=9, world=world),
+            interpret=resolve_interpret(None),
+        )(xs)
+
+    x = jax.device_put(jnp.ones((16, 128), jnp.float32),
+                       NamedSharding(mesh8, P("tp")))
+    with pytest.raises(AssertionError, match="race"):
+        with race_check():
+            out = jax.shard_map(body, mesh=mesh8, in_specs=P("tp"),
+                                out_specs=P("tp"), check_vma=False)(x)
+            jax.block_until_ready(out)
+    assert races_were_found()

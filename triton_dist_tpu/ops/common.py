@@ -18,14 +18,22 @@ def resolve_interpret(interpret: bool | None):
 
     Interpreted kernels simulate remote DMA + semaphores on a multi-device
     CPU mesh — the framework's single-process distributed test mode.
+
+    With ``TDT_DETECT_RACES=1`` the interpreter's vector-clock race
+    detector is enabled: missing semaphore waits in kernel signal
+    protocols are reported as data races. This is the framework's race
+    sanitizer — the reference has no equivalent (SURVEY.md §5 "no custom
+    sanitizer"; it relies on sleep-injection + stress runs).
     """
+    import os
     if interpret is None:
         interpret = default_interpret()
     if interpret:
         from triton_dist_tpu.runtime.interpret_compat import (
             patch_interpreter_spin)
         patch_interpreter_spin()
-        return pltpu.InterpretParams()
+        return pltpu.InterpretParams(
+            detect_races=bool(os.environ.get("TDT_DETECT_RACES")))
     return False
 
 
