@@ -1,0 +1,54 @@
+"""Two-level (ICI+DCN) collective tests on a 4x2 mesh (reference
+test_reduce_scatter.py 2D paths, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.ops.hierarchical import (
+    all_gather_2d, all_reduce_2d, create_hier_context, reduce_scatter_2d)
+
+
+@pytest.fixture()
+def mesh2d(devices):
+    return Mesh(np.array(devices).reshape(2, 4), ("dcn", "ici"))
+
+
+def test_all_gather_2d(mesh2d, key):
+    ctx = create_hier_context(mesh2d)
+    x = jax.random.normal(key, (16, 32), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh2d, P(("dcn", "ici"))))
+    out = all_gather_2d(xs, ctx)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_reduce_scatter_2d(mesh2d, key):
+    ctx = create_hier_context(mesh2d)
+    x = jax.random.normal(key, (16, 8), jnp.float32)
+    out = reduce_scatter_2d(x, ctx)
+    # every device contributed the same replicated x → sum = 8 * x
+    np.testing.assert_allclose(np.asarray(out), 8 * np.asarray(x),
+                               rtol=1e-5)
+
+
+def test_all_reduce_2d(mesh2d, key):
+    ctx = create_hier_context(mesh2d)
+    x = jax.random.normal(key, (16, 8), jnp.float32)
+    out = all_reduce_2d(x, ctx)
+    np.testing.assert_allclose(np.asarray(out), 8 * np.asarray(x),
+                               rtol=1e-5)
+
+
+def test_all_reduce_2d_matches_flat(mesh2d, key):
+    """2-level AR must equal a flat psum over both axes."""
+    ctx = create_hier_context(mesh2d)
+    x = jax.random.normal(key, (8, 8), jnp.float32)
+
+    def flat(xs):
+        return jax.lax.psum(xs, ("dcn", "ici"))
+    ref = jax.shard_map(flat, mesh=mesh2d, in_specs=P(), out_specs=P(),
+                        check_vma=False)(x)
+    out = all_reduce_2d(x, ctx)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)

@@ -1,0 +1,111 @@
+"""Two-level (hierarchical) collectives over ICI + DCN mesh axes.
+
+TPU-native redesign of the reference's 2D intra/inter-node collectives
+(python/triton_dist/kernels/nvidia/reduce_scatter.py:506-673: per-node
+staging buffers + intra-node ring + inter-node ring;
+low_latency_allgather.py 2d/3d multinode variants; SURVEY.md §7
+"Cross-host (DCN) one-sided ops ... the reference's 2D ring intra/inter
+split is the right template").
+
+On a multi-host TPU pod the mesh has a fast axis (ICI, within the slice)
+and a slow axis (DCN, across hosts). The two-level schedule does the
+bandwidth-heavy stage on ICI and moves only the reduced/partial data over
+DCN:
+
+- all_gather_2d:     AG over ICI first (big payload on fast links), then
+                     AG the ICI-gathered blocks over DCN.
+- reduce_scatter_2d: RS over ICI first (reduces payload by the ICI world
+                     size before it touches DCN), then RS over DCN.
+- all_reduce_2d:     RS(ici) → AR(dcn) → AG(ici): the DCN stage carries
+                     1/w_ici of the data.
+
+These compose the per-axis ``lax`` collectives so XLA emits them on the
+right transport; the fused Pallas per-axis kernels (ops/allgather,
+ops/reduce_scatter) slot in per-axis when explicit overlap is wanted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class HierCollectiveContext:
+    """Axis naming: ``inner`` = fast transport (ICI), ``outer`` = slow
+    (DCN) — the reference's intra-node / inter-node split."""
+    mesh: Mesh
+    inner: str = "ici"
+    outer: str = "dcn"
+
+    @property
+    def inner_size(self) -> int:
+        return self.mesh.shape[self.inner]
+
+    @property
+    def outer_size(self) -> int:
+        return self.mesh.shape[self.outer]
+
+
+def create_hier_context(mesh: Mesh | None = None, inner: str = "ici",
+                        outer: str = "dcn") -> HierCollectiveContext:
+    if mesh is None:
+        from triton_dist_tpu.runtime.dist import get_mesh
+        mesh = get_mesh()
+    return HierCollectiveContext(mesh=mesh, inner=inner, outer=outer)
+
+
+def _spec2(ctx):
+    # data sharded jointly over (outer, inner) on dim 0
+    return P((ctx.outer, ctx.inner))
+
+
+def all_gather_2d(x: jax.Array, ctx: HierCollectiveContext) -> jax.Array:
+    """Gather dim-0 shards across both axes: ICI stage then DCN stage
+    (reference 2D AG: intra-node ring + inter-node ring,
+    low_latency_allgather.py 2d variants)."""
+    def body(xs):
+        g_in = lax.all_gather(xs, ctx.inner, tiled=True)
+        return lax.all_gather(g_in, ctx.outer, tiled=True)
+    f = jax.shard_map(body, mesh=ctx.mesh, in_specs=_spec2(ctx),
+                      out_specs=P(), check_vma=False)
+    return f(x)
+
+
+def reduce_scatter_2d(x: jax.Array, ctx: HierCollectiveContext) -> jax.Array:
+    """Reduce-scatter replicated-per-device partials down to 2D shards:
+    ICI RS first so DCN carries 1/w_ici of the bytes (reference
+    ``reduce_scatter_2d_op`` reduce_scatter.py:857).
+
+    Note the resulting dim-0 sharding is *inner-major*
+    (``P((inner, outer))``): scattering over ICI first fixes the coarse
+    block per ICI rank, the DCN stage subdivides it — the transpose of
+    the AG layout, exactly like the reference's 2D RS whose per-node
+    staging leaves node-interleaved segments.
+    """
+    def body(xs):
+        part = lax.psum_scatter(xs, ctx.inner, scatter_dimension=0,
+                                tiled=True)
+        return lax.psum_scatter(part, ctx.outer, scatter_dimension=0,
+                                tiled=True)
+    f = jax.shard_map(body, mesh=ctx.mesh, in_specs=P(),
+                      out_specs=P((ctx.inner, ctx.outer)),
+                      check_vma=False)
+    return f(x)
+
+
+def all_reduce_2d(x: jax.Array, ctx: HierCollectiveContext) -> jax.Array:
+    """AllReduce via RS(ici) → AR(dcn) → AG(ici): minimum DCN traffic
+    (the reference's double-tree/2D AR role, allreduce.py:1101)."""
+    def body(xs):
+        part = lax.psum_scatter(xs, ctx.inner, scatter_dimension=0,
+                                tiled=True)
+        part = lax.psum(part, ctx.outer)
+        return lax.all_gather(part, ctx.inner, tiled=True)
+    f = jax.shard_map(body, mesh=ctx.mesh, in_specs=P(), out_specs=P(),
+                      check_vma=False)
+    return f(x)
