@@ -1,0 +1,37 @@
+"""Serving roundtrip test (reference model_server/chat demo, SURVEY §2.7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.models import DenseLLM, Engine, ModelConfig
+from triton_dist_tpu.serving import ChatClient, ModelServer
+
+
+def test_server_client_roundtrip(mesh8, key):
+    cfg = ModelConfig(hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=8,
+                      num_key_value_heads=8, head_dim=4, vocab_size=64,
+                      max_position_embeddings=32, dtype=jnp.float32)
+    model = DenseLLM(cfg, mesh=mesh8, axis="tp", impl="xla")
+    params = model.init(key)
+    eng = Engine(model, batch=1, max_seq=16, prefill_mode="xla_ar",
+                 decode_mode="gemm_ar")
+    srv = ModelServer(eng, params, port=0).start()
+    try:
+        client = ChatClient(srv.host, srv.port)
+        resp = client.generate_ids([[1, 2, 3]], gen_len=4)
+        assert "tokens" in resp and len(resp["tokens"][0]) == 4
+        assert resp["latency_ms"] > 0
+        # server result must equal a direct engine call
+        direct = eng.serve(params, jnp.asarray([[1, 2, 3]], jnp.int32), 4)
+        np.testing.assert_array_equal(np.asarray(resp["tokens"]),
+                                      np.asarray(direct)[:, 3:])
+        # malformed request → error response, server stays alive
+        bad = client.generate_ids("nonsense", gen_len=1)
+        assert "error" in bad
+        ok = client.generate_ids([[5]], gen_len=2)
+        assert "tokens" in ok
+        client.close()
+    finally:
+        srv.stop()

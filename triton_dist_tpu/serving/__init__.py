@@ -1,0 +1,5 @@
+"""Serving demo (reference: mega_triton_kernel/test/models/model_server.py
+socket server, chat.py client, bench_qwen3.py; SURVEY.md §2.7)."""
+
+from triton_dist_tpu.serving.server import ModelServer  # noqa: F401
+from triton_dist_tpu.serving.client import ChatClient  # noqa: F401

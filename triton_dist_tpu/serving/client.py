@@ -1,0 +1,63 @@
+"""Chat client for the ModelServer (reference chat.py,
+mega_triton_kernel/test/models/chat.py). Token-id protocol; plugs a HF
+tokenizer in when available for text chat."""
+
+from __future__ import annotations
+
+import json
+import socket
+
+
+class ChatClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8777,
+                 tokenizer=None):
+        self.addr = (host, port)
+        self.tokenizer = tokenizer
+        self._sock = socket.create_connection(self.addr)
+        self._file = self._sock.makefile("rwb")
+
+    def generate_ids(self, prompt_ids, gen_len: int = 16) -> dict:
+        req = {"prompt_ids": prompt_ids, "gen_len": gen_len}
+        self._file.write((json.dumps(req) + "\n").encode())
+        self._file.flush()
+        return json.loads(self._file.readline())
+
+    def chat(self, text: str, gen_len: int = 64) -> str:
+        assert self.tokenizer is not None, "text chat needs a tokenizer"
+        ids = self.tokenizer(text, return_tensors="np")["input_ids"]
+        resp = self.generate_ids(ids.tolist(), gen_len)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return self.tokenizer.decode(resp["tokens"][0])
+
+    def close(self):
+        self._file.close()
+        self._sock.close()
+
+
+def main():  # pragma: no cover - manual demo
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8777)
+    ap.add_argument("--tokenizer-dir", default=None)
+    args = ap.parse_args()
+    tok = None
+    if args.tokenizer_dir:
+        from transformers import AutoTokenizer
+        tok = AutoTokenizer.from_pretrained(args.tokenizer_dir)
+    client = ChatClient(args.host, args.port, tok)
+    try:
+        while True:
+            text = input("you> ")
+            if tok:
+                print("model>", client.chat(text))
+            else:
+                ids = [[int(t) for t in text.split()]]
+                print("model>", client.generate_ids(ids))
+    except (EOFError, KeyboardInterrupt):
+        client.close()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,114 @@
+"""Minimal generation server.
+
+TPU-native analog of the reference's demo server
+(python/triton_dist/mega_triton_kernel/test/models/model_server.py: a
+socket server feeding the megakernel model, with chat.py as the client).
+Protocol: newline-delimited JSON over TCP —
+
+    → {"prompt_ids": [[...]], "gen_len": 16}
+    ← {"tokens": [[...]], "latency_ms": 12.3}
+
+Text in/out (tokenizer round trip) is the client's job when a HF
+tokenizer is available; the server moves token ids only, like the
+reference's server.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+                resp = self.server.model_server._serve_request(req)
+            except Exception as e:  # report, keep serving
+                resp = {"error": repr(e)}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class ModelServer:
+    """Wraps an Engine behind a TCP JSON-lines protocol."""
+
+    def __init__(self, engine, params, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.engine = engine
+        self.params = params
+        self._lock = threading.Lock()  # one generation at a time
+        self._srv = _TCPServer((host, port), _Handler)
+        self._srv.model_server = self
+        self.host, self.port = self._srv.server_address
+        self._thread: threading.Thread | None = None
+
+    def _serve_request(self, req: dict) -> dict:
+        ids = np.asarray(req["prompt_ids"], np.int32)
+        gen_len = int(req.get("gen_len", 16))
+        with self._lock:
+            t0 = time.perf_counter()
+            out = self.engine.serve(self.params, jnp.asarray(ids), gen_len)
+            out = np.asarray(out)
+            ms = (time.perf_counter() - t0) * 1e3
+        return {"tokens": out[:, ids.shape[1]:].tolist(),
+                "latency_ms": round(ms, 3)}
+
+    def start(self):
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def main():  # pragma: no cover - manual demo
+    import argparse
+    import jax
+    from jax.sharding import Mesh
+    from triton_dist_tpu.models import AutoLLM, Engine, ModelConfig
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-dir", default=None,
+                    help="HF checkpoint dir (random tiny model if unset)")
+    ap.add_argument("--port", type=int, default=8777)
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--max-seq", type=int, default=1024)
+    args = ap.parse_args()
+
+    mesh = Mesh(np.array(jax.devices()), ("tp",))
+    if args.model_dir:
+        model, params = AutoLLM.from_pretrained(args.model_dir, mesh=mesh)
+    else:
+        cfg = ModelConfig(num_hidden_layers=2, hidden_size=256,
+                          intermediate_size=512, num_attention_heads=8,
+                          num_key_value_heads=8, head_dim=32,
+                          vocab_size=1024)
+        model = AutoLLM.build(cfg, mesh=mesh)
+        params = model.init(jax.random.PRNGKey(0))
+    eng = Engine(model, batch=args.batch, max_seq=args.max_seq)
+    srv = ModelServer(eng, params, port=args.port).start()
+    print(f"serving on {srv.host}:{srv.port}")
+    threading.Event().wait()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()
