@@ -10,6 +10,22 @@ Run (no TPU needed — CPU simulation):
       python examples/01_primitives.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# 8-device CPU simulation by default (the axon TPU plugin overrides the
+# JAX_PLATFORMS env var, so force it in-config); set TDT_EXAMPLES_ON_TPU=1
+# to run on real devices instead.
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+import jax
+
+if not os.environ.get("TDT_EXAMPLES_ON_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+
 import functools
 
 import jax
