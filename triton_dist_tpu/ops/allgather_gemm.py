@@ -51,10 +51,31 @@ class AllGatherGEMMContext:
     # Return the gathered A alongside C (the reference reuses the AG
     # workspace for attention, tp_attn.py).
     return_gathered: bool = False
+    # Kernel variant: "vmem" holds whole operands in VMEM (small shapes,
+    # lowest latency); "hbm" keeps A/B/C in HBM and streams K-tiles
+    # through double-buffered VMEM (reference-headline shapes, the analog
+    # of the reference's BLOCK_M/N/K tiling, allgather_gemm.py:417-456);
+    # "auto" picks by VMEM footprint.
+    variant: str = "auto"
+    # Tile sizes for the hbm variant (auto-shrunk to divisors of K / the
+    # per-rank row chunk).
+    block_k: int = 512
+    block_m: int = 256
+    # VMEM budget for the auto choice (bytes; ~16 MB/core minus slack).
+    vmem_budget: int = 12 * 1024 * 1024
 
     @property
     def world_size(self) -> int:
         return self.mesh.shape[self.axis]
+
+    def resolve_variant(self, m: int, k: int, n_tot: int,
+                        itemsize: int) -> str:
+        if self.variant != "auto":
+            return self.variant
+        # vmem kernel holds ag(M,K) + Bs(K,N) + Cs(M,N) + x(M/w,K)
+        footprint = itemsize * (m * k + k * n_tot + m * n_tot
+                                + (m // max(self.world_size, 1)) * k)
+        return "vmem" if footprint <= self.vmem_budget else "hbm"
 
 
 def create_ag_gemm_context(mesh: Mesh | None = None, axis: str = "tp",
@@ -134,6 +155,132 @@ def _ag_gemm_kernel(x_ref, *rest, axis: str, world: int, rows: int,
     lax.fori_loop(0, world - 1, drain, None)
 
 
+def _ag_gemm_hbm_kernel(x_hbm, b_hbm, ag_hbm, c_hbm, a_tile, b_tile, acc,
+                        c_stage, copy_sem, a_sem, b_sem, c_sem, send_sem,
+                        recv_sem, *, axis: str, world: int, rows: int,
+                        k: int, k_blk: int, m_blk: int, acc_dtype):
+    """HBM-resident ring AG-GEMM: operands never fully enter VMEM.
+
+    Ring protocol identical to ``_ag_gemm_kernel`` (per-chunk DMA
+    semaphores, barrier before first remote write) but the AG workspace
+    lives in HBM and each chunk's GEMM streams (m_blk, k_blk)·(k_blk, N)
+    tiles through double-buffered VMEM — the TPU shape of the reference's
+    persistent tiled consumer (kernel_consumer_gemm_persistent,
+    allgather_gemm.py:158-264): its ``dl.wait`` per M-tile becomes the
+    chunk-boundary ``wait_recv``; its BLOCK_M/BLOCK_K loops become the
+    tile DMA pipeline; rank-rotated consumption order is preserved.
+    """
+    me = lax.axis_index(axis)
+    right = lax.rem(me + 1, world)
+    k_tiles = k // k_blk
+    m_tiles = rows // m_blk
+    per_chunk = m_tiles * k_tiles
+    total = world * per_chunk
+
+    # local shard → ag[me] (HBM→HBM DMA)
+    cp = pltpu.make_async_copy(x_hbm, ag_hbm.at[pl.ds(me * rows, rows), :],
+                               copy_sem)
+    cp.start()
+    cp.wait()
+    if world > 1:
+        dl.barrier_all(axis)
+
+    def chunk_of(i):
+        return lax.rem(me - i // per_chunk + world, world)
+
+    def row_of(i):
+        """First AG row of iteration i's (chunk, m-tile)."""
+        mt = lax.rem(i, per_chunk) // k_tiles
+        return chunk_of(i) * rows + mt * m_blk
+
+    def chunk_copy(idx):
+        return dl.remote_copy(
+            ag_hbm.at[pl.ds(idx * rows, rows), :],
+            ag_hbm.at[pl.ds(idx * rows, rows), :],
+            right, send_sem.at[idx], recv_sem.at[idx], axis=axis)
+
+    def a_dma(slot, i):
+        return pltpu.make_async_copy(
+            ag_hbm.at[pl.ds(row_of(i), m_blk),
+                      pl.ds(lax.rem(i, k_tiles) * k_blk, k_blk)],
+            a_tile.at[slot], a_sem.at[slot])
+
+    def b_dma(slot, i):
+        return pltpu.make_async_copy(
+            b_hbm.at[pl.ds(lax.rem(i, k_tiles) * k_blk, k_blk), :],
+            b_tile.at[slot], b_sem.at[slot])
+
+    def ring_advance(j):
+        """At chunk boundary j: ensure the chunk has arrived, then keep it
+        moving round the ring — the forward overlaps this whole chunk's
+        tile compute."""
+        s = j // per_chunk
+
+        @pl.when((j < total) & (lax.rem(j, per_chunk) == 0))
+        def _():
+            if world > 1:
+                @pl.when(s > 0)
+                def _():
+                    chunk_copy(chunk_of(j)).wait_recv()
+
+                @pl.when(s < world - 1)
+                def _():
+                    chunk_copy(chunk_of(j)).start()
+
+    ring_advance(0)
+    a_dma(0, 0).start()
+    b_dma(0, 0).start()
+
+    def step(i, _):
+        slot = lax.rem(i, 2)
+        nxt = lax.rem(i + 1, 2)
+        ring_advance(i + 1)
+
+        @pl.when(i + 1 < total)
+        def _():
+            a_dma(nxt, i + 1).start()
+            b_dma(nxt, i + 1).start()
+
+        a_dma(slot, i).wait()
+        b_dma(slot, i).wait()
+        kt = lax.rem(i, k_tiles)
+
+        partial = jnp.dot(a_tile[slot], b_tile[slot],
+                          preferred_element_type=acc_dtype)
+
+        @pl.when(kt == 0)
+        def _():
+            acc[:] = partial
+
+        @pl.when(kt > 0)
+        def _():
+            acc[:] = acc[:] + partial
+
+        @pl.when(kt == k_tiles - 1)
+        def _():
+            c_stage[:] = acc[:].astype(c_stage.dtype)
+            cw = pltpu.make_async_copy(
+                c_stage, c_hbm.at[pl.ds(row_of(i), m_blk), :], c_sem)
+            cw.start()
+            cw.wait()
+        return _
+
+    lax.fori_loop(0, total, step, None)
+
+    if world > 1:
+        def drain(s, _):
+            chunk_copy(lax.rem(me - s + world, world)).wait_send()
+            return _
+        lax.fori_loop(0, world - 1, drain, None)
+
+
+def _pick_block_k(k: int, want: int) -> int:
+    for cand in (want, 512, 256, 128):
+        if cand <= k and k % cand == 0:
+            return cand
+    return k
+
+
 def ag_gemm_multi(a: jax.Array, bs,
                   ctx: AllGatherGEMMContext | None = None,
                   impl: str = "pallas"):
@@ -170,6 +317,51 @@ def ag_gemm_multi(a: jax.Array, bs,
         return list(f(a, *bs))
 
     interpret = resolve_interpret(ctx.interpret)
+    n_tot_loc = sum(b.shape[1] // world for b in bs)
+    variant = ctx.resolve_variant(m, k, n_tot_loc, a.dtype.itemsize)
+
+    if variant == "hbm":
+        k_blk = _pick_block_k(k, ctx.block_k)
+        m_blk = _pick_block_k(rows, ctx.block_m)
+        hbm_kernel = functools.partial(
+            _ag_gemm_hbm_kernel, axis=axis, world=world, rows=rows, k=k,
+            k_blk=k_blk, m_blk=m_blk, acc_dtype=ctx.acc_dtype)
+
+        def body(xs, *ws):
+            wcat = ws[0] if n_b == 1 else jnp.concatenate(ws, axis=1)
+            ag, ccat = pl.pallas_call(
+                hbm_kernel,
+                out_shape=(jax.ShapeDtypeStruct((m, k), a.dtype),
+                           jax.ShapeDtypeStruct((m, n_tot_loc), a.dtype)),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 2,
+                out_specs=(pl.BlockSpec(memory_space=pltpu.ANY),) * 2,
+                scratch_shapes=[
+                    pltpu.VMEM((2, m_blk, k_blk), a.dtype),
+                    pltpu.VMEM((2, k_blk, n_tot_loc), a.dtype),
+                    pltpu.VMEM((m_blk, n_tot_loc), ctx.acc_dtype),
+                    pltpu.VMEM((m_blk, n_tot_loc), a.dtype),
+                    pltpu.SemaphoreType.DMA,
+                    pltpu.SemaphoreType.DMA((2,)),
+                    pltpu.SemaphoreType.DMA((2,)),
+                    pltpu.SemaphoreType.DMA,
+                    pltpu.SemaphoreType.DMA((world,)),
+                    pltpu.SemaphoreType.DMA((world,)),
+                ],
+                compiler_params=comm_params(collective_id=4, world=world),
+                interpret=interpret,
+            )(xs, wcat)
+            widths = [b.shape[1] // world for b in bs]
+            cs, off = [], 0
+            for wdt in widths:
+                cs.append(lax.slice_in_dim(ccat, off, off + wdt, axis=1))
+                off += wdt
+            return tuple(cs) + ((ag,) if ctx.return_gathered else ())
+
+        f = jax.shard_map(body, mesh=mesh,
+                          in_specs=(P(axis),) + (P(None, axis),) * n_b,
+                          out_specs=out_specs, check_vma=False)
+        return list(sync_interpret(f(a, *bs), interpret))
+
     kernel = functools.partial(_ag_gemm_kernel, axis=axis, world=world,
                                rows=rows, acc_dtype=ctx.acc_dtype, n_b=n_b)
 
