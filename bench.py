@@ -1,47 +1,156 @@
 """Benchmark entry point (driver-run on real TPU hardware).
 
-Benches the flagship fused TP-MLP forward (AG-GEMM + GEMM-RS collective
-matmul path) against the unfused XLA baseline — the reference's headline
-e2e MLP benchmark (docs/getting-started/e2e/e2e_dense.md:21, M=2048:
-0.885 ms fused vs 1.077 ms torch on 8×H800).
+Round-2 contract (VERDICT.md "what's weak" 1): this script must NEVER let
+a backend failure kill the perf story — backend init is retried with
+backoff and every sub-benchmark failure degrades to a field in the JSON
+rather than rc!=0.
 
-Timing methodology: the real-TPU environment here is a *tunneled* single
-chip that executes lazily and dedupes unread results, so each mode is
-timed as a self-chained step (``x = mlp(x)`` with a bounded renorm, the
-renorm cost identical in both modes) and the per-step cost is the slope
-between two chained runs (runtime/utils.perf_func_chained).
+What it benches (BASELINE.md north star: per-op TFLOPS + overlap
+efficiency; reference headline e2e_dense.md:21):
+  * ``ag_gemm``  — fused AllGather-GEMM Pallas kernel vs the XLA
+    all_gather+dot baseline, TFLOPS per chip.
+  * ``gemm_rs``  — fused GEMM-ReduceScatter vs XLA dot+psum_scatter.
+  * ``tp_mlp``   — the round-1 headline metric (fused MLP fwd ms), kept
+    for cross-round comparability.
+On a single chip (the tunneled bench environment) the collective parts
+collapse, so the numbers measure Mosaic-kernel vs XLA compute quality;
+on a real slice the same code measures overlap.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-``vs_baseline`` is the speedup of the fused path over the XLA baseline on
-the same hardware (>1.0 is a win; the reference's own headline ratio for
-this class of shape is 1.216×).
+Timing: the tunneled chip executes lazily and dedupes unread results, so
+each mode is timed as a self-chained step and the per-step cost is the
+slope between two chained runs (runtime/utils.perf_func_chained).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"extras"}. ``vs_baseline`` > 1.0 means the fused/Pallas path beats the
+XLA baseline on the same hardware.
 """
 
 from __future__ import annotations
 
 import json
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+import time
+import traceback
 
 
-def main():
-    from triton_dist_tpu.layers.tp_mlp import TPMLP
-    from triton_dist_tpu.runtime.platform import is_tpu
+def _probe_backend_subprocess(timeout_s: float) -> bool:
+    """Probe backend init in a THROWAWAY subprocess with a hard deadline.
+
+    Two failure modes make in-process retry useless (round-1 postmortem):
+    the tunneled PJRT plugin can *hang* in make_c_api_client (no
+    exception ever reaches a retry loop), and jax caches backend init
+    failures so a second in-process jax.devices() cannot recover. A
+    subprocess gives both a kill-able deadline and a fresh cache."""
+    import subprocess
+    import sys
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print(len(d))"],
+            timeout=timeout_s, capture_output=True, text=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _init_backend(retries: int = 3, probe_timeout_s: float = 240.0,
+                  backoff_s: float = 30.0):
+    """Return jax.devices(), but only attempt in-process init after a
+    subprocess probe has confirmed the backend actually comes up."""
+    for attempt in range(retries):
+        if _probe_backend_subprocess(probe_timeout_s):
+            import jax
+            return jax.devices()
+        if attempt < retries - 1:
+            time.sleep(backoff_s * (attempt + 1))
+    raise RuntimeError(
+        f"backend never initialized within {retries} probe attempts")
+
+
+def _bench_ag_gemm(mesh, n, extras):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from triton_dist_tpu.ops.allgather_gemm import (
+        create_ag_gemm_context, ag_gemm)
     from triton_dist_tpu.runtime.utils import perf_func_chained
 
-    devices = jax.devices()
-    on_tpu = is_tpu()
-    # Bench over every real chip available; CI/laptops fall back to a single
-    # (interpreted) device so the script still produces a line.
-    n = len(devices) if on_tpu else 1
-    mesh = Mesh(np.array(devices[:n]), ("tp",))
+    m, k, nn = 2048, 4096, 4096
+    ctx = create_ag_gemm_context(mesh, "tp", interpret=False)
+    a0 = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(0), (m, k), jnp.float32
+                          ).astype(jnp.bfloat16),
+        NamedSharding(mesh, P("tp")))
+    b = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (k, nn), jnp.float32
+                          ).astype(jnp.bfloat16),
+        NamedSharding(mesh, P(None, "tp")))
+
+    def make_step(impl):
+        @jax.jit
+        def step(a):
+            c = ag_gemm(a, b, ctx, impl=impl)
+            # fold C back to A's shape so the step chains; the fold cost
+            # is identical across impls.
+            return c[:, :k].astype(jnp.float32).astype(jnp.bfloat16) * 1e-3
+        return step
+
+    flops = 2.0 * m * k * nn  # every chip computes full M x its N-shard;
+    # per-chip flops: 2*M*K*(N/n) * ... with column sharding each chip does
+    # 2*M*K*N/n; report per-chip TFLOPS.
+    t_pallas = perf_func_chained(make_step("pallas"), a0, (8, 24))
+    t_xla = perf_func_chained(make_step("xla"), a0, (8, 24))
+    tflops = flops / max(n, 1) / (t_pallas * 1e-3) / 1e12
+    extras["ag_gemm_pallas_ms"] = round(t_pallas, 4)
+    extras["ag_gemm_xla_ms"] = round(t_xla, 4)
+    extras["ag_gemm_tflops"] = round(tflops, 2)
+    extras["ag_gemm_vs_xla"] = round(t_xla / t_pallas, 4)
+    return tflops, t_xla / t_pallas
+
+
+def _bench_gemm_rs(mesh, n, extras):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from triton_dist_tpu.ops.gemm_reduce_scatter import (
+        create_gemm_rs_context, gemm_rs)
+    from triton_dist_tpu.runtime.utils import perf_func
+
+    m, k, nn = 2048, 4096, 4096
+    ctx = create_gemm_rs_context(mesh, "tp", interpret=False)
+    a0 = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(0), (m, k), jnp.float32
+                          ).astype(jnp.bfloat16),
+        NamedSharding(mesh, P(None, "tp")))
+    b = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (k, nn), jnp.float32
+                          ).astype(jnp.bfloat16),
+        NamedSharding(mesh, P("tp")))
+
+    # gemm_rs changes shape (M, K) -> (M/w rows), so self-chaining is not
+    # possible; time with a fixed input instead (output read per step).
+    t_ms = {}
+    for impl in ("pallas", "xla"):
+        f = jax.jit(lambda a, impl=impl: gemm_rs(a, b, ctx, impl=impl))
+        _ = jax.block_until_ready(f(a0))
+        _, ms = perf_func(lambda f=f: f(a0), iters=16, warmup_iters=4)
+        t_ms[impl] = ms
+    flops = 2.0 * m * k * nn
+    tflops = flops / max(n, 1) / (t_ms["pallas"] * 1e-3) / 1e12
+    extras["gemm_rs_pallas_ms"] = round(t_ms["pallas"], 4)
+    extras["gemm_rs_xla_ms"] = round(t_ms["xla"], 4)
+    extras["gemm_rs_tflops"] = round(tflops, 2)
+    extras["gemm_rs_vs_xla"] = round(t_ms["xla"] / t_ms["pallas"], 4)
+    return tflops, t_ms["xla"] / t_ms["pallas"]
+
+
+def _bench_tp_mlp(mesh, n, on_tpu, extras):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from triton_dist_tpu.layers.tp_mlp import TPMLP
+    from triton_dist_tpu.runtime.utils import perf_func_chained
 
     if on_tpu:
-        # Reference-headline-class shape (e2e_dense.md:21); the hbm kernel
-        # variant streams K/M tiles so VMEM no longer caps the shape.
         m, hidden, inter = 2048, 4096, 12288 // max(n, 8) * n
         iters = (16, 48)
     else:
@@ -58,21 +167,62 @@ def main():
         @jax.jit
         def step(x):
             y = mlp(params, x, mode=mode).astype(jnp.float32)
-            # bounded renorm so the chain can't overflow bf16; identical
-            # cost in both modes.
             scale = 8.0 / jnp.maximum(jnp.sqrt(jnp.mean(y * y)), 1e-3)
             return (y * scale).astype(jnp.bfloat16)
         return step
 
-    t_fused_ms = perf_func_chained(make_step("ag_rs"), x0, iters)
-    t_base_ms = perf_func_chained(make_step("xla"), x0, iters)
+    t_fused = perf_func_chained(make_step("ag_rs"), x0, iters)
+    t_base = perf_func_chained(make_step("xla"), x0, iters)
+    extras["tp_mlp_fused_ms"] = round(t_fused, 4)
+    extras["tp_mlp_xla_ms"] = round(t_base, 4)
+    extras["tp_mlp_vs_xla"] = round(t_base / t_fused, 4)
+    return t_fused, t_base / t_fused
 
-    print(json.dumps({
-        "metric": "tp_mlp_fused_ms",
-        "value": round(t_fused_ms, 4),
-        "unit": "ms",
-        "vs_baseline": round(t_base_ms / t_fused_ms, 4),
-    }))
+
+def main():
+    extras: dict = {}
+    result = {"metric": "ag_gemm_tflops", "value": None, "unit": "TFLOPS",
+              "vs_baseline": None, "extras": extras}
+    try:
+        import numpy as np
+        devices = _init_backend()
+        import jax
+        from jax.sharding import Mesh
+        from triton_dist_tpu.runtime.platform import is_tpu
+        on_tpu = is_tpu()
+        n = len(devices) if on_tpu else 1
+        mesh = Mesh(np.array(devices[:n]), ("tp",))
+        extras["n_devices"] = n
+        extras["device_kind"] = getattr(devices[0], "device_kind", "?")
+
+        for name, fn in (
+                ("ag_gemm", lambda: _bench_ag_gemm(mesh, n, extras)),
+                ("gemm_rs", lambda: _bench_gemm_rs(mesh, n, extras)),
+                ("tp_mlp", lambda: _bench_tp_mlp(mesh, n, on_tpu, extras)),
+        ):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — partial output over rc!=0
+                extras[name + "_error"] = \
+                    traceback.format_exc().strip().splitlines()[-1][:200]
+
+        if "ag_gemm_tflops" in extras:
+            result["value"] = extras["ag_gemm_tflops"]
+            result["vs_baseline"] = extras["ag_gemm_vs_xla"]
+        elif "gemm_rs_tflops" in extras:
+            result = {"metric": "gemm_rs_tflops",
+                      "value": extras["gemm_rs_tflops"], "unit": "TFLOPS",
+                      "vs_baseline": extras["gemm_rs_vs_xla"],
+                      "extras": extras}
+        elif "tp_mlp_fused_ms" in extras:
+            result = {"metric": "tp_mlp_fused_ms",
+                      "value": extras["tp_mlp_fused_ms"], "unit": "ms",
+                      "vs_baseline": extras["tp_mlp_vs_xla"],
+                      "extras": extras}
+    except Exception:  # noqa: BLE001 — emit partial JSON, never rc!=0
+        extras["fatal"] = traceback.format_exc().strip().splitlines()[-1][:300]
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -14,7 +14,8 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.ops.flash_decode import (
-    create_flash_decode_context, gqa_fwd_batch_decode)
+    create_flash_decode_context, gqa_fwd_batch_decode,
+    gqa_fwd_batch_decode_paged)
 from triton_dist_tpu.ops.sp_attention import (
     create_sp_attention_context, sp_ag_attention, zigzag_reorder,
     zigzag_restore)
@@ -73,7 +74,62 @@ def test_flash_decode_single_rank_kv(mesh8, key):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.parametrize("impl", ["xla", "ring", "pallas"])
+@pytest.mark.parametrize("kv_len", [41, 128, 3])
+def test_flash_decode_tiled(mesh8, kv_len, key):
+    """Tiled split-KV variant (KV streamed from HBM in t_blk tiles with
+    online softmax) vs the dense golden — VERDICT r1 item 2 gate."""
+    b, hq, hkv, d, t = 2, 8, 4, 32, 128   # t_loc = 16 per rank
+    q = jax.random.normal(key, (b, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, t, hkv, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, t, hkv, d), jnp.float32)
+    ctx = create_flash_decode_context(mesh8, "tp", variant="tiled", t_blk=8)
+    ks = jax.device_put(k, NamedSharding(mesh8, P(None, "tp")))
+    vs = jax.device_put(v, NamedSharding(mesh8, P(None, "tp")))
+    out = gqa_fwd_batch_decode(q, ks, vs, jnp.int32(kv_len), ctx,
+                               impl="pallas")
+    ref = attention_golden(q[:, None], k[:, :kv_len], v[:, :kv_len],
+                           causal=False)[:, 0]
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_decode_paged(mesh8, key):
+    """Paged pool + block_table indirection matches the dense golden
+    (reference block_table paged decode, flash_decode.py:136,:203)."""
+    w, b, hq, hkv, d = 8, 2, 8, 4, 32
+    page, n_pages = 8, 4                  # t_loc = 32/rank, t = 256
+    t = w * page * n_pages
+    kv_len = 177
+    rng = np.random.default_rng(0)
+    k = rng.standard_normal((b, t, hkv, d), np.float32)
+    v = rng.standard_normal((b, t, hkv, d), np.float32)
+    q = jax.random.normal(key, (b, hq, d), jnp.float32)
+
+    # Scatter each device's slice into a shuffled local pool.
+    p_loc = b * n_pages + 3               # a few spare slots
+    pool_k = np.zeros((w * p_loc, page, hkv, d), np.float32)
+    pool_v = np.zeros((w * p_loc, page, hkv, d), np.float32)
+    table = np.zeros((w, b, n_pages), np.int32)
+    for r in range(w):
+        slots = rng.permutation(p_loc)[:b * n_pages].reshape(b, n_pages)
+        for bi in range(b):
+            for pi in range(n_pages):
+                lo = r * page * n_pages + pi * page
+                pool_k[r * p_loc + slots[bi, pi]] = k[bi, lo:lo + page]
+                pool_v[r * p_loc + slots[bi, pi]] = v[bi, lo:lo + page]
+        table[r] = slots
+
+    ctx = create_flash_decode_context(mesh8, "tp")
+    sh = NamedSharding(mesh8, P("tp"))
+    out = gqa_fwd_batch_decode_paged(
+        q, jax.device_put(jnp.asarray(pool_k), sh),
+        jax.device_put(jnp.asarray(pool_v), sh),
+        jax.device_put(jnp.asarray(table), sh), jnp.int32(kv_len), ctx)
+    ref = attention_golden(q[:, None], k[:, :kv_len], v[:, :kv_len],
+                           causal=False)[:, 0]
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("impl", ["xla", "ring", "pallas", "ag_pallas"])
 @pytest.mark.parametrize("causal", [True, False])
 def test_sp_prefill_attention(mesh8, impl, causal, key):
     b, s, hq, hkv, d = 2, 64, 4, 2, 16
@@ -84,6 +140,25 @@ def test_sp_prefill_attention(mesh8, impl, causal, key):
     sh = NamedSharding(mesh8, P(None, "tp"))
     out = sp_ag_attention(jax.device_put(q, sh), jax.device_put(k, sh),
                           jax.device_put(v, sh), ctx, impl=impl)
+    ref = attention_golden(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=3e-4, atol=3e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_sp_fused_multi_tile(mesh8, causal, key):
+    """Fused kernel with several KV subtiles and q tiles per chunk
+    (n_sub=2, n_q=2) — exercises the double-buffered subtile DMA loop."""
+    from triton_dist_tpu.ops.sp_attention import sp_ag_attention_fused
+    b, s, hq, hkv, d = 1, 256, 4, 2, 16
+    q = jax.random.normal(key, (b, s, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(8), (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(9), (b, s, hkv, d), jnp.float32)
+    ctx = create_sp_attention_context(mesh8, "tp", causal=causal)
+    sh = NamedSharding(mesh8, P(None, "tp"))
+    out = sp_ag_attention_fused(jax.device_put(q, sh),
+                                jax.device_put(k, sh),
+                                jax.device_put(v, sh), ctx,
+                                sq_blk=16, t_sub=16)
     ref = attention_golden(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=3e-4, atol=3e-4)
 

@@ -29,7 +29,9 @@ extern "C" {
 //                              return value; caller sizes it with
 //                              sum(ceil(count/block)) <= n_pairs +
 //                              n_experts extra blocks worst case)
-// Returns the number of blocks, or -1 if cap_blocks is too small.
+// Returns the number of blocks, -1 if cap_blocks is too small, or -2 if
+// any expert id is outside [0, n_experts] (matching the numpy fallback,
+// which never indexes out of range).
 int32_t tdt_moe_align_block_size(int32_t n_pairs, const int32_t* expert_ids,
                                  int32_t n_experts, int32_t block_size,
                                  int32_t* sorted_order,
@@ -38,7 +40,10 @@ int32_t tdt_moe_align_block_size(int32_t n_pairs, const int32_t* expert_ids,
                                  int32_t* block_expert,
                                  int32_t cap_blocks) {
   std::vector<int32_t> counts(n_experts + 1, 0);
-  for (int32_t i = 0; i < n_pairs; ++i) counts[expert_ids[i]]++;
+  for (int32_t i = 0; i < n_pairs; ++i) {
+    if (expert_ids[i] < 0 || expert_ids[i] > n_experts) return -2;
+    counts[expert_ids[i]]++;
+  }
 
   // stable counting sort by expert id
   std::vector<int32_t> pos(n_experts + 2, 0);

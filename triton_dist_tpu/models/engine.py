@@ -73,6 +73,8 @@ class Engine:
         Returns (B, S + gen_len) (reference ``Engine.serve``
         engine.py:113-190)."""
         b, s = input_ids.shape
+        if gen_len <= 0:
+            return input_ids
         self.kv.reset()
         caches = self.kv.init()
 

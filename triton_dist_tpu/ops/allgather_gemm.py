@@ -35,7 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
-    comm_params, resolve_interpret, sync_interpret)
+    any_spec, comm_params, resolve_interpret, sync_interpret)
 
 
 @dataclasses.dataclass
@@ -210,6 +210,10 @@ def _ag_gemm_hbm_kernel(x_hbm, b_hbm, ag_hbm, c_hbm, a_tile, b_tile, acc,
             b_hbm.at[pl.ds(lax.rem(i, k_tiles) * k_blk, k_blk), :],
             b_tile.at[slot], b_sem.at[slot])
 
+    def c_dma(slot, row):
+        return pltpu.make_async_copy(
+            c_stage.at[slot], c_hbm.at[pl.ds(row, m_blk), :], c_sem.at[slot])
+
     def ring_advance(j):
         """At chunk boundary j: ensure the chunk has arrived, then keep it
         moving round the ring — the forward overlaps this whole chunk's
@@ -258,14 +262,24 @@ def _ag_gemm_hbm_kernel(x_hbm, b_hbm, ag_hbm, c_hbm, a_tile, b_tile, acc,
 
         @pl.when(kt == k_tiles - 1)
         def _():
-            c_stage[:] = acc[:].astype(c_stage.dtype)
-            cw = pltpu.make_async_copy(
-                c_stage, c_hbm.at[pl.ds(row_of(i), m_blk), :], c_sem)
-            cw.start()
-            cw.wait()
+            # Double-buffered writeback: stage into the alternate slot and
+            # let the DMA drain while the next m-tile computes; only wait
+            # for this slot's *previous* writeback (2 m-tiles ago).
+            mi = i // k_tiles
+            cslot = lax.rem(mi, 2)
+
+            @pl.when(mi >= 2)
+            def _():
+                c_dma(cslot, row_of(i)).wait()
+            c_stage[cslot] = acc[:].astype(c_stage.dtype)
+            c_dma(cslot, row_of(i)).start()
         return _
 
     lax.fori_loop(0, total, step, None)
+
+    # Drain the outstanding C writebacks (one per slot in flight).
+    for s in range(min(2, world * m_tiles)):
+        c_dma(s, 0).wait()
 
     if world > 1:
         def drain(s, _):
@@ -333,17 +347,17 @@ def ag_gemm_multi(a: jax.Array, bs,
                 hbm_kernel,
                 out_shape=(jax.ShapeDtypeStruct((m, k), a.dtype),
                            jax.ShapeDtypeStruct((m, n_tot_loc), a.dtype)),
-                in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 2,
-                out_specs=(pl.BlockSpec(memory_space=pltpu.ANY),) * 2,
+                in_specs=[any_spec()] * 2,
+                out_specs=(any_spec(),) * 2,
                 scratch_shapes=[
                     pltpu.VMEM((2, m_blk, k_blk), a.dtype),
                     pltpu.VMEM((2, k_blk, n_tot_loc), a.dtype),
                     pltpu.VMEM((m_blk, n_tot_loc), ctx.acc_dtype),
-                    pltpu.VMEM((m_blk, n_tot_loc), a.dtype),
+                    pltpu.VMEM((2, m_blk, n_tot_loc), a.dtype),
                     pltpu.SemaphoreType.DMA,
                     pltpu.SemaphoreType.DMA((2,)),
                     pltpu.SemaphoreType.DMA((2,)),
-                    pltpu.SemaphoreType.DMA,
+                    pltpu.SemaphoreType.DMA((2,)),
                     pltpu.SemaphoreType.DMA((world,)),
                     pltpu.SemaphoreType.DMA((world,)),
                 ],

@@ -80,7 +80,7 @@ def vmem_spec(block_shape=None, index_map=None):
 
 
 def any_spec():
-    return pl.BlockSpec(memory_space=pltpu.ANY)
+    return pl.BlockSpec(memory_space=pl.ANY)
 
 
 def cdiv(a: int, b: int) -> int:

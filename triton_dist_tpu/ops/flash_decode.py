@@ -1,11 +1,13 @@
-"""Distributed flash-decode: split-KV GQA decode with cross-rank
-partial-softmax combine.
+"""Distributed flash-decode: tiled split-KV GQA decode with paged KV and
+cross-rank partial-softmax combine.
 
 TPU-native redesign of the reference's distributed flash-decode
 (python/triton_dist/kernels/nvidia/flash_decode.py: split-KV batch decode
-kernels :130-393, intra-rank combine :393-482, **inter-rank combine**
-merging (m, l, acc) partial softmax states through symmetric buffers
-:482-566; host wrappers :763-1130; scaling claim 1→32 GPUs README.md:203).
+kernels :130-393 with paged KV via block_table/page_size :136,:203,
+persistent variant :587, intra-rank combine :393-482, **inter-rank
+combine** merging (m, l, acc) partial softmax states through symmetric
+buffers :482-566; host wrappers :763-1130; scaling claim 1→32 GPUs
+README.md:203).
 
 Design: the KV cache is sequence-sharded over the SP axis. Each device
 computes an *unnormalized* flash partial over its shard:
@@ -15,6 +17,23 @@ computes an *unnormalized* flash partial over its shard:
 and the cross-rank combine is the associative log-sum-exp merge
 
     out = Σ_r a_r e^{m_r - m*} / Σ_r l_r e^{m_r - m*},  m* = max_r m_r.
+
+Local-partial variants (``FlashDecodeContext.variant``):
+  * ``tiled``  — the real kernel: KV stays in HBM; (B, t_blk, D) tiles
+    per KV head stream through double-buffered VMEM feeding an
+    online-softmax loop. Never materializes (B, K, G, T) scores, so
+    T ≥ 64k per device fits. The single long-running kernel is the
+    analog of the reference's persistent variant (:587); the tile DMA
+    pipeline replaces its split-KV grid.
+  * ``einsum`` — whole-shard scores in one batched einsum; lowest
+    latency for short caches that fit VMEM.
+  * ``auto``   — picks by KV-shard byte size.
+
+Paged KV (``gqa_fwd_batch_decode_paged``): the cache is a physical page
+pool (P, page_size, Hkv, D); ``block_table[b, i]`` maps sequence b's
+i-th logical page to a pool slot (reference block_table/page_table
+indirection, flash_decode.py:136,:203). Tiles are DMA'd page-by-page via
+the table — t_blk == page_size.
 
 ``impl="xla"``: partials via one batched einsum; merge via ``pmax`` +
 ``psum`` (3 scalar-sized collectives — the reference needs a second
@@ -37,7 +56,8 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
-from triton_dist_tpu.ops.common import comm_params, resolve_interpret, sync_interpret
+from triton_dist_tpu.ops.common import (
+    any_spec, comm_params, resolve_interpret, sync_interpret)
 
 _NEG = -1e30
 
@@ -49,23 +69,36 @@ class FlashDecodeContext:
     mesh: Mesh
     axis: str = "sp"
     interpret: bool | None = None
+    # Local-partial variant: "tiled" | "einsum" | "auto" (by shard bytes).
+    variant: str = "auto"
+    # KV positions per VMEM tile for the tiled variant (dense path).
+    t_blk: int = 512
+    # Byte threshold for auto: einsum below (shard fits VMEM comfortably).
+    einsum_max_bytes: int = 4 * 1024 * 1024
 
     @property
     def world_size(self) -> int:
         return self.mesh.shape[self.axis]
 
+    def resolve_variant(self, shard_bytes: int) -> str:
+        if self.variant != "auto":
+            return self.variant
+        return "einsum" if shard_bytes <= self.einsum_max_bytes else "tiled"
+
 
 def create_flash_decode_context(mesh: Mesh | None = None, axis: str = "sp",
-                                interpret: bool | None = None
-                                ) -> FlashDecodeContext:
+                                interpret: bool | None = None,
+                                variant: str = "auto",
+                                t_blk: int = 512) -> FlashDecodeContext:
     if mesh is None:
         from triton_dist_tpu.runtime.dist import get_mesh
         mesh = get_mesh()
-    return FlashDecodeContext(mesh=mesh, axis=axis, interpret=interpret)
+    return FlashDecodeContext(mesh=mesh, axis=axis, interpret=interpret,
+                              variant=variant, t_blk=t_blk)
 
 
 def _local_partials(q, k, v, first_pos, kv_len, groups: int):
-    """Unnormalized flash partial over one KV shard.
+    """Unnormalized flash partial over one KV shard (einsum variant).
 
     q: (B, Hq, D); k/v: (B, T, Hkv, D); positions of the shard are
     ``first_pos + [0, T)``; only positions < ``kv_len`` are live.
@@ -94,23 +127,13 @@ def _merge(a, l, m):
     return num / jnp.maximum(den, 1e-20)[..., None]
 
 
-def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, abuf, lbuf, mbuf,
-                   send_sem, recv_sem, *, axis: str, world: int,
-                   groups: int, t_loc: int):
-    """Single-program distributed decode: local partial → full-mesh push of
-    (a, l, m) into per-rank slots of the combine buffers → wait → merge.
-
-    The combine buffers are the analog of the reference's symmetric
-    reduce buffers (flash_decode.py:482-566); `abuf[r]` holds rank r's
-    partial after the exchange.
-    """
+def _exchange_and_merge(abuf, lbuf, mbuf, send_sem, recv_sem, o_ref, *,
+                        axis: str, world: int):
+    """Full-mesh push of this rank's (a, l, m) partial into every peer's
+    combine-buffer slot, wait for all peers, then merge locally — the
+    symmetric-buffer exchange of the reference's inter-rank combine
+    (flash_decode.py:482-566)."""
     me = lax.axis_index(axis)
-    kv_len = len_ref[0]
-    a, l, m = _local_partials(q_ref[:], k_ref[:], v_ref[:],
-                              me * t_loc, kv_len, groups)
-    abuf[me] = a
-    lbuf[me] = l
-    mbuf[me] = m
     if world > 1:
         # Peers' buffers must exist before remote writes land.
         dl.barrier_all(axis)
@@ -144,8 +167,132 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, abuf, lbuf, mbuf,
         lax.fori_loop(1, world, drain, None)
 
     out = _merge(abuf[:], lbuf[:], mbuf[:])
-    b = q_ref.shape[0]
+    b = out.shape[0]
     o_ref[:] = out.reshape(b, -1, out.shape[-1]).astype(o_ref.dtype)
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, abuf, lbuf, mbuf,
+                   send_sem, recv_sem, *, axis: str, world: int,
+                   groups: int, t_loc: int):
+    """Einsum-variant distributed decode: whole-shard partial in VMEM →
+    cross-rank combine. Lowest latency for short caches."""
+    me = lax.axis_index(axis)
+    kv_len = len_ref[0]
+    a, l, m = _local_partials(q_ref[:], k_ref[:], v_ref[:],
+                              me * t_loc, kv_len, groups)
+    abuf[me] = a
+    lbuf[me] = l
+    mbuf[me] = m
+    _exchange_and_merge(abuf, lbuf, mbuf, send_sem, recv_sem, o_ref,
+                        axis=axis, world=world)
+
+
+def _tiled_decode_kernel(q_ref, len_ref, table_ref, k_hbm, v_hbm, o_ref,
+                         abuf, lbuf, mbuf, k_tile, v_tile, k_sem, v_sem,
+                         send_sem, recv_sem, *, axis: str, world: int,
+                         batch: int, hkv: int, groups: int, d: int,
+                         t_loc: int, t_blk: int, paged: bool):
+    """Tiled split-KV partial: stream (B, t_blk, D) K/V tiles per KV head
+    through double-buffered VMEM with an online-softmax carry, then the
+    cross-rank combine.
+
+    The KV refs live in HBM (``pl.ANY``):
+      dense: (B, T_loc, Hkv, D); tile DMA slices rows [ts, ts+t_blk).
+      paged: pool (P, page_size, Hkv, D) + ``table_ref`` (B, n_pages)
+        int32 in SMEM; tile i of sequence b reads pool[table[b, i]]
+        (reference block_table indirection, flash_decode.py:136,:203).
+
+    Per-tile trip count is *dynamic* (ceil of the live positions in this
+    rank's shard), so ranks whose shard lies past ``kv_len`` skip all
+    DMAs and compute — the split-KV early-exit of the reference's
+    persistent kernel (:587).
+    """
+    me = lax.axis_index(axis)
+    scale = d ** -0.5
+
+    # Live positions inside this rank's shard: kv_len is the sequence
+    # maximum (per-batch lens are masked per tile below).
+    kv_len = len_ref[0]
+    first_pos = me * t_loc
+    live_here = jnp.clip(kv_len - first_pos, 0, t_loc)
+    n_tiles = lax.div(live_here + t_blk - 1, t_blk)
+
+    def k_dma(slot, ti, b):
+        if paged:
+            page = table_ref[b, ti]
+            src = k_hbm.at[page, :, :, :]
+        else:
+            src = k_hbm.at[b, pl.ds(ti * t_blk, t_blk), :, :]
+        return pltpu.make_async_copy(src, k_tile.at[slot, b],
+                                     k_sem.at[slot, b])
+
+    def v_dma(slot, ti, b):
+        if paged:
+            page = table_ref[b, ti]
+            src = v_hbm.at[page, :, :, :]
+        else:
+            src = v_hbm.at[b, pl.ds(ti * t_blk, t_blk), :, :]
+        return pltpu.make_async_copy(src, v_tile.at[slot, b],
+                                     v_sem.at[slot, b])
+
+    def start_tile(slot, ti):
+        for b in range(batch):
+            k_dma(slot, ti, b).start()
+            v_dma(slot, ti, b).start()
+
+    def wait_tile(slot, ti):
+        for b in range(batch):
+            k_dma(slot, ti, b).wait()
+            v_dma(slot, ti, b).wait()
+
+    @pl.when(n_tiles > 0)
+    def _():
+        start_tile(0, 0)
+
+    def tile_step(ti, carry):
+        m_run, l_run, acc = carry
+        slot = lax.rem(ti, 2)
+
+        @pl.when(ti + 1 < n_tiles)
+        def _():
+            start_tile(lax.rem(ti + 1, 2), ti + 1)
+        wait_tile(slot, ti)
+
+        kt = k_tile[slot].astype(jnp.float32)   # (B, t_blk, Hkv, D)
+        vt = v_tile[slot].astype(jnp.float32)
+        q = q_ref[:].reshape(batch, hkv, groups, d).astype(jnp.float32)
+        # (B, K, G, D) x (B, t_blk, K, D) -> (B, K, G, t_blk)
+        scores = jnp.einsum("bkgd,btkd->bkgt", q, kt,
+                            preferred_element_type=jnp.float32) * scale
+        pos = first_pos + ti * t_blk + jnp.arange(t_blk)
+        live = pos < kv_len                                  # (t_blk,)
+        scores = jnp.where(live[None, None, None, :], scores, _NEG)
+
+        m_new = jnp.maximum(m_run, jnp.max(scores, axis=-1))
+        alpha = jnp.exp(m_run - m_new)
+        p = jnp.exp(scores - m_new[..., None]) * live[None, None, None, :]
+        l_new = l_run * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bkgt,btkd->bkgd", p, vt,
+                        preferred_element_type=jnp.float32)
+        acc_new = acc * alpha[..., None] + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((batch, hkv, groups), _NEG, jnp.float32)
+    l0 = jnp.zeros((batch, hkv, groups), jnp.float32)
+    a0 = jnp.zeros((batch, hkv, groups, d), jnp.float32)
+    m_f, l_f, a_f = lax.fori_loop(0, n_tiles, tile_step, (m0, l0, a0))
+
+    abuf[me] = a_f
+    lbuf[me] = l_f
+    mbuf[me] = m_f
+    _exchange_and_merge(abuf, lbuf, mbuf, send_sem, recv_sem, o_ref,
+                        axis=axis, world=world)
+
+
+def _combine_shapes(world, b, hkv, groups, d):
+    return (jax.ShapeDtypeStruct((world, b, hkv, groups, d), jnp.float32),
+            jax.ShapeDtypeStruct((world, b, hkv, groups), jnp.float32),
+            jax.ShapeDtypeStruct((world, b, hkv, groups), jnp.float32))
 
 
 def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
@@ -172,14 +319,14 @@ def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
     groups = hq // hkv
     kv_len = jnp.asarray(kv_len, jnp.int32)
 
-    if impl == "xla" or world == 1:
+    if impl == "xla":
         def body(qs, ks, vs, n):
             me = lax.axis_index(axis)
             a, l, m = _local_partials(qs, ks, vs, me * t_loc, n[0], groups)
             m_star = lax.pmax(m, axis)
-            scale = jnp.exp(m - m_star)
-            num = lax.psum(a * scale[..., None], axis)
-            den = lax.psum(l * scale, axis)
+            sc = jnp.exp(m - m_star)
+            num = lax.psum(a * sc[..., None], axis)
+            den = lax.psum(l * sc, axis)
             out = num / jnp.maximum(den, 1e-20)[..., None]
             return out.reshape(b, hq, d).astype(qs.dtype)
 
@@ -190,32 +337,138 @@ def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
         return f(q, cache_k, cache_v, kv_len.reshape(1))
 
     interpret = resolve_interpret(ctx.interpret)
-    kernel = functools.partial(_decode_kernel, axis=axis, world=world,
-                               groups=groups, t_loc=t_loc)
+    shard_bytes = t_loc * hkv * d * cache_k.dtype.itemsize * b
+    variant = ctx.resolve_variant(shard_bytes)
 
-    def body(qs, ks, vs, n):
+    if variant == "einsum":
+        kernel = functools.partial(_decode_kernel, axis=axis, world=world,
+                                   groups=groups, t_loc=t_loc)
+
+        def body(qs, ks, vs, n):
+            out, *_ = pl.pallas_call(
+                kernel,
+                out_shape=(jax.ShapeDtypeStruct((b, hq, d), q.dtype),)
+                + _combine_shapes(world, b, hkv, groups, d),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3 +
+                         [pl.BlockSpec(memory_space=pltpu.SMEM)],
+                out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 4),
+                scratch_shapes=[pltpu.SemaphoreType.DMA((world, 3)),
+                                pltpu.SemaphoreType.DMA((world, 3))],
+                compiler_params=comm_params(collective_id=7, world=world),
+                interpret=interpret,
+            )(qs, ks, vs, n)
+            return out
+
+        f = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(None, axis), P(None, axis), P()),
+            out_specs=P(), check_vma=False)
+        return sync_interpret(f(q, cache_k, cache_v, kv_len.reshape(1)),
+                              interpret)
+
+    # tiled variant: KV stays in HBM, dummy 1x1 table (dense addressing).
+    t_blk = min(ctx.t_blk, t_loc)
+    while t_loc % t_blk:
+        t_blk //= 2
+    kernel = functools.partial(
+        _tiled_decode_kernel, axis=axis, world=world, batch=b, hkv=hkv,
+        groups=groups, d=d, t_loc=t_loc, t_blk=t_blk, paged=False)
+
+    def body(qs, n, ks, vs):
+        table = jnp.zeros((1, 1), jnp.int32)
         out, *_ = pl.pallas_call(
             kernel,
-            out_shape=(jax.ShapeDtypeStruct((b, hq, d), q.dtype),
-                       jax.ShapeDtypeStruct((world, b, hkv, groups, d),
-                                            jnp.float32),
-                       jax.ShapeDtypeStruct((world, b, hkv, groups),
-                                            jnp.float32),
-                       jax.ShapeDtypeStruct((world, b, hkv, groups),
-                                            jnp.float32)),
-            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3 +
-                     [pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_shape=(jax.ShapeDtypeStruct((b, hq, d), q.dtype),)
+            + _combine_shapes(world, b, hkv, groups, d),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec(memory_space=pltpu.SMEM),
+                      any_spec(),
+                      any_spec()],
             out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 4),
-            scratch_shapes=[pltpu.SemaphoreType.DMA((world, 3)),
-                            pltpu.SemaphoreType.DMA((world, 3))],
+            scratch_shapes=[
+                pltpu.VMEM((2, b, t_blk, hkv, d), cache_k.dtype),
+                pltpu.VMEM((2, b, t_blk, hkv, d), cache_v.dtype),
+                pltpu.SemaphoreType.DMA((2, b)),
+                pltpu.SemaphoreType.DMA((2, b)),
+                pltpu.SemaphoreType.DMA((world, 3)),
+                pltpu.SemaphoreType.DMA((world, 3))],
             compiler_params=comm_params(collective_id=7, world=world),
             interpret=interpret,
-        )(qs, ks, vs, n)
+        )(qs, n, table, ks, vs)
         return out
 
     f = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(), P(None, axis), P(None, axis), P()),
+        in_specs=(P(), P(), P(None, axis), P(None, axis)),
         out_specs=P(), check_vma=False)
-    return sync_interpret(f(q, cache_k, cache_v, kv_len.reshape(1)),
+    return sync_interpret(f(q, kv_len.reshape(1), cache_k, cache_v),
                           interpret)
+
+
+def gqa_fwd_batch_decode_paged(q: jax.Array, pool_k: jax.Array,
+                               pool_v: jax.Array, block_table: jax.Array,
+                               kv_len: jax.Array,
+                               ctx: FlashDecodeContext | None = None
+                               ) -> jax.Array:
+    """Paged-KV distributed decode (reference paged split-KV kernels,
+    flash_decode.py:130-393 block_table/page_size :136,:203).
+
+    Sharding contract: device r of the SP axis backs global positions
+    [r*t_loc, (r+1)*t_loc) of every sequence, t_loc = n_pages*page_size.
+
+    Args:
+      q: (B, Hq, D) replicated.
+      pool_k/pool_v: (w*P_loc, page_size, Hkv, D) physical page pools,
+        dim 0 sharded over ``ctx.axis`` — each device owns P_loc slots.
+      block_table: (w, B, n_pages) int32, dim 0 sharded — device r's
+        table maps its logical page i of sequence b to a *local* slot id
+        in [0, P_loc).
+      kv_len: scalar int32 global live length.
+    Returns:
+      (B, Hq, D) replicated.
+    """
+    ctx = ctx or create_flash_decode_context()
+    mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    b, hq, d = q.shape
+    page_size, hkv = pool_k.shape[1], pool_k.shape[2]
+    assert block_table.shape[0] == world and block_table.shape[1] == b
+    n_pages = block_table.shape[2]
+    groups = hq // hkv
+    t_loc = n_pages * page_size
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+    interpret = resolve_interpret(ctx.interpret)
+
+    kernel = functools.partial(
+        _tiled_decode_kernel, axis=axis, world=world, batch=b, hkv=hkv,
+        groups=groups, d=d, t_loc=t_loc, t_blk=page_size, paged=True)
+
+    def body(qs, n, table, ks, vs):
+        out, *_ = pl.pallas_call(
+            kernel,
+            out_shape=(jax.ShapeDtypeStruct((b, hq, d), q.dtype),)
+            + _combine_shapes(world, b, hkv, groups, d),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec(memory_space=pltpu.SMEM),
+                      any_spec(),
+                      any_spec()],
+            out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 4),
+            scratch_shapes=[
+                pltpu.VMEM((2, b, page_size, hkv, d), pool_k.dtype),
+                pltpu.VMEM((2, b, page_size, hkv, d), pool_v.dtype),
+                pltpu.SemaphoreType.DMA((2, b)),
+                pltpu.SemaphoreType.DMA((2, b)),
+                pltpu.SemaphoreType.DMA((world, 3)),
+                pltpu.SemaphoreType.DMA((world, 3))],
+            compiler_params=comm_params(collective_id=7, world=world),
+            interpret=interpret,
+        )(qs, n, table.reshape(b, n_pages), ks, vs)
+        return out
+
+    f = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(), P(axis), P(axis), P(axis)),
+        out_specs=P(), check_vma=False)
+    return sync_interpret(
+        f(q, kv_len.reshape(1), block_table, pool_k, pool_v), interpret)

@@ -35,13 +35,14 @@ def grouped_matmul(tokens: jax.Array, w: jax.Array, expert_ids: jax.Array,
     Sort-by-expert + ``ragged_dot`` + unsort (the whole
     ``moe_ag_scatter_align_block_size`` pipeline in three ops). Rows with
     ``expert_ids == num_experts`` (invalid/padding) produce garbage rows
-    that callers must mask — they are routed through group 0 weights.
+    that callers must mask — they are routed through the LAST expert's
+    (``num_experts - 1``) weights.
     """
     sorted_tokens, group_sizes, unsort = sort_by_group(
         tokens, expert_ids, num_experts)
     # ragged_dot requires sum(group_sizes) == rows; padding rows (sentinel
-    # group) sit past the last real group and read as group 0 — masked by
-    # callers via `valid`.
+    # group) are folded into the last real group, so they run through
+    # expert num_experts-1's weights — masked by callers via `valid`.
     pad = tokens.shape[0] - jnp.sum(group_sizes)
     group_sizes = group_sizes.at[num_experts - 1].add(pad)
     out = lax.ragged_dot(

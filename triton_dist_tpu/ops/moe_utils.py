@@ -157,6 +157,11 @@ def moe_align_block_size(expert_ids, num_experts: int, block_size: int):
     """
     import numpy as np
     ids = np.ascontiguousarray(np.asarray(expert_ids).reshape(-1), np.int32)
+    if ids.size and (ids.min() < 0 or ids.max() > num_experts):
+        raise ValueError(
+            f"expert ids must lie in [0, {num_experts}] "
+            f"(== {num_experts} is the invalid sentinel); got "
+            f"[{ids.min()}, {ids.max()}]")
     n = ids.shape[0]
     lib = _moe_native()
     if lib is not None:
@@ -170,7 +175,7 @@ def moe_align_block_size(expert_ids, num_experts: int, block_size: int):
         nb = lib.tdt_moe_align_block_size(
             n, p(ids), num_experts, block_size, p(order), p(counts),
             p(offsets), p(blocks), cap)
-        assert nb >= 0
+        assert nb >= 0, f"tdt_moe_align_block_size failed (rc={nb})"
         return {"sorted_order": order, "expert_counts": counts,
                 "padded_offsets": offsets, "block_expert": blocks[:nb]}
     # numpy fallback (bit-identical; tests assert so)

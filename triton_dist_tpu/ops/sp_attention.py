@@ -17,9 +17,14 @@ Three implementations:
   overlaps the ppermute with the einsums).
 - ``impl="xla"``   — AG-KV golden: one ``all_gather`` of KV + a single
   masked attention pass (the reference's semantic baseline).
-- ``impl="pallas"``— AG-KV with the fused Pallas ring all-gather
-  (ops/allgather) producing KV, then the same local pass; the analog of
-  the reference's copy-engine-AG + consumer split.
+- ``impl="pallas"``— ONE fused kernel: in-kernel ring AG of KV chunks
+  (per-chunk recv semaphores — the reference's per-shard ``dl.wait``)
+  feeding a tiled flash loop that streams KV subtiles from the HBM
+  workspace (``_sp_fused_kernel``; reference
+  sp_ag_attention_inter_node.py:259-499).
+- ``impl="ag_pallas"`` — two-step: fused Pallas ring all-gather
+  (ops/allgather) producing KV, then one local masked pass; the analog
+  of the reference's copy-engine-AG + consumer split.
 
 Causal masking uses global positions (query block r holds positions
 ``r*S_loc + [0, S_loc)``), so all variants are exact for causal and full
@@ -32,14 +37,20 @@ permutation of the sequence dimension, exposed as ``zigzag_reorder`` /
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.allgather import (
     AllGatherContext, create_allgather_context, all_gather)
+from triton_dist_tpu.ops.common import (
+    any_spec, comm_params, resolve_interpret, sync_interpret)
 
 _NEG = -1e30
 
@@ -95,6 +106,213 @@ def _online_update(state, scores, v):
     acc = acc * corr[..., None] + jnp.einsum(
         "bkgst,btkd->bkgsd", p, v.astype(jnp.float32))
     return m_new, l, acc
+
+
+def _sp_fused_kernel(q_ref, k_ref, v_ref, o_ref, kw_hbm, vw_hbm, k_sub,
+                     v_sub, copy_sem, ks_sem, vs_sem, send_sem, recv_sem, *,
+                     axis: str, world: int, batch: int, s_loc: int,
+                     hkv: int, groups: int, d: int, sq_blk: int,
+                     t_sub: int, causal: bool):
+    """Fused SP prefill attention: in-kernel ring AG of KV chunks feeding
+    a tiled flash loop.
+
+    TPU shape of the reference's fused consumer
+    (sp_ag_attention_inter_node.py:259-499: flash-attn blocks that
+    ``dl.wait`` per-KV-shard signals while copy engines run the AG): the
+    per-shard signal wait becomes the chunk ``wait_recv`` at the top of
+    each ring step; the copy-engine producer becomes the in-kernel remote
+    DMA forwarding the freshest chunk while the MXU consumes it; the
+    flash inner loop streams (B, t_sub, K, D) KV subtiles from the HBM
+    workspace through double-buffered VMEM and updates per-(q-tile)
+    online-softmax state.
+
+    Causal skip: chunks whose positions all exceed every local query
+    position contribute nothing and skip compute entirely (they are
+    still forwarded — peers need them), mirroring the reference's
+    early-exit blocks.
+
+    VMEM budget: q, o, and the fp32 (m, l, acc) state are VMEM-resident
+    → s_loc·hq·d·4B must fit (~1k-4k positions/device at 8 heads); the
+    KV workspace itself is HBM so total sequence length is unbounded.
+    """
+    me = lax.axis_index(axis)
+    right = lax.rem(me + 1, world)
+    n_sub = s_loc // t_sub
+    n_q = s_loc // sq_blk
+    scale = d ** -0.5
+
+    # local chunk → workspace slot me (HBM→HBM)
+    for ref, hbm, sem_i in ((k_ref, kw_hbm, 0), (v_ref, vw_hbm, 1)):
+        cp = pltpu.make_async_copy(ref, hbm.at[me], copy_sem.at[sem_i])
+        cp.start()
+    for sem_i, (ref, hbm) in enumerate(((k_ref, kw_hbm), (v_ref, vw_hbm))):
+        pltpu.make_async_copy(ref, hbm.at[me], copy_sem.at[sem_i]).wait()
+    if world > 1:
+        dl.barrier_all(axis)
+
+    def chunk_copy(idx):
+        return [dl.remote_copy(hbm.at[idx], hbm.at[idx], right,
+                               send_sem.at[idx, i], recv_sem.at[idx, i],
+                               axis=axis)
+                for i, hbm in enumerate((kw_hbm, vw_hbm))]
+
+    def k_dma(slot, src, j):
+        return pltpu.make_async_copy(
+            kw_hbm.at[src, :, pl.ds(j * t_sub, t_sub)], k_sub.at[slot],
+            ks_sem.at[slot])
+
+    def v_dma(slot, src, j):
+        return pltpu.make_async_copy(
+            vw_hbm.at[src, :, pl.ds(j * t_sub, t_sub)], v_sub.at[slot],
+            vs_sem.at[slot])
+
+    qf = q_ref[:].reshape(batch, s_loc, hkv, groups, d).astype(jnp.float32)
+    qf = qf.transpose(0, 2, 3, 1, 4)          # (B, K, G, S_loc, D)
+
+    def consume_chunk(src, state):
+        """Fold chunk ``src`` (already in the HBM workspace) into the
+        online state, streaming KV subtiles through VMEM."""
+        k_dma(0, src, 0).start()
+        v_dma(0, src, 0).start()
+
+        def sub_step(j, state):
+            slot = lax.rem(j, 2)
+
+            @pl.when(j + 1 < n_sub)
+            def _():
+                k_dma(lax.rem(j + 1, 2), src, j + 1).start()
+                v_dma(lax.rem(j + 1, 2), src, j + 1).start()
+            k_dma(slot, src, j).wait()
+            v_dma(slot, src, j).wait()
+            kt = k_sub[slot].astype(jnp.float32)   # (B, t_sub, K, D)
+            vt = v_sub[slot].astype(jnp.float32)
+            k_first = src * s_loc + j * t_sub
+
+            m, l, acc = state
+            for i in range(n_q):                    # static q-tile loop
+                qi = lax.dynamic_slice_in_dim(qf, i * sq_blk, sq_blk, 3)
+                s_blk = jnp.einsum(
+                    "bkgsd,btkd->bkgst", qi, kt,
+                    preferred_element_type=jnp.float32) * scale
+                if causal:
+                    q_pos = (me * s_loc + i * sq_blk
+                             + jnp.arange(sq_blk))[:, None]
+                    k_pos = k_first + jnp.arange(t_sub)[None, :]
+                    s_blk = jnp.where(q_pos >= k_pos, s_blk, _NEG)
+                mi = lax.dynamic_slice_in_dim(m, i * sq_blk, sq_blk, 3)
+                li = lax.dynamic_slice_in_dim(l, i * sq_blk, sq_blk, 3)
+                ai = lax.dynamic_slice_in_dim(acc, i * sq_blk, sq_blk, 3)
+                m_new = jnp.maximum(mi, jnp.max(s_blk, axis=-1))
+                p = jnp.exp(s_blk - m_new[..., None])
+                corr = jnp.exp(mi - m_new)
+                li = li * corr + jnp.sum(p, axis=-1)
+                ai = ai * corr[..., None] + jnp.einsum(
+                    "bkgst,btkd->bkgsd", p, vt,
+                    preferred_element_type=jnp.float32)
+                m = lax.dynamic_update_slice_in_dim(m, m_new, i * sq_blk, 3)
+                l = lax.dynamic_update_slice_in_dim(l, li, i * sq_blk, 3)
+                acc = lax.dynamic_update_slice_in_dim(acc, ai,
+                                                      i * sq_blk, 3)
+            return m, l, acc
+
+        return lax.fori_loop(0, n_sub, sub_step, state)
+
+    state = (jnp.full((batch, hkv, groups, s_loc), _NEG, jnp.float32),
+             jnp.zeros((batch, hkv, groups, s_loc), jnp.float32),
+             jnp.zeros((batch, hkv, groups, s_loc, d), jnp.float32))
+
+    def ring_step(s, state):
+        cur = lax.rem(me - s + world, world)
+        nxt = lax.rem(me - s - 1 + world, world)
+        if world > 1:
+            @pl.when(s < world - 1)
+            def _():
+                for c in chunk_copy(cur):
+                    c.start()           # forward current chunk (ICI)
+        if causal:
+            # Chunks strictly in the future contribute nothing.
+            state = lax.cond(cur <= me, lambda st: consume_chunk(cur, st),
+                             lambda st: st, state)
+        else:
+            state = consume_chunk(cur, state)
+        if world > 1:
+            @pl.when(s < world - 1)
+            def _():
+                for c in chunk_copy(nxt):
+                    c.wait_recv()       # next chunk must have landed
+        return state
+
+    state = lax.fori_loop(0, world, ring_step, state)
+
+    if world > 1:
+        def drain(s, _):
+            for c in chunk_copy(lax.rem(me - s + world, world)):
+                c.wait_send()
+            return _
+        lax.fori_loop(0, world - 1, drain, None)
+
+    m, l, acc = state
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    o_ref[:] = out.transpose(0, 3, 1, 2, 4).reshape(
+        batch, s_loc, hkv * groups, d).astype(o_ref.dtype)
+
+
+def sp_ag_attention_fused(q: jax.Array, k: jax.Array, v: jax.Array,
+                          ctx: SpAttentionContext | None = None,
+                          sq_blk: int = 256, t_sub: int = 256) -> jax.Array:
+    """Single fused Pallas kernel for SP prefill attention — ``impl=
+    "pallas"`` of :func:`sp_ag_attention` routes here. See
+    :func:`_sp_fused_kernel`."""
+    ctx = ctx or create_sp_attention_context()
+    mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    groups = hq // hkv
+    assert s % world == 0
+    s_loc = s // world
+    t_sub = min(t_sub, s_loc)
+    while s_loc % t_sub:
+        t_sub //= 2
+    sq_blk = min(sq_blk, s_loc)
+    while s_loc % sq_blk:
+        sq_blk //= 2
+    interpret = resolve_interpret(ctx.interpret)
+
+    kernel = functools.partial(
+        _sp_fused_kernel, axis=axis, world=world, batch=b, s_loc=s_loc,
+        hkv=hkv, groups=groups, d=d, sq_blk=sq_blk, t_sub=t_sub,
+        causal=ctx.causal)
+
+    def body(qs, ks, vs):
+        out, *_ = pl.pallas_call(
+            kernel,
+            out_shape=(jax.ShapeDtypeStruct((b, s_loc, hq, d), q.dtype),
+                       jax.ShapeDtypeStruct((world, b, s_loc, hkv, d),
+                                            k.dtype),
+                       jax.ShapeDtypeStruct((world, b, s_loc, hkv, d),
+                                            v.dtype)),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+            out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                       any_spec(),
+                       any_spec()),
+            scratch_shapes=[
+                pltpu.VMEM((2, b, t_sub, hkv, d), k.dtype),
+                pltpu.VMEM((2, b, t_sub, hkv, d), v.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((world, 2)),
+                pltpu.SemaphoreType.DMA((world, 2)),
+            ],
+            compiler_params=comm_params(collective_id=6, world=world),
+            interpret=interpret,
+        )(qs, ks, vs)
+        return out
+
+    f = jax.shard_map(body, mesh=mesh,
+                      in_specs=(P(None, axis),) * 3,
+                      out_specs=P(None, axis), check_vma=False)
+    return sync_interpret(f(q, k, v), interpret)
 
 
 def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -166,7 +384,7 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         state = _online_update(state, scores, vc)
         return finish(state, qs.dtype)
 
-    if impl in ("xla", "ring") or world == 1:
+    if impl in ("xla", "ring"):
         body = ag_body if (impl == "xla" or world == 1) else ring_body
         f = jax.shard_map(
             body, mesh=mesh,
@@ -175,8 +393,12 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return f(q, k, v)
 
     if impl == "pallas":
-        # Fused Pallas ring AG of KV (the copy-engine producer analog),
-        # then one local masked pass.
+        # Single fused kernel: in-kernel ring AG + tiled flash consumer.
+        return sp_ag_attention_fused(q, k, v, ctx)
+
+    if impl == "ag_pallas":
+        # Two-step: fused Pallas ring AG of KV (the copy-engine producer
+        # analog), then one local masked pass.
         ag_ctx = create_allgather_context(mesh, axis,
                                           interpret=ctx.interpret)
         # Flatten KV to 2-D row-sharded layout for the AG kernel.

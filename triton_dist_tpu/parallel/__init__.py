@@ -1,0 +1,40 @@
+"""Parallelism-strategy re-export surface (reference L6 layer map,
+SURVEY.md §1).
+
+The implementations live in :mod:`triton_dist_tpu.layers`; this package
+groups them by parallelism strategy the way the reference's docs do
+(SURVEY.md §2.9 checklist): TP (dense + MoE), EP (all-to-all
+dispatch/combine), SP (AG-KV attention + distributed flash decode), and
+PP (p2p buffers + pipeline schedule).
+"""
+
+from triton_dist_tpu.layers.ep_a2a import DispatchHandle, EPAll2AllLayer
+from triton_dist_tpu.layers.p2p import CommOp
+from triton_dist_tpu.layers.sp_flash_decode import (
+    SpAttentionLayer,
+    SpFlashDecodeLayer,
+)
+from triton_dist_tpu.layers.tp_attn import TPAttn
+from triton_dist_tpu.layers.tp_mlp import TPMLP
+from triton_dist_tpu.layers.tp_moe import TPMoE
+
+# Strategy → layers index (mirrors SURVEY.md §2.9).
+TP_LAYERS = (TPMLP, TPAttn, TPMoE)
+EP_LAYERS = (EPAll2AllLayer,)
+SP_LAYERS = (SpFlashDecodeLayer, SpAttentionLayer)
+PP_LAYERS = (CommOp,)
+
+__all__ = [
+    "CommOp",
+    "DispatchHandle",
+    "EPAll2AllLayer",
+    "SpAttentionLayer",
+    "SpFlashDecodeLayer",
+    "TPAttn",
+    "TPMLP",
+    "TPMoE",
+    "TP_LAYERS",
+    "EP_LAYERS",
+    "SP_LAYERS",
+    "PP_LAYERS",
+]

@@ -84,7 +84,13 @@ def perf_func(
             t0 = time.perf_counter()
             for _ in range(n):
                 out = func()
-            _materialize_small(out)
+                # The tunnel executes lazily and dedupes unread results:
+                # every iteration must be read or the slope measures
+                # dispatch overhead only. The per-read roundtrip does NOT
+                # cancel, so this is an upper bound — prefer
+                # perf_func_chained for absolute numbers; the constant
+                # overhead still preserves config *ranking* (autotuner).
+                _materialize_small(out)
             return time.perf_counter() - t0
 
         t1 = run(iters)

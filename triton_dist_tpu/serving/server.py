@@ -60,7 +60,7 @@ class ModelServer:
 
     def _serve_request(self, req: dict) -> dict:
         ids = np.asarray(req["prompt_ids"], np.int32)
-        gen_len = int(req.get("gen_len", 16))
+        gen_len = max(0, min(int(req.get("gen_len", 16)), 4096))
         with self._lock:
             t0 = time.perf_counter()
             out = self.engine.serve(self.params, jnp.asarray(ids), gen_len)
