@@ -5,6 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.ops.allgather_gemm import ag_gemm, create_ag_gemm_context
 from triton_dist_tpu.ops.gemm_reduce_scatter import (
@@ -115,3 +116,61 @@ def test_ag_gemm_jit_grad_composes(mesh8, key):
     da = jax.grad(lambda a, b: ag_gemm(a, b, ctx, impl="xla").sum(),
                   argnums=0)(a, b)
     assert da.shape == a.shape
+
+
+def test_gemm_rs_hbm_variant(mesh8, key):
+    """HBM-streaming GEMM-RS (tiled K/M loops, travelling partials in
+    HBM) matches the xla golden."""
+    from triton_dist_tpu.ops.gemm_reduce_scatter import (
+        create_gemm_rs_context, gemm_rs)
+    m, k, n = 64, 128, 256
+    ctx = create_gemm_rs_context(mesh8, "tp")
+    ctx.variant = "hbm"
+    ctx.block_m, ctx.block_k = 8, 8
+    a = jax.random.normal(key, (m, k), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(1), (k, n), jnp.float32)
+    a_s = jax.device_put(a, NamedSharding(mesh8, P(None, "tp")))
+    b_s = jax.device_put(b, NamedSharding(mesh8, P("tp")))
+    out = gemm_rs(a_s, b_s, ctx, impl="pallas")
+    ref = gemm_rs(a_s, b_s, create_gemm_rs_context(mesh8, "tp"),
+                  impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ag_gemm_autotune_caches(mesh8, key):
+    """Autotune sweeps the config table on the first eager call and
+    caches the winner by shape (VERDICT r1 item 5)."""
+    from triton_dist_tpu.ops import allgather_gemm as agm
+    m, k, n = 32, 64, 128
+    ctx = agm.create_ag_gemm_context(mesh8, "tp")
+    ctx.autotune = True
+    a = jax.random.normal(key, (m, k), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(1), (k, n), jnp.float32)
+    a_s = jax.device_put(a, NamedSharding(mesh8, P("tp")))
+    b_s = jax.device_put(b, NamedSharding(mesh8, P(None, "tp")))
+    agm._TUNED.clear()
+    out = agm.ag_gemm(a_s, b_s, ctx, impl="pallas")
+    key_ = (m, k, n // 8, "float32", 8)
+    assert key_ in agm._TUNED, agm._TUNED
+    cfg = agm._TUNED[key_]
+    assert cfg["variant"] in ("vmem", "hbm")
+    ref = agm.ag_gemm(a_s, b_s, agm.create_ag_gemm_context(mesh8, "tp"),
+                      impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    # jitted call reuses the cache (no eager sweep possible inside trace)
+    out2 = jax.jit(lambda x, w: agm.ag_gemm(x, w, ctx))(a_s, b_s)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_gemm_rs_configs_table():
+    from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs_configs
+    cfgs = gemm_rs_configs(2048, 2048, 4096, 4096, 2, 1)
+    assert all(c["variant"] == "hbm" for c in cfgs)  # too big for vmem
+    assert len(cfgs) >= 1
+    cfgs2 = gemm_rs_configs(2048, 2048, 4096, 1024, 2, 1)
+    assert len(cfgs2) >= 2  # smaller N admits several tilings
+    small = gemm_rs_configs(64, 8, 16, 32, 4, 8)
+    assert small[0]["variant"] == "vmem"

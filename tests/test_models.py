@@ -191,3 +191,37 @@ def test_model_config_from_hf_dict():
         "num_experts": 16, "num_experts_per_tok": 4,
         "moe_intermediate_size": 64, "model_type": "qwen3_moe"})
     assert cfg.is_moe and cfg.head_dim == 32 and cfg.num_experts == 16
+
+
+def test_moe_ep_mode_matches_tp(mesh8, key):
+    """Qwen3MoE under EP (expert-sharded + a2a dispatch) matches the TP
+    model on the same weights — VERDICT r1 item 4 model gate."""
+    b, s, t = 2, 4, 16
+    tp = Qwen3MoE(tiny_moe_cfg(), mesh=mesh8, axis="tp")
+    ep = Qwen3MoE(tiny_moe_cfg(), mesh=mesh8, axis="tp", moe_parallel="ep")
+    params_tp = tp.init(key)
+    params_ep = ep.init(key)  # same key → same host values, EP sharding
+    ids = jax.random.randint(jax.random.PRNGKey(3), (b, s), 0,
+                             tp.config.vocab_size, jnp.int32)
+    ref, _ = tp.forward(params_tp, ids, _caches(tp, b, t), 0, mode="xla")
+    out, _ = ep.forward(params_ep, ids, _caches(ep, b, t), 0, mode="ep")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=3e-3,
+                               atol=3e-3)
+
+
+def test_moe_ep_engine_serve(mesh8, key):
+    """EP-mode Qwen3MoE through the Engine decode loop."""
+    from triton_dist_tpu.models.engine import Engine
+    ep = Qwen3MoE(tiny_moe_cfg(), mesh=mesh8, axis="tp", moe_parallel="ep")
+    params = ep.init(key)
+    ids = jax.random.randint(jax.random.PRNGKey(5), (2, 3), 0,
+                             ep.config.vocab_size, jnp.int32)
+    eng = Engine(ep, batch=2, max_seq=16, prefill_mode="ep",
+                 decode_mode="ep")
+    out = eng.serve(params, ids, gen_len=2)
+    assert out.shape == (2, 5)
+    tp = Qwen3MoE(tiny_moe_cfg(), mesh=mesh8, axis="tp")
+    eng_tp = Engine(tp, batch=2, max_seq=16, prefill_mode="xla_ar",
+                    decode_mode="xla_ar")
+    out_tp = eng_tp.serve(tp.init(key), ids, gen_len=2)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out_tp))

@@ -23,6 +23,7 @@ from triton_dist_tpu.ops.group_gemm import (
 from triton_dist_tpu.ops.moe_reduce_rs import (
     create_moe_rs_context, moe_reduce_rs)
 from triton_dist_tpu.layers.ep_a2a import EPAll2AllLayer
+from triton_dist_tpu.layers.ep_moe import EPMoE
 from triton_dist_tpu.layers.tp_moe import TPMoE
 
 
@@ -268,3 +269,38 @@ def test_tp_moe_vs_dense(mesh8, mode, key):
     ref = dense_moe_golden(x, full["w_router"], full["w_gate"],
                            full["w_up"], full["w_down"], topk)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_ep_moe_layer_vs_dense(mesh8, impl, key):
+    """EPMoE layer (router → dispatch → per-rank experts → combine) vs
+    the brute-force dense golden — VERDICT r1 item 4 gate."""
+    world, rows, h, i, e, topk = 8, 4, 16, 24, 16, 2
+    t = world * rows
+    layer = EPMoE(h, i, e, topk, mesh=mesh8, axis="tp",
+                  dtype=jnp.float32, impl=impl)
+    params = layer.init(key)
+    x = jax.random.normal(jax.random.PRNGKey(7), (t, h), jnp.float32) * 0.5
+    xs = jax.device_put(x, NamedSharding(mesh8, P("tp")))
+    out = layer(params, xs)
+    ref = dense_moe_golden(
+        x, params["w_router"], params["w_gate"], params["w_up"],
+        params["w_down"], topk)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-3, atol=1e-3)
+
+
+def test_ep_moe_layer_matches_tp_moe(mesh8, key):
+    """EP and TP parallelizations of the same MoE weights agree."""
+    world, rows, h, i, e, topk = 8, 4, 16, 24, 16, 2
+    t = world * rows
+    ep = EPMoE(h, i, e, topk, mesh=mesh8, axis="tp", dtype=jnp.float32)
+    tp = TPMoE(h, i, e, topk, mesh=mesh8, axis="tp", dtype=jnp.float32)
+    ep_params = ep.init(key)
+    tp_params = tp.shard_params(
+        {k: np.asarray(v) for k, v in ep_params.items()})
+    x = jax.random.normal(jax.random.PRNGKey(8), (t, h), jnp.float32) * 0.5
+    xs = jax.device_put(x, NamedSharding(mesh8, P("tp")))
+    out_ep = ep(ep_params, xs)
+    out_tp = tp(tp_params, xs, mode="ag_rs")
+    np.testing.assert_allclose(np.asarray(out_ep), np.asarray(out_tp),
+                               rtol=1e-3, atol=1e-3)

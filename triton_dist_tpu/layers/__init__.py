@@ -25,5 +25,7 @@ from triton_dist_tpu.layers.common import (  # noqa: F401
 )
 from triton_dist_tpu.layers.tp_mlp import TPMLP  # noqa: F401
 from triton_dist_tpu.layers.tp_attn import TPAttn  # noqa: F401
+from triton_dist_tpu.layers.tp_moe import TPMoE  # noqa: F401
+from triton_dist_tpu.layers.ep_moe import EPMoE  # noqa: F401
 
 FWD_MODES = ("xla", "ag_rs", "gemm_ar", "xla_ar")

@@ -1,0 +1,133 @@
+"""Expert-parallel MoE FFN layer.
+
+TPU-native equivalent of the reference's EP inference path
+(python/triton_dist/test/nvidia/test_ep_moe_inference.py, 504 LoC:
+Qwen3-MoE served with experts sharded across ranks and token routing via
+the LL all-to-all; models/qwen_moe.py:108): the router runs on local
+rows, :class:`~triton_dist_tpu.layers.ep_a2a.EPAll2AllLayer` dispatches
+each (token, expert) pair to the rank owning the expert, the rank runs
+its experts at FULL intermediate size over the received rows
+(``grouped_expert_ffn`` — sorted ``ragged_dot``), and combine returns +
+top-k-reduces the pair rows.
+
+Contrast with :class:`~triton_dist_tpu.layers.tp_moe.TPMoE`: TP shards
+every expert's intermediate dim across ranks (all ranks touch all
+experts); EP shards the expert set itself (each rank owns E/w whole
+experts) — the reference offers both, selected per deployment.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.layers.common import shard_param
+from triton_dist_tpu.layers.ep_a2a import EPAll2AllLayer
+from triton_dist_tpu.ops.group_gemm import grouped_expert_ffn
+from triton_dist_tpu.ops.moe_utils import topk_routing
+
+
+class EPMoE:
+    """Expert-parallel sparse FFN: dispatch → local experts → combine."""
+
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 num_experts: int, topk: int, mesh: Mesh | None = None,
+                 axis: str = "ep", dtype=jnp.bfloat16,
+                 impl: str = "pallas", norm_topk_prob: bool = True):
+        if mesh is None:
+            from triton_dist_tpu.runtime.dist import get_mesh
+            mesh = get_mesh()
+        self.mesh, self.axis = mesh, axis
+        self.world = mesh.shape[axis]
+        assert num_experts % self.world == 0
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_experts = num_experts
+        self.experts_per_rank = num_experts // self.world
+        self.topk = topk
+        self.dtype = dtype
+        self.impl = impl
+        self.norm_topk_prob = norm_topk_prob
+        # One a2a layer per distinct per-rank token count (prefill vs
+        # decode shapes); the reference similarly sizes its symmetric
+        # buffers by max_M and reuses them (ep_a2a_layer.py:70-90).
+        self._a2a: dict[int, EPAll2AllLayer] = {}
+
+    def set_fwd(self, mode: str):  # parity with TPMoE's interface
+        pass
+
+    def _a2a_for(self, t_loc: int) -> EPAll2AllLayer:
+        if t_loc not in self._a2a:
+            self._a2a[t_loc] = EPAll2AllLayer(
+                max_tokens=t_loc, hidden=self.hidden_size, topk=self.topk,
+                num_experts=self.num_experts, mesh=self.mesh,
+                axis=self.axis, dtype=self.dtype, impl=self.impl)
+        return self._a2a[t_loc]
+
+    # -- params (same pytree as TPMoE; EP sharding) -------------------------
+    def init(self, key: jax.Array) -> dict:
+        kr, kg, ku, kd = jax.random.split(key, 4)
+        h, i, e = self.hidden_size, self.intermediate_size, self.num_experts
+        params = {
+            "w_router": jax.random.normal(kr, (h, e), jnp.float32) * h**-0.5,
+            "w_gate": jax.random.normal(kg, (e, h, i), self.dtype) * h**-0.5,
+            "w_up": jax.random.normal(ku, (e, h, i), self.dtype) * h**-0.5,
+            "w_down": jax.random.normal(kd, (e, i, h), self.dtype) * i**-0.5,
+        }
+        return self.shard_params(params)
+
+    def shard_params(self, params: dict) -> dict:
+        m, ax = self.mesh, self.axis
+        return {
+            "w_router": shard_param(params["w_router"], m, P()),
+            # Expert dim sharded: each rank owns E/w whole experts.
+            "w_gate": shard_param(params["w_gate"], m, P(ax)),
+            "w_up": shard_param(params["w_up"], m, P(ax)),
+            "w_down": shard_param(params["w_down"], m, P(ax)),
+        }
+
+    # -- forward -----------------------------------------------------------
+    def __call__(self, params: dict, x: jax.Array,
+                 mode: str | None = None) -> jax.Array:
+        """x: (T, H) row-sharded over ``axis``; returns the same layout.
+
+        ``mode`` accepts "ep" (default, LL a2a dispatch) or "xla"
+        (dispatch/combine ride the XLA all_to_all baseline).
+
+        Rows are padded up to a multiple of the axis size (decode-size
+        batches) — pad rows carry zero weights and are sliced off."""
+        t, h = x.shape
+        t_pad = -(-t // self.world) * self.world
+        logits = x.astype(jnp.float32) @ params["w_router"]
+        weights, indices = topk_routing(logits, self.topk,
+                                        self.norm_topk_prob)
+        if t_pad != t:
+            pad = t_pad - t
+            x = jnp.concatenate([x, jnp.zeros((pad, h), x.dtype)])
+            weights = jnp.concatenate(
+                [weights, jnp.zeros((pad,) + weights.shape[1:],
+                                    weights.dtype)])
+            indices = jnp.concatenate(
+                [indices, jnp.zeros((pad,) + indices.shape[1:],
+                                    indices.dtype)])
+
+        t_loc = t_pad // self.world
+        a2a = self._a2a_for(t_loc)
+        e_loc = self.experts_per_rank
+
+        tokens, local_expert, handle = a2a.dispatch(x, indices)
+
+        def local_ffn(tok, exp, wg, wu, wd):
+            return grouped_expert_ffn(tok, wg, wu, wd, exp, e_loc)
+
+        ffn = jax.shard_map(
+            local_ffn, mesh=self.mesh,
+            in_specs=(P(self.axis), P(self.axis), P(self.axis),
+                      P(self.axis), P(self.axis)),
+            out_specs=P(self.axis), check_vma=False)
+        expert_out = ffn(tokens, local_expert, params["w_gate"],
+                         params["w_up"], params["w_down"])
+
+        out = a2a.combine(expert_out, weights, handle)
+        return out[:t] if t_pad != t else out

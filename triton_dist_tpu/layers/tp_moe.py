@@ -94,6 +94,17 @@ class TPMoE:
         logits = x.astype(jnp.float32) @ params["w_router"]
         weights, indices = topk_routing(logits, k, self.norm_topk_prob)
 
+        # Decode-size batches: pad rows to a multiple of the axis (pad
+        # rows carry zero weights and are sliced off at the end).
+        m_pad = -(-m // self.world) * self.world
+        if m_pad != m:
+            pad = m_pad - m
+            x = jnp.concatenate([x, jnp.zeros((pad, h), x.dtype)])
+            weights = jnp.concatenate(
+                [weights, jnp.zeros((pad, k), weights.dtype)])
+            indices = jnp.concatenate(
+                [indices, jnp.zeros((pad, k), indices.dtype)])
+
         impl = "xla" if mode == "xla" else self.impl
         # Fused/collective all-gather of tokens and routing ids.
         ag_x = all_gather(x, self.ag_ctx, impl=impl)
@@ -112,8 +123,9 @@ class TPMoE:
                up.astype(jnp.float32)).astype(x.dtype)
 
         rs_impl = "xla" if mode == "xla" else "ring"
-        return moe_reduce_rs(act, params["w_down"], pair_ids, ag_w,
-                             self.rs_ctx, impl=rs_impl)
+        out = moe_reduce_rs(act, params["w_down"], pair_ids, ag_w,
+                            self.rs_ctx, impl=rs_impl)
+        return out[:m] if m_pad != m else out
 
     def _ag_meta(self, arr: jax.Array) -> jax.Array:
         """All-gather small routing metadata (XLA collective)."""

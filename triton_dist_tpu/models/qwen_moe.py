@@ -23,34 +23,51 @@ from triton_dist_tpu.models.config import ModelConfig
 
 
 class Qwen3MoE:
-    """TP Qwen3-MoE decoder (reference models/qwen_moe.py:108)."""
+    """TP/EP Qwen3-MoE decoder (reference models/qwen_moe.py:108).
+
+    ``moe_parallel="tp"``: every expert's intermediate dim is sharded
+    (TPMoE — AG + grouped GEMM + MoE-RS). ``moe_parallel="ep"``: the
+    expert set is sharded, tokens route via the LL all-to-all (EPMoE —
+    the reference's EP inference deployment, test_ep_moe_inference.py).
+    Attention is TP over the same axis in both."""
 
     def __init__(self, config: ModelConfig, mesh: Mesh | None = None,
                  axis: str = "tp", fwd_mode: str = "ag_rs",
-                 impl: str = "pallas"):
+                 impl: str = "pallas", moe_parallel: str = "tp"):
         if mesh is None:
             from triton_dist_tpu.runtime.dist import get_mesh
             mesh = get_mesh()
         assert config.is_moe, "use DenseLLM for dense configs"
+        assert moe_parallel in ("tp", "ep")
         self.config = config
         self.mesh, self.axis = mesh, axis
         self.fwd_mode = fwd_mode
+        self.moe_parallel = moe_parallel
         c = config
         self.attn = TPAttn(c.hidden_size, c.num_attention_heads,
                            c.num_key_value_heads, c.head_dim, mesh=mesh,
                            axis=axis, dtype=c.dtype, fwd_mode=fwd_mode,
                            impl=impl, rms_eps=c.rms_norm_eps)
-        self.moe = TPMoE(c.hidden_size, c.moe_intermediate_size,
-                         c.num_experts, c.num_experts_per_tok, mesh=mesh,
-                         axis=axis, dtype=c.dtype, fwd_mode=fwd_mode,
-                         impl=impl, norm_topk_prob=c.norm_topk_prob)
+        if moe_parallel == "ep":
+            from triton_dist_tpu.layers.ep_moe import EPMoE
+            self.moe = EPMoE(c.hidden_size, c.moe_intermediate_size,
+                             c.num_experts, c.num_experts_per_tok,
+                             mesh=mesh, axis=axis, dtype=c.dtype,
+                             impl=impl, norm_topk_prob=c.norm_topk_prob)
+        else:
+            self.moe = TPMoE(c.hidden_size, c.moe_intermediate_size,
+                             c.num_experts, c.num_experts_per_tok,
+                             mesh=mesh, axis=axis, dtype=c.dtype,
+                             fwd_mode=fwd_mode, impl=impl,
+                             norm_topk_prob=c.norm_topk_prob)
         self.rope_cache = precompute_rope_cache(
             c.head_dim, c.max_position_embeddings, c.rope_theta)
 
     def set_fwd(self, mode: str):
         self.fwd_mode = mode
         self.attn.set_fwd(mode)
-        self.moe.set_fwd("xla" if mode in ("xla", "xla_ar") else "ag_rs")
+        if self.moe_parallel == "tp":
+            self.moe.set_fwd("xla" if mode in ("xla", "xla_ar") else "ag_rs")
 
     # -- params ------------------------------------------------------------
     def init(self, key: jax.Array) -> dict:
@@ -102,8 +119,21 @@ class Qwen3MoE:
         row-sharded layout (modes xla / ag_rs)."""
         c = self.config
         mode = mode or self.fwd_mode
-        moe_mode = "xla" if mode in ("xla", "xla_ar") else "ag_rs"
-        attn_mode = mode
+        if self.moe_parallel == "ep":
+            moe_mode = "ep"
+            if mode == "ep":
+                # Row-sharded attention needs divisible rows; decode-size
+                # batches fall back to the replicated gemm_ar path (the
+                # reference's EP serving uses the same small-batch mode,
+                # test_ep_moe_inference.py).
+                w = self.mesh.shape[self.axis]
+                attn_mode = "ag_rs" if (input_ids.size % w == 0) else \
+                    "gemm_ar"
+            else:
+                attn_mode = mode
+        else:
+            moe_mode = "xla" if mode in ("xla", "xla_ar") else "ag_rs"
+            attn_mode = mode
         b, s = input_ids.shape
         offset = jnp.asarray(offset, jnp.int32)
         position_ids = offset + jnp.tile(

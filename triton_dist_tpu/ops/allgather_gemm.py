@@ -63,6 +63,11 @@ class AllGatherGEMMContext:
     block_m: int = 256
     # VMEM budget for the auto choice (bytes; ~16 MB/core minus slack).
     vmem_budget: int = 12 * 1024 * 1024
+    # Autotune (variant, block_m, block_k) on first *eager* call per
+    # shape via tools.autotuner (reference ContextualAutoTuner +
+    # matmul_get_configs, allgather_gemm.py:396); jitted calls reuse the
+    # shape-keyed cache.
+    autotune: bool = False
 
     @property
     def world_size(self) -> int:
@@ -295,6 +300,65 @@ def _pick_block_k(k: int, want: int) -> int:
     return k
 
 
+# Shape-keyed tuned configs: (m, k, n_tot_loc, dtype, world) → config dict.
+# The analog of the reference's per-op static config tables + autotuner
+# cache (allgather_gemm.py:396, autotuner.py:43-250).
+_TUNED: dict[tuple, dict] = {}
+
+
+def ag_gemm_configs(m: int, rows: int, k: int, n_tot_loc: int,
+                    itemsize: int,
+                    vmem_budget: int = 12 * 1024 * 1024) -> list[dict]:
+    """Candidate config table for the fused AG-GEMM (reference
+    ``matmul_get_configs`` allgather_gemm.py:396, pruned to shapes that
+    fit the hardware constraints)."""
+    cfgs: list[dict] = []
+    vmem_fp = itemsize * (m * k + k * n_tot_loc + m * n_tot_loc + rows * k)
+    if vmem_fp <= vmem_budget:
+        cfgs.append({"variant": "vmem"})
+    for bm in (128, 256, 512):
+        if bm > rows:
+            continue
+        for bk in (256, 512, 1024):
+            if bk > k:
+                continue
+            # tile footprint: 2 A-tiles + 2 B-tiles + acc + 2 C-stages
+            fp = (2 * bm * bk + 2 * bk * n_tot_loc) * itemsize \
+                + bm * n_tot_loc * (4 + 2 * itemsize)
+            if fp <= vmem_budget:
+                cfgs.append({"variant": "hbm", "block_m": bm,
+                             "block_k": bk})
+    return cfgs or [{"variant": "hbm", "block_m": 128, "block_k": 256}]
+
+
+def _autotune_ag_gemm(a, bs, ctx, key, n_tot_loc):
+    """Eager sweep over :func:`ag_gemm_configs`; winner cached by shape
+    and agreed across processes (tools/autotuner broadcast)."""
+    from triton_dist_tpu.tools.autotuner import autotune
+
+    m, k = a.shape
+    rows = m // ctx.world_size
+    cfgs = ag_gemm_configs(m, rows, k, n_tot_loc, a.dtype.itemsize,
+                           ctx.vmem_budget)
+    if len(cfgs) == 1:
+        _TUNED[key] = cfgs[0]
+        return cfgs[0]
+
+    def make_fn(**cfg):
+        ctx2 = dataclasses.replace(ctx, autotune=False, **cfg)
+        fn = jax.jit(lambda x, ws: ag_gemm_multi(x, ws, ctx2,
+                                                 impl="pallas"))
+
+        def run():
+            return jax.block_until_ready(fn(a, list(bs)))
+        return run
+
+    result = autotune(make_fn, cfgs, key=f"ag_gemm:{key}", iters=8,
+                      warmup_iters=2)
+    _TUNED[key] = result.config
+    return result.config
+
+
 def ag_gemm_multi(a: jax.Array, bs,
                   ctx: AllGatherGEMMContext | None = None,
                   impl: str = "pallas"):
@@ -332,6 +396,15 @@ def ag_gemm_multi(a: jax.Array, bs,
 
     interpret = resolve_interpret(ctx.interpret)
     n_tot_loc = sum(b.shape[1] // world for b in bs)
+
+    if ctx.autotune:
+        tune_key = (m, k, n_tot_loc, str(a.dtype), world)
+        tuned = _TUNED.get(tune_key)
+        if tuned is None and not isinstance(a, jax.core.Tracer):
+            tuned = _autotune_ag_gemm(a, bs, ctx, tune_key, n_tot_loc)
+        if tuned is not None:
+            ctx = dataclasses.replace(ctx, autotune=False, **tuned)
+
     variant = ctx.resolve_variant(m, k, n_tot_loc, a.dtype.itemsize)
 
     if variant == "hbm":

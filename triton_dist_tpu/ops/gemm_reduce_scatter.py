@@ -32,7 +32,76 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
-from triton_dist_tpu.ops.common import comm_params, resolve_interpret, sync_interpret
+from triton_dist_tpu.ops.common import (
+    any_spec, comm_params, resolve_interpret, sync_interpret)
+
+
+def _pick_block(total: int, want: int) -> int:
+    for cand in (want, 512, 256, 128):
+        if cand <= total and total % cand == 0:
+            return cand
+    return total
+
+
+# Shape-keyed tuned configs (reference get_auto_triton_config,
+# moe_reduce_rs.py:553 + autotuner.py).
+_TUNED: dict[tuple, dict] = {}
+
+
+def gemm_rs_configs(m: int, rows: int, k_loc: int, n: int, itemsize: int,
+                    world: int,
+                    vmem_budget: int = 12 * 1024 * 1024) -> list[dict]:
+    """Candidate config table for the fused GEMM-RS."""
+    cfgs: list[dict] = []
+    vmem_fp = itemsize * (m * k_loc + k_loc * n + rows * n
+                          + 2 * max(world - 1, 1) * rows * n)
+    if vmem_fp <= vmem_budget:
+        cfgs.append({"variant": "vmem"})
+    for bm in (128, 256, 512):
+        if bm > rows:
+            continue
+        for bk in (256, 512):
+            if bk > k_loc:
+                continue
+            fp = (2 * bm * bk + 2 * bk * n) * itemsize \
+                + bm * n * (4 + 3 * itemsize)
+            if fp <= vmem_budget:
+                cfgs.append({"variant": "hbm", "block_m": bm,
+                             "block_k": bk})
+    return cfgs or [{"variant": "hbm", "block_m": 128, "block_k": 256}]
+
+
+def _autotune_gemm_rs(a, b, ctx, key, all_gather_epilogue):
+    from triton_dist_tpu.tools.autotuner import autotune
+
+    m = a.shape[0]
+    world = ctx.world_size
+    rows = m // world
+    k_loc = a.shape[1] // world
+    n = b.shape[1]
+    cfgs = gemm_rs_configs(m, rows, k_loc, n, a.dtype.itemsize, world,
+                           ctx.vmem_budget)
+    if all_gather_epilogue:
+        # HBM variant has no AG epilogue yet — vmem only.
+        cfgs = [c for c in cfgs if c["variant"] == "vmem"] or cfgs[:1]
+    if len(cfgs) == 1:
+        _TUNED[key] = cfgs[0]
+        return cfgs[0]
+
+    entry = gemm_ar if all_gather_epilogue else gemm_rs
+
+    def make_fn(**cfg):
+        ctx2 = dataclasses.replace(ctx, autotune=False, **cfg)
+        fn = jax.jit(lambda x, w: entry(x, w, ctx2, impl="pallas"))
+
+        def run():
+            return jax.block_until_ready(fn(a, b))
+        return run
+
+    result = autotune(make_fn, cfgs, key=f"gemm_rs:{key}", iters=8,
+                      warmup_iters=2)
+    _TUNED[key] = result.config
+    return result.config
 
 
 @dataclasses.dataclass
@@ -44,10 +113,32 @@ class GEMMReduceScatterContext:
     axis: str = "tp"
     acc_dtype: jnp.dtype = jnp.float32
     interpret: bool | None = None
+    # "vmem": whole operands resident (low latency); "hbm": stream
+    # (m_blk, k_blk) tiles through double-buffered VMEM (large shapes);
+    # "auto" picks by footprint.
+    variant: str = "auto"
+    block_k: int = 512
+    block_m: int = 256
+    vmem_budget: int = 12 * 1024 * 1024
+    # Autotune (variant, blocks) on first eager call per shape
+    # (reference ContextualAutoTuner + get_auto_triton_config,
+    # moe_reduce_rs.py:553).
+    autotune: bool = False
 
     @property
     def world_size(self) -> int:
         return self.mesh.shape[self.axis]
+
+    def resolve_variant(self, m: int, k_loc: int, n: int,
+                        itemsize: int) -> str:
+        if self.variant != "auto":
+            return self.variant
+        w = max(self.world_size, 1)
+        rows = m // w
+        # vmem kernel holds x + w + out + (w-1)*2 travelling chunks
+        fp = itemsize * (m * k_loc + k_loc * n + rows * n
+                         + 2 * max(w - 1, 1) * rows * n)
+        return "vmem" if fp <= self.vmem_budget else "hbm"
 
 
 def create_gemm_rs_context(mesh: Mesh | None = None, axis: str = "tp",
@@ -142,6 +233,135 @@ def _gemm_rs_kernel(x_ref, w_ref, o_ref, send_buf, recv_buf, send_sem,
     lax.fori_loop(0, world - 1, drain, None)
 
 
+def _gemm_rs_hbm_kernel(x_hbm, w_hbm, o_hbm, send_hbm, recv_hbm, a_tile,
+                        b_tile, r_tile, acc, c_stage, a_sem, b_sem, r_sem,
+                        c_sem, send_sem, recv_sem, *, axis: str, world: int,
+                        rows: int, k_loc: int, n: int, k_blk: int,
+                        m_blk: int, acc_dtype):
+    """HBM-resident GEMM-RS: operands and travelling partials never fully
+    enter VMEM.
+
+    Same ring-ordered producer schedule as ``_gemm_rs_kernel`` (chunk
+    (me-s-1) computed at step s, travelling partial added, forwarded) but
+    each chunk's GEMM streams (m_blk, k_blk)·(k_blk, N) tiles through
+    double-buffered VMEM, and the per-step send/recv slabs live in HBM —
+    the TPU shape of the reference's persistent tiled producer + staged
+    reduce (gemm_reduce_scatter.py:122-285, reduce_scatter.py:285-504).
+    """
+    me = lax.axis_index(axis)
+    right = lax.rem(me + 1, world)
+    k_tiles = k_loc // k_blk
+    m_tiles = rows // m_blk
+
+    def a_dma(slot, row0, kt):
+        return pltpu.make_async_copy(
+            x_hbm.at[pl.ds(row0, m_blk), pl.ds(kt * k_blk, k_blk)],
+            a_tile.at[slot], a_sem.at[slot])
+
+    def b_dma(slot, kt):
+        return pltpu.make_async_copy(
+            w_hbm.at[pl.ds(kt * k_blk, k_blk), :], b_tile.at[slot],
+            b_sem.at[slot])
+
+    def c_dma(slot, dst, row0):
+        return pltpu.make_async_copy(
+            c_stage.at[slot], dst.at[pl.ds(row0, m_blk), :],
+            c_sem.at[slot])
+
+    def rs_copy(s):
+        return dl.remote_copy(send_hbm.at[s], recv_hbm.at[s], right,
+                              send_sem.at[s], recv_sem.at[s], axis=axis)
+
+    def chunk_gemm(chunk, s, dst):
+        """Tiled partial for ``chunk``; adds recv slab s-1 when s > 0;
+        writes to dst (send slab or output)."""
+        def m_step(mt, _):
+            row0 = chunk * rows + mt * m_blk
+            a_dma(0, row0, 0).start()
+            b_dma(0, 0).start()
+
+            @pl.when(s > 0)
+            def _():
+                pltpu.make_async_copy(
+                    recv_hbm.at[jnp.maximum(s - 1, 0),
+                                pl.ds(mt * m_blk, m_blk), :],
+                    r_tile, r_sem).start()
+
+            def k_step(kt, _):
+                slot = lax.rem(kt, 2)
+
+                @pl.when(kt + 1 < k_tiles)
+                def _():
+                    a_dma(lax.rem(kt + 1, 2), row0, kt + 1).start()
+                    b_dma(lax.rem(kt + 1, 2), kt + 1).start()
+                a_dma(slot, row0, kt).wait()
+                b_dma(slot, kt).wait()
+                partial = jnp.dot(a_tile[slot], b_tile[slot],
+                                  preferred_element_type=acc_dtype)
+
+                @pl.when(kt == 0)
+                def _():
+                    acc[:] = partial
+
+                @pl.when(kt > 0)
+                def _():
+                    acc[:] = acc[:] + partial
+                return _
+
+            lax.fori_loop(0, k_tiles, k_step, None)
+
+            cslot = lax.rem(mt, 2)
+
+            @pl.when(mt >= 2)
+            def _():
+                c_dma(cslot, dst, mt * m_blk).wait()
+
+            @pl.when(s > 0)
+            def _():
+                pltpu.make_async_copy(
+                    recv_hbm.at[jnp.maximum(s - 1, 0),
+                                pl.ds(mt * m_blk, m_blk), :],
+                    r_tile, r_sem).wait()
+                c_stage[cslot] = (acc[:].astype(c_stage.dtype)
+                                  + r_tile[:]).astype(c_stage.dtype)
+
+            @pl.when(s == 0)
+            def _():
+                c_stage[cslot] = acc[:].astype(c_stage.dtype)
+            c_dma(cslot, dst, mt * m_blk).start()
+            return _
+
+        lax.fori_loop(0, m_tiles, m_step, None)
+        for slot in range(min(2, m_tiles)):
+            c_dma(slot, dst, 0).wait()
+
+    if world == 1:
+        chunk_gemm(jnp.int32(0), jnp.int32(0), o_hbm)
+        return
+
+    dl.barrier_all(axis)
+
+    def rs_step(s, _):
+        send_idx = lax.rem(me - s - 1 + world, world)
+
+        @pl.when(s > 0)
+        def _():
+            rs_copy(jnp.maximum(s - 1, 0)).wait_recv()
+        chunk_gemm(send_idx, s, send_hbm.at[s])
+        rs_copy(s).start()
+        return _
+
+    lax.fori_loop(0, world - 1, rs_step, None)
+    rs_copy(world - 2).wait_recv()
+    chunk_gemm(me, jnp.int32(world - 1), o_hbm)
+
+    def drain(s, _):
+        rs_copy(s).wait_send()
+        return _
+
+    lax.fori_loop(0, world - 1, drain, None)
+
+
 def _entry(a, b, ctx, impl, all_gather_epilogue):
     mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
     m = a.shape[0]
@@ -164,6 +384,61 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
         return f(a, b)
 
     interpret = resolve_interpret(ctx.interpret)
+    k_loc = a.shape[1] // world
+
+    if ctx.autotune:
+        tune_key = (m, k_loc, n, str(a.dtype), world,
+                    all_gather_epilogue)
+        tuned = _TUNED.get(tune_key)
+        if tuned is None and not isinstance(a, jax.core.Tracer):
+            tuned = _autotune_gemm_rs(a, b, ctx, tune_key,
+                                      all_gather_epilogue)
+        if tuned is not None:
+            ctx = dataclasses.replace(ctx, autotune=False, **tuned)
+
+    variant = ctx.resolve_variant(m, k_loc, n, a.dtype.itemsize)
+    if variant == "hbm" and not all_gather_epilogue and world >= 1:
+        k_blk = _pick_block(k_loc, ctx.block_k)
+        m_blk = _pick_block(rows, ctx.block_m)
+        kernel = functools.partial(
+            _gemm_rs_hbm_kernel, axis=axis, world=world, rows=rows,
+            k_loc=k_loc, n=n, k_blk=k_blk, m_blk=m_blk,
+            acc_dtype=ctx.acc_dtype)
+
+        def hbm_body(xs, ws):
+            out, *_ = pl.pallas_call(
+                kernel,
+                out_shape=(
+                    jax.ShapeDtypeStruct((rows, n), a.dtype),
+                    jax.ShapeDtypeStruct((max(world - 1, 1), rows, n),
+                                         a.dtype),
+                    jax.ShapeDtypeStruct((max(world - 1, 1), rows, n),
+                                         a.dtype)),
+                in_specs=[any_spec(), any_spec()],
+                out_specs=(any_spec(),) * 3,
+                scratch_shapes=[
+                    pltpu.VMEM((2, m_blk, k_blk), a.dtype),
+                    pltpu.VMEM((2, k_blk, n), a.dtype),
+                    pltpu.VMEM((m_blk, n), a.dtype),
+                    pltpu.VMEM((m_blk, n), ctx.acc_dtype),
+                    pltpu.VMEM((2, m_blk, n), a.dtype),
+                    pltpu.SemaphoreType.DMA((2,)),
+                    pltpu.SemaphoreType.DMA((2,)),
+                    pltpu.SemaphoreType.DMA,
+                    pltpu.SemaphoreType.DMA((2,)),
+                    pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+                    pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+                ],
+                compiler_params=comm_params(collective_id=5, world=world),
+                interpret=interpret,
+            )(xs, ws)
+            return out
+
+        f = jax.shard_map(hbm_body, mesh=mesh,
+                          in_specs=(P(None, axis), P(axis)),
+                          out_specs=out_spec, check_vma=False)
+        return sync_interpret(f(a, b), interpret)
+
     scratch = [pltpu.VMEM((world - 1, rows, n), a.dtype),
                pltpu.VMEM((world - 1, rows, n), a.dtype),
                pltpu.SemaphoreType.DMA((world - 1,)),

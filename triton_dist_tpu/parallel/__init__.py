@@ -9,6 +9,7 @@ PP (p2p buffers + pipeline schedule).
 """
 
 from triton_dist_tpu.layers.ep_a2a import DispatchHandle, EPAll2AllLayer
+from triton_dist_tpu.layers.ep_moe import EPMoE
 from triton_dist_tpu.layers.p2p import CommOp
 from triton_dist_tpu.layers.sp_flash_decode import (
     SpAttentionLayer,
@@ -20,7 +21,7 @@ from triton_dist_tpu.layers.tp_moe import TPMoE
 
 # Strategy → layers index (mirrors SURVEY.md §2.9).
 TP_LAYERS = (TPMLP, TPAttn, TPMoE)
-EP_LAYERS = (EPAll2AllLayer,)
+EP_LAYERS = (EPAll2AllLayer, EPMoE)
 SP_LAYERS = (SpFlashDecodeLayer, SpAttentionLayer)
 PP_LAYERS = (CommOp,)
 
@@ -28,6 +29,7 @@ __all__ = [
     "CommOp",
     "DispatchHandle",
     "EPAll2AllLayer",
+    "EPMoE",
     "SpAttentionLayer",
     "SpFlashDecodeLayer",
     "TPAttn",
