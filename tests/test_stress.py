@@ -80,3 +80,57 @@ def test_stress_a2a_random_counts(mesh8):
                     rp.reshape(WORLD, WORLD, cap, h)[dst, src, :nlive],
                     rx.reshape(WORLD, WORLD, cap, h)[dst, src, :nlive],
                     err_msg=f"iter {it} dst={dst} src={src}")
+
+
+def test_stress_injection_options_accepted(mesh8):
+    """for_correctness noise + straggler options must be accepted by
+    AG / AG-GEMM / A2A and leave results exact (VERDICT r2 next 8;
+    reference for_correctness allgather.py:74-79, stress_test_ag_gemm).
+    In interpret mode the delays are no-ops (pl.delay is a hardware
+    spin); tpu_smoke runs the same options compiled on the chip where
+    they really skew the rank schedule."""
+    from triton_dist_tpu.ops.allgather import (
+        AllGatherMethod, create_allgather_context, all_gather)
+    rng = np.random.RandomState(7)
+    x = jax.device_put(jnp.asarray(rng.randn(WORLD * 4, 128), jnp.float32),
+                       NamedSharding(mesh8, P("tp")))
+    ctx = create_allgather_context(mesh8, "tp",
+                                   method=AllGatherMethod.RING_BIDIR)
+    ctx.straggler_option = (3, 2000)
+    ctx.for_correctness = True
+    got = all_gather(x, ctx, impl="pallas")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(x), rtol=0,
+                               atol=0)
+
+    agctx = create_ag_gemm_context(mesh8, "tp")
+    agctx.straggler_option = (1, 2000)
+    agctx.for_correctness = True
+    a = jax.device_put(jnp.asarray(rng.randn(WORLD * 2, 64), jnp.float32),
+                       NamedSharding(mesh8, P("tp")))
+    b = jax.device_put(jnp.asarray(rng.randn(64, WORLD * 16), jnp.float32),
+                       NamedSharding(mesh8, P(None, "tp")))
+    fused = ag_gemm(a, b, agctx, impl="pallas")
+    gold = ag_gemm(a, b, agctx, impl="xla")
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(gold),
+                               rtol=1e-4, atol=1e-4)
+
+    a2actx = create_all_to_all_context(mesh8, "tp", capacity=16)
+    a2actx.straggler_option = (5, 2000)
+    a2actx.for_correctness = True
+    send = jax.device_put(
+        jnp.asarray(rng.randn(WORLD * WORLD, 16, 128), jnp.float32),
+        NamedSharding(mesh8, P("tp")))
+    counts = jax.device_put(
+        jnp.full((WORLD * WORLD,), 8, jnp.int32),
+        NamedSharding(mesh8, P("tp")))
+    got_buf, got_counts = fast_all_to_all(send, counts, a2actx,
+                                          impl="pallas")
+    ref_buf, ref_counts = fast_all_to_all(send, counts, a2actx,
+                                          impl="xla")
+    np.testing.assert_array_equal(np.asarray(got_counts),
+                                  np.asarray(ref_counts))
+    # Compare only live rows (capacity slabs beyond counts are garbage).
+    gb = np.asarray(got_buf).reshape(WORLD, WORLD, 16, 128)
+    rb = np.asarray(ref_buf).reshape(WORLD, WORLD, 16, 128)
+    np.testing.assert_allclose(gb[:, :, :8], rb[:, :, :8], rtol=1e-5,
+                               atol=1e-5)

@@ -94,3 +94,55 @@ def test_aot_compile_spaces(tmp_path):
     assert os.path.exists(paths[0])
     g = load_artifact(paths[0])
     np.testing.assert_allclose(np.asarray(g(a)), np.asarray(a * a))
+
+
+def test_perf_model_auto_crossovers():
+    """AUTO method selection turns on perf-model crossovers, not
+    hardcoded byte thresholds (VERDICT r2 next 9; reference
+    comm_perf_model.py:94-116, allreduce.py:1101-1127)."""
+    from triton_dist_tpu.tools.perf_model import (
+        CHIP_SPECS, estimate_all_gather_time_ms,
+        estimate_full_mesh_push_time_ms)
+    from triton_dist_tpu.ops.allgather import (
+        AllGatherMethod, get_auto_all_gather_method)
+    from triton_dist_tpu.ops.allreduce import (
+        AllReduceMethod, get_auto_allreduce_method)
+
+    spec = CHIP_SPECS["v5e"]
+    # Latency-bound: one launch beats per-step ring overhead.
+    assert get_auto_all_gather_method(8, 4 * 1024, spec) \
+        is AllGatherMethod.FULL_MESH_PUSH
+    # Bandwidth-bound: through-traffic sinks full-mesh; ring wins.
+    assert get_auto_all_gather_method(8, 64 * 1024 * 1024, spec) \
+        is AllGatherMethod.RING_BIDIR
+    # The crossover exists and is monotone: find it by bisection and
+    # check the model actually flips there.
+    lo, hi = 4 * 1024, 64 * 1024 * 1024
+    while hi - lo > 1024:
+        mid = (lo + hi) // 2
+        if (estimate_full_mesh_push_time_ms(mid, 8, spec)
+                <= estimate_all_gather_time_ms(mid, 8, spec)):
+            lo = mid
+        else:
+            hi = mid
+    assert 16 * 1024 < hi < 16 * 1024 * 1024  # physically plausible
+
+    assert get_auto_allreduce_method(8, 16 * 1024, spec) \
+        is AllReduceMethod.ONE_SHOT
+    assert get_auto_allreduce_method(8, 64 * 1024 * 1024, spec) \
+        is AllReduceMethod.TWO_SHOT
+    # w<=2 degenerates to the single-hop method regardless of size.
+    assert get_auto_all_gather_method(2, 64 * 1024 * 1024, spec) \
+        is AllGatherMethod.FULL_MESH_PUSH
+
+
+def test_reduce_scatter_auto_crossover():
+    from triton_dist_tpu.ops.reduce_scatter import (
+        ReduceScatterMethod, create_reduce_scatter_context)
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:8]), ("tp",))
+    ctx = create_reduce_scatter_context(mesh, "tp")
+    assert ctx.resolve_method(8 * 1024) is ReduceScatterMethod.ONE_SHOT
+    assert ctx.resolve_method(64 * 1024 * 1024) is ReduceScatterMethod.RING

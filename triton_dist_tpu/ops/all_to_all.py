@@ -41,7 +41,9 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
-from triton_dist_tpu.ops.common import cdiv, comm_params, resolve_interpret, sync_interpret
+from triton_dist_tpu.ops.common import (
+    cdiv, comm_params, maybe_noise, maybe_straggle, resolve_interpret,
+    sync_interpret)
 
 
 def _default_chunk_rows(capacity: int) -> int:
@@ -63,6 +65,10 @@ class AllToAllContext:
     capacity: int = 128          # max rows per (src, dst) pair
     chunk_rows: int | None = None
     interpret: bool | None = None
+    # Correctness-debug injection (reference for_correctness sleeps /
+    # straggler_option, low_latency_all_to_all.py): see ops/common.py.
+    straggler_option: tuple[int, int] | None = None
+    for_correctness: bool = False
 
     @property
     def world_size(self) -> int:
@@ -86,7 +92,8 @@ def create_all_to_all_context(mesh: Mesh | None = None, axis: str = "ep",
 
 def _a2a_kernel(send_counts_ref, recv_counts_ref, send_ref, recv_ref,
                 send_sem, recv_sem, *, axis: str, world: int, capacity: int,
-                chunk: int):
+                chunk: int, straggler_option=None, for_correctness=False,
+                interp=False):
     """Per-device body: push live chunks of each slab to its peer.
 
     Per peer p: ``n = cdiv(send_counts[p], chunk)`` chunk DMAs
@@ -105,6 +112,8 @@ def _a2a_kernel(send_counts_ref, recv_counts_ref, send_ref, recv_ref,
         return
     # Peers' recv buffers must exist before remote writes land.
     dl.barrier_all(axis)
+    maybe_straggle(straggler_option, axis, interp)
+    maybe_noise(for_correctness, axis, world, salt=6, interpret=interp)
 
     def chunk_copy(p, c):
         # dst slab on peer p is indexed by *our* rank; semaphore slot
@@ -202,7 +211,10 @@ def fast_all_to_all(send_buf: jax.Array, send_counts: jax.Array,
 
     interpret = resolve_interpret(ctx.interpret)
     kernel = functools.partial(_a2a_kernel, axis=axis, world=world,
-                               capacity=capacity, chunk=chunk)
+                               capacity=capacity, chunk=chunk,
+                               straggler_option=ctx.straggler_option,
+                               for_correctness=ctx.for_correctness,
+                               interp=bool(interpret))
     n_chunks = capacity // chunk
 
     def body(buf, counts, rcounts):

@@ -37,7 +37,9 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
-from triton_dist_tpu.ops.common import comm_params, resolve_interpret, sync_interpret
+from triton_dist_tpu.ops.common import (
+    comm_params, maybe_noise, maybe_straggle, resolve_interpret,
+    sync_interpret)
 
 
 class AllGatherMethod(enum.Enum):
@@ -47,15 +49,25 @@ class AllGatherMethod(enum.Enum):
     FULL_MESH_PUSH = "full_mesh_push"
 
 
-def get_auto_all_gather_method(world_size: int,
-                               nbytes_per_rank: int) -> AllGatherMethod:
-    """Size-based method choice (reference get_auto_all_gather_method,
-    allgather.py:46-73: full-mesh for small, ring for large)."""
+def get_auto_all_gather_method(world_size: int, nbytes_per_rank: int,
+                               spec=None) -> AllGatherMethod:
+    """Perf-model-driven method choice (reference
+    get_auto_all_gather_method allgather.py:46-73 picks from probed
+    bandwidth models, comm_perf_model.py:94-116): full-mesh push wins
+    when its single-launch latency beats the ring's per-step fixed
+    costs; the bidirectional ring wins once payloads are
+    bandwidth-bound (through-traffic makes full-mesh scale as w·w/4
+    hops)."""
+    from triton_dist_tpu.tools.perf_model import (
+        estimate_all_gather_time_ms, estimate_full_mesh_push_time_ms)
     if world_size <= 2:
         return AllGatherMethod.FULL_MESH_PUSH
-    if nbytes_per_rank <= 256 * 1024:
-        return AllGatherMethod.FULL_MESH_PUSH
-    return AllGatherMethod.RING_BIDIR
+    t_fm = estimate_full_mesh_push_time_ms(nbytes_per_rank, world_size,
+                                           spec)
+    t_ring = estimate_all_gather_time_ms(nbytes_per_rank, world_size,
+                                         spec, bidir=True)
+    return (AllGatherMethod.FULL_MESH_PUSH if t_fm <= t_ring
+            else AllGatherMethod.RING_BIDIR)
 
 
 @dataclasses.dataclass
@@ -67,6 +79,10 @@ class AllGatherContext:
     axis: str = "tp"
     method: AllGatherMethod = AllGatherMethod.AUTO
     interpret: bool | None = None
+    # Correctness-debug injection (reference for_correctness sleeps
+    # allgather.py:74-79 and straggler_option): see ops/common.py.
+    straggler_option: tuple[int, int] | None = None
+    for_correctness: bool = False
 
     @property
     def world_size(self) -> int:
@@ -94,7 +110,9 @@ def create_allgather_context(mesh: Mesh | None = None, axis: str = "tp",
 # ---------------------------------------------------------------------------
 
 def _ring_ag_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis: str,
-                    world: int, rows: int, bidir: bool):
+                    world: int, rows: int, bidir: bool,
+                    straggler_option=None, for_correctness=False,
+                    interp=False):
     """Ring all-gather. Unidirectional: w-1 hops to the right.
     Bidirectional: chunks travel the shorter way round; ceil((w-1)/2) steps.
 
@@ -111,6 +129,8 @@ def _ring_ag_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis: str,
     # Peers must have written their own chunk (and exist) before remote
     # writes into their o_ref land.
     dl.barrier_all(axis)
+    maybe_straggle(straggler_option, axis, interp)
+    maybe_noise(for_correctness, axis, world, salt=1, interpret=interp)
 
     n_fwd = (world - 1 + 1) // 2 if bidir else world - 1
     n_bwd = (world - 1) - n_fwd if bidir else 0
@@ -175,7 +195,8 @@ def _ring_ag_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis: str,
 
 
 def _full_mesh_push_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis: str,
-                           world: int, rows: int):
+                           world: int, rows: int, straggler_option=None,
+                           for_correctness=False, interp=False):
     """Every device puts its chunk to all peers (reference full-mesh push,
     allgather.py:81-170). Latency-optimal: one hop, w-1 concurrent DMAs."""
     me = lax.axis_index(axis)
@@ -183,6 +204,8 @@ def _full_mesh_push_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis: str,
     if world == 1:
         return
     dl.barrier_all(axis)
+    maybe_straggle(straggler_option, axis, interp)
+    maybe_noise(for_correctness, axis, world, salt=2, interpret=interp)
 
     def send(p, _):
         peer = lax.rem(me + p, world)
@@ -250,15 +273,19 @@ def all_gather(x: jax.Array, ctx: AllGatherContext | None = None,
 
     interpret = resolve_interpret(ctx.interpret)
 
+    inject = dict(straggler_option=ctx.straggler_option,
+                  for_correctness=ctx.for_correctness,
+                  interp=bool(interpret))
     if method in (AllGatherMethod.RING_1D, AllGatherMethod.RING_BIDIR):
         kernel = functools.partial(
             _ring_ag_kernel, axis=axis, world=world, rows=rows,
-            bidir=method is AllGatherMethod.RING_BIDIR)
+            bidir=method is AllGatherMethod.RING_BIDIR, **inject)
         scratch = [pltpu.SemaphoreType.DMA((world,)),
                    pltpu.SemaphoreType.DMA((2, world))]
     else:
         kernel = functools.partial(
-            _full_mesh_push_kernel, axis=axis, world=world, rows=rows)
+            _full_mesh_push_kernel, axis=axis, world=world, rows=rows,
+            **inject)
         scratch = [pltpu.SemaphoreType.DMA((world,)),
                    pltpu.SemaphoreType.DMA((world,))]
 

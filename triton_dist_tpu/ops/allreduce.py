@@ -45,12 +45,22 @@ class AllReduceMethod(enum.Enum):
     TWO_SHOT = "two_shot"
 
 
-def get_auto_allreduce_method(world_size: int,
-                              nbytes: int) -> AllReduceMethod:
-    """Size-based selection (reference allreduce.py:1101-1127)."""
-    if world_size <= 2 or nbytes <= 512 * 1024:
+def get_auto_allreduce_method(world_size: int, nbytes: int,
+                              spec=None) -> AllReduceMethod:
+    """Perf-model-driven selection (reference allreduce.py:1101-1127
+    picks from measured bandwidth models): one-shot's single full-buffer
+    exchange wins at small payloads; the two-shot RS+AG decomposition
+    moves 2·nbytes/w per link instead of (w-1)·nbytes and wins once
+    bandwidth-bound."""
+    from triton_dist_tpu.tools.perf_model import estimate_all_reduce_time_ms
+    if world_size <= 2:
         return AllReduceMethod.ONE_SHOT
-    return AllReduceMethod.TWO_SHOT
+    t_one = estimate_all_reduce_time_ms(nbytes, world_size, spec,
+                                        method="one_shot")
+    t_two = estimate_all_reduce_time_ms(nbytes, world_size, spec,
+                                        method="two_shot")
+    return (AllReduceMethod.ONE_SHOT if t_one <= t_two
+            else AllReduceMethod.TWO_SHOT)
 
 
 @dataclasses.dataclass

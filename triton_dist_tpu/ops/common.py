@@ -75,6 +75,42 @@ def comm_params(collective_id: int | None = 0,
     return pltpu.CompilerParams(**kwargs)
 
 
+def maybe_straggle(straggler_option, axis: str, interpret=False) -> None:
+    """Spin one rank before it starts communicating
+    (reference ``straggler_option`` / ``_run_straggler``,
+    allreduce.py:137): correctness must not depend on rank arrival
+    order. ``pl.delay`` is a hardware spin — skipped in interpret mode,
+    where the interpreter's own thread scheduling provides the skew."""
+    if straggler_option is None or interpret:
+        return
+    from jax import lax
+    rank, cycles = straggler_option
+
+    @pl.when(lax.axis_index(axis) == rank)
+    def _():
+        pl.delay(cycles)
+
+
+def maybe_noise(for_correctness: bool, axis: str, world: int,
+                salt: int = 0, base_cycles: int = 512,
+                interpret=False) -> None:
+    """Per-rank pseudo-random delay for correctness-debug runs
+    (reference ``for_correctness`` sleep injection, allgather.py:74-79,
+    allgather_gemm.py:507-508): shakes the rank schedule so stale-signal
+    / missing-wait bugs reproduce instead of hiding behind lockstep
+    timing. Deterministic per (rank, salt) so failures replay."""
+    if not for_correctness or interpret or world <= 1:
+        return
+    from jax import lax
+    me = lax.axis_index(axis)
+    for r in range(world):
+        amt = ((r * 2654435761 + salt * 40503) >> 7) % 8 + 1
+
+        @pl.when(me == r)
+        def _(amt=amt):
+            pl.delay(base_cycles * amt)
+
+
 def vmem_spec(block_shape=None, index_map=None):
     return pl.BlockSpec(block_shape, index_map, memory_space=pltpu.VMEM)
 

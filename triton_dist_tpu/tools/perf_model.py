@@ -30,7 +30,12 @@ class ChipSpec:
 
 
 # Public-spec table (order matters: first matching substring wins).
+# "lite" keys first: real device_kind strings are e.g. "TPU v5 lite" /
+# "TPU v6 lite", which no bare "v5e"/"v6e" substring matches — missing
+# them would silently select the cpu-sim spec on the bench chip.
 CHIP_SPECS = {
+    "v5 lite": ChipSpec("v5e", 197.0, 819.0, 50.0, 4),
+    "v6 lite": ChipSpec("v6e", 918.0, 1640.0, 100.0, 4),
     "v6": ChipSpec("v6e", 918.0, 1640.0, 100.0, 4),
     "v5p": ChipSpec("v5p", 459.0, 2765.0, 100.0, 6),
     "v5e": ChipSpec("v5e", 197.0, 819.0, 50.0, 4),
@@ -63,6 +68,15 @@ def estimate_gemm_sol_time_ms(m: int, n: int, k: int,
     return max(t_flops, t_mem) * 1e3
 
 
+# Fixed costs per DMA/step (ICI hop launch + semaphore signalling). These
+# are what make small payloads latency-bound and large ones
+# bandwidth-bound — the axis every AUTO crossover below turns on (the
+# reference's analog constants live in its probed bandwidth tables,
+# comm_perf_model.py:94-116).
+DMA_STARTUP_US = 2.0
+ICI_HOP_LATENCY_US = 1.0
+
+
 def _ring_time_s(nbytes_per_rank: int, world: int, link_gbps: float,
                  n_hops: int) -> float:
     return (nbytes_per_rank * n_hops) / (link_gbps * 1e9)
@@ -71,12 +85,32 @@ def _ring_time_s(nbytes_per_rank: int, world: int, link_gbps: float,
 def estimate_all_gather_time_ms(nbytes_per_rank: int, world: int,
                                 spec: ChipSpec | None = None,
                                 bidir: bool = True) -> float:
-    """Ring AG over ICI: (w-1) hops of the shard per direction (reference
-    comm_perf_model.py:94)."""
+    """Ring AG over ICI: (w-1) hops of the shard per direction plus
+    per-step fixed costs (reference comm_perf_model.py:94)."""
     spec = spec or get_chip_spec()
+    if world <= 1:
+        return 0.0
     hops = (world - 1 + 1) // 2 if bidir else world - 1
-    return _ring_time_s(nbytes_per_rank, world,
-                        spec.ici_gbps_per_link, hops) * 1e3
+    bw = _ring_time_s(nbytes_per_rank, world, spec.ici_gbps_per_link, hops)
+    fixed = hops * (DMA_STARTUP_US + ICI_HOP_LATENCY_US) * 1e-6
+    return (bw + fixed) * 1e3
+
+
+def estimate_full_mesh_push_time_ms(nbytes_per_rank: int, world: int,
+                                    spec: ChipSpec | None = None) -> float:
+    """Full-mesh push AG: one logical hop (all w-1 puts launch at once),
+    but non-neighbor puts traverse the torus (mean distance ~w/4 on a
+    ring), consuming through-bandwidth on intermediate links."""
+    spec = spec or get_chip_spec()
+    if world <= 1:
+        return 0.0
+    avg_hops = max(world / 4.0, 1.0)
+    # A 1-D gather axis owns 2 of the chip's links (one per direction);
+    # every put occupies avg_hops link-segments of that capacity.
+    bw = 2.0 * spec.ici_gbps_per_link
+    t = nbytes_per_rank * (world - 1) * avg_hops / (bw * 1e9)
+    fixed = (DMA_STARTUP_US + avg_hops * ICI_HOP_LATENCY_US) * 1e-6
+    return (t + fixed) * 1e3
 
 
 def estimate_reduce_scatter_time_ms(nbytes_per_rank: int, world: int,
@@ -86,9 +120,28 @@ def estimate_reduce_scatter_time_ms(nbytes_per_rank: int, world: int,
     return estimate_all_gather_time_ms(nbytes_per_rank, world, spec, bidir)
 
 
+def estimate_one_shot_reduce_time_ms(nbytes_per_chunk: int, world: int,
+                                     spec: ChipSpec | None = None) -> float:
+    """One-shot RS/AR gather phase: every peer pushes its contribution
+    directly (full-mesh), then a local w-way sum (HBM-bound)."""
+    spec = spec or get_chip_spec()
+    if world <= 1:
+        return 0.0
+    push = estimate_full_mesh_push_time_ms(nbytes_per_chunk, world, spec)
+    reduce_ms = world * nbytes_per_chunk / (spec.hbm_gbps * 1e9) * 1e3
+    return push + reduce_ms
+
+
 def estimate_all_reduce_time_ms(nbytes: int, world: int,
-                                spec: ChipSpec | None = None) -> float:
-    """RS + AG decomposition."""
+                                spec: ChipSpec | None = None,
+                                method: str = "two_shot") -> float:
+    """two_shot: RS + AG decomposition; one_shot: full-buffer full-mesh
+    exchange + local sum (reference allreduce.py:1101-1127 budgets the
+    same trade)."""
+    if world <= 1:
+        return 0.0
+    if method == "one_shot":
+        return estimate_one_shot_reduce_time_ms(nbytes, world, spec)
     per = nbytes // max(world, 1)
     return (estimate_all_gather_time_ms(per, world, spec)
             + estimate_reduce_scatter_time_ms(per, world, spec))
