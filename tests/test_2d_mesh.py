@@ -1,0 +1,137 @@
+"""Fused ops on 2-D meshes (VERDICT r2 next 10: "2-D mesh (tp x sp,
+tp x ep) variants for every fused op" — round 2 only exercised 2-D
+meshes in test_language).
+
+Each op runs on ONE axis of a (tp=4, ep=2) mesh; correctness requires
+``logical_device_id`` to translate axis-relative peers into global mesh
+ids inside every remote DMA and barrier (a bug here silently corrupts
+rank math on any real multi-dim topology, e.g. tp x sp serving or
+tp x ep MoE)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _put(mesh, x, spec):
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+@pytest.mark.parametrize("axis", ["tp", "ep"])
+def test_allgather_2d(mesh4x2, axis):
+    from triton_dist_tpu.ops.allgather import (
+        AllGatherMethod, create_allgather_context, all_gather)
+    w = mesh4x2.shape[axis]
+    x = jnp.arange(w * 4 * 128, dtype=jnp.float32).reshape(w * 4, 128)
+    xs = _put(mesh4x2, x, P(axis))
+    for method in (AllGatherMethod.RING_1D, AllGatherMethod.RING_BIDIR,
+                   AllGatherMethod.FULL_MESH_PUSH):
+        ctx = create_allgather_context(mesh4x2, axis, method=method)
+        got = all_gather(xs, ctx, impl="pallas")
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(x),
+                                      err_msg=f"{axis}/{method}")
+
+
+@pytest.mark.parametrize("axis", ["tp", "ep"])
+def test_ag_gemm_2d(mesh4x2, axis, key):
+    from triton_dist_tpu.ops.allgather_gemm import (
+        create_ag_gemm_context, ag_gemm)
+    w = mesh4x2.shape[axis]
+    m, k, n = w * 8, 64, w * 32
+    a = _put(mesh4x2, jax.random.normal(key, (m, k), jnp.float32) / 4,
+             P(axis))
+    b = _put(mesh4x2,
+             jax.random.normal(jax.random.PRNGKey(1), (k, n),
+                               jnp.float32) / 4, P(None, axis))
+    ctx = create_ag_gemm_context(mesh4x2, axis)
+    got = ag_gemm(a, b, ctx, impl="pallas")
+    gold = ag_gemm(a, b, ctx, impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(gold),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("axis", ["tp", "ep"])
+def test_gemm_rs_2d(mesh4x2, axis, key):
+    from triton_dist_tpu.ops.gemm_reduce_scatter import (
+        create_gemm_rs_context, gemm_rs)
+    w = mesh4x2.shape[axis]
+    m, k, n = w * 8, w * 16, 128
+    a = _put(mesh4x2, jax.random.normal(key, (m, k), jnp.float32) / 4,
+             P(None, axis))
+    b = _put(mesh4x2,
+             jax.random.normal(jax.random.PRNGKey(1), (k, n),
+                               jnp.float32) / 4, P(axis))
+    ctx = create_gemm_rs_context(mesh4x2, axis)
+    got = gemm_rs(a, b, ctx, impl="pallas")
+    gold = gemm_rs(a, b, ctx, impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(gold),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("axis", ["tp", "ep"])
+def test_flash_decode_2d(mesh4x2, axis, key):
+    from triton_dist_tpu.ops.flash_decode import (
+        create_flash_decode_context, gqa_fwd_batch_decode)
+    w = mesh4x2.shape[axis]
+    b, hq, hkv, d, t = 2, 8, 2, 64, w * 64
+    q = jax.random.normal(key, (b, hq, d), jnp.float32)
+    kc = _put(mesh4x2, jax.random.normal(jax.random.PRNGKey(1),
+                                         (b, t, hkv, d), jnp.float32),
+              P(None, axis))
+    vc = _put(mesh4x2, jax.random.normal(jax.random.PRNGKey(2),
+                                         (b, t, hkv, d), jnp.float32),
+              P(None, axis))
+    ctx = create_flash_decode_context(mesh4x2, axis, variant="tiled",
+                                      t_blk=32)
+    got = gqa_fwd_batch_decode(q, kc, vc, jnp.int32(t - 5), ctx,
+                               impl="pallas")
+    gold = gqa_fwd_batch_decode(q, kc, vc, jnp.int32(t - 5), ctx,
+                                impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(gold),
+                               rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("axis", ["tp", "ep"])
+def test_sp_attention_fused_2d(mesh4x2, axis, key):
+    from triton_dist_tpu.ops.sp_attention import (
+        create_sp_attention_context, sp_ag_attention)
+    w = mesh4x2.shape[axis]
+    b, s, hq, hkv, d = 1, w * 128, 4, 2, 64
+    q = _put(mesh4x2, jax.random.normal(key, (b, s, hq, d), jnp.float32),
+             P(None, axis))
+    k = _put(mesh4x2, jax.random.normal(jax.random.PRNGKey(1),
+                                        (b, s, hkv, d), jnp.float32),
+             P(None, axis))
+    v = _put(mesh4x2, jax.random.normal(jax.random.PRNGKey(2),
+                                        (b, s, hkv, d), jnp.float32),
+             P(None, axis))
+    ctx = create_sp_attention_context(mesh4x2, axis, causal=True)
+    got = sp_ag_attention(q, k, v, ctx, impl="pallas")
+    gold = sp_ag_attention(q, k, v, ctx, impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(gold),
+                               rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("axis", ["tp", "ep"])
+def test_all_to_all_2d(mesh4x2, axis):
+    from triton_dist_tpu.ops.all_to_all import (
+        create_all_to_all_context, fast_all_to_all)
+    w = mesh4x2.shape[axis]
+    cap = 16
+    rng = np.random.RandomState(0)
+    send = _put(mesh4x2,
+                jnp.asarray(rng.randn(w * w, cap, 128), jnp.float32),
+                P(axis))
+    counts = _put(mesh4x2, jnp.full((w * w,), 8, jnp.int32), P(axis))
+    ctx = create_all_to_all_context(mesh4x2, axis, capacity=cap)
+    got_buf, got_counts = fast_all_to_all(send, counts, ctx,
+                                          impl="pallas")
+    ref_buf, ref_counts = fast_all_to_all(send, counts, ctx, impl="xla")
+    np.testing.assert_array_equal(np.asarray(got_counts),
+                                  np.asarray(ref_counts))
+    gb = np.asarray(got_buf).reshape(w, w, cap, 128)
+    rb = np.asarray(ref_buf).reshape(w, w, cap, 128)
+    np.testing.assert_allclose(gb[:, :, :8], rb[:, :, :8], rtol=1e-5,
+                               atol=1e-5)

@@ -1,0 +1,339 @@
+"""Primitive-exhaustive device-language tests.
+
+The analog of the reference's test/nvidia/test_nvshmem_api.py (962 LoC
+exercising every libshmem_device primitive individually): every public
+symbol of ``triton_dist_tpu.language`` and ``language.shmem`` gets at
+least one kernel-level test here, beyond the protocol-shaped cases in
+test_language.py (VERDICT r2 next 10 "primitive-exhaustive language/
+tests").
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.language as dl
+from triton_dist_tpu.language import shmem
+from triton_dist_tpu.ops.common import comm_params, resolve_interpret
+
+WORLD = 8
+
+
+def _run(mesh, kernel, x, axis="tp", out_shape=None, scratch_shapes=(),
+         collective_id=0, in_axes_spec=None, out_axes_spec=None):
+    spec = in_axes_spec or P(axis)
+    out_spec = out_axes_spec or spec
+    out_shape = out_shape or jax.ShapeDtypeStruct(
+        (x.shape[0] // mesh.shape[axis],) + x.shape[1:], x.dtype)
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=spec,
+                       out_specs=out_spec, check_vma=False)
+    def run(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=out_shape,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=list(scratch_shapes),
+            compiler_params=comm_params(collective_id),
+            interpret=resolve_interpret(None),
+        )(x)
+
+    return run(x)
+
+
+# --- identity / topology ---------------------------------------------------
+
+def test_shmem_pe_queries(mesh8):
+    """my_pe/n_pes/team_my_pe/team_n_pes (reference shmem identity API)."""
+    def kernel(x_ref, o_ref):
+        v = (shmem.my_pe("tp") * 1000 + shmem.n_pes("tp") * 100
+             + shmem.team_my_pe("tp") * 10 + jnp.int32(0))
+        o_ref[:] = jnp.full_like(o_ref, v + shmem.team_n_pes("tp"))
+
+    x = jnp.zeros((WORLD * 8, 128), jnp.int32)
+    got = np.asarray(_run(mesh8, kernel, x)).reshape(WORLD, 8, 128)
+    for r in range(WORLD):
+        assert (got[r] == r * 1000 + 800 + r * 10 + 8).all(), r
+
+
+def test_multi_value_wait(mesh8):
+    """notify(inc=k) accumulates; wait(k) consumes exactly k — split
+    waits must drain a single accumulated signal."""
+    def kernel(x_ref, o_ref, sem):
+        dl.notify(sem, inc=5)
+        dl.wait(sem, 3)               # consume 3 of the 5
+        dl.wait(sem, 2)               # drain the rest
+        o_ref[:] = jnp.full_like(o_ref, 52)
+
+    x = jnp.zeros((WORLD * 8, 128), jnp.int32)
+    got = _run(mesh8, kernel, x,
+               scratch_shapes=[pltpu.SemaphoreType.REGULAR])
+    assert (np.asarray(got) == 52).all()
+
+
+def test_semaphore_read(mesh8):
+    """semaphore_read observes without consuming (debug aid). The
+    interpreter may not implement it — hardware-only then."""
+    def kernel(x_ref, o_ref, sem):
+        dl.notify(sem, inc=5)
+        before = dl.semaphore_read(sem)
+        dl.wait(sem, 5)
+        o_ref[:] = jnp.full_like(o_ref, before)
+
+    x = jnp.zeros((WORLD * 8, 128), jnp.int32)
+    try:
+        got = _run(mesh8, kernel, x,
+                   scratch_shapes=[pltpu.SemaphoreType.REGULAR])
+    except NotImplementedError:
+        pytest.skip("semaphore_read unimplemented in interpret mode")
+    assert (np.asarray(got) == 5).all()
+
+
+def test_notify_wait_cross_rank_values(mesh8):
+    """Remote notify with inc>1: every rank signals its right neighbor
+    w+me times; neighbor waits for exactly that count."""
+    def kernel(x_ref, o_ref, sem):
+        me = dl.rank("tp")
+        n = dl.num_ranks("tp")
+        dst = jax.lax.rem(me + 1, n)
+        dl.notify(sem, peer=dst, inc=8 + dst, axis="tp")
+        dl.wait(sem, 8 + me)
+        o_ref[:] = jnp.full_like(o_ref, me)
+
+    x = jnp.zeros((WORLD * 8, 128), jnp.int32)
+    got = np.asarray(_run(
+        mesh8, kernel, x,
+        scratch_shapes=[pltpu.SemaphoreType.REGULAR])).reshape(WORLD, 8, 128)
+    for r in range(WORLD):
+        assert (got[r] == r).all()
+
+
+# --- one-sided data movement -----------------------------------------------
+
+def test_local_copy_roundtrip(mesh8):
+    """dl.local_copy: async same-chip DMA through a scratch buffer."""
+    def kernel(x_ref, o_ref, stage, sem):
+        cp = dl.local_copy(x_ref, stage, sem)
+        cp.start()
+        cp.wait()
+        o_ref[:] = stage[:] * 2.0
+
+    x = jnp.arange(WORLD * 8 * 128, dtype=jnp.float32).reshape(-1, 128)
+    got = _run(mesh8, kernel, x, scratch_shapes=[
+        pltpu.VMEM((8, 128), jnp.float32), pltpu.SemaphoreType.DMA])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(x) * 2.0)
+
+
+def test_remote_copy_full_exchange(mesh8):
+    """Every rank puts its block to EVERY peer slot (the reference's
+    putmem-to-all nvshmem case) with per-source semaphores."""
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        me = dl.rank("tp")
+        n = dl.num_ranks("tp")
+        o_ref[me] = x_ref[:]
+        dl.barrier_all("tp")
+
+        def put(p, _):
+            peer = jax.lax.rem(me + p, n)
+            dl.remote_copy(o_ref.at[me], o_ref.at[me], peer,
+                           send_sem.at[peer], recv_sem.at[me],
+                           axis="tp").start()
+            return _
+        jax.lax.fori_loop(1, n, put, None)
+
+        def wait_one(p, _):
+            src = jax.lax.rem(me - p + n, n)
+            dl.remote_copy(o_ref.at[src], o_ref.at[src], me,
+                           send_sem.at[src], recv_sem.at[src],
+                           axis="tp").wait_recv()
+            return _
+        jax.lax.fori_loop(1, n, wait_one, None)
+
+        def drain(p, _):
+            peer = jax.lax.rem(me + p, n)
+            dl.remote_copy(o_ref.at[me], o_ref.at[me], peer,
+                           send_sem.at[peer], recv_sem.at[me],
+                           axis="tp").wait_send()
+            return _
+        jax.lax.fori_loop(1, n, drain, None)
+
+    x = (jnp.arange(WORLD)[:, None, None]
+         * jnp.ones((WORLD, 8, 128))).astype(jnp.float32).reshape(-1, 128)
+    out_shape = jax.ShapeDtypeStruct((WORLD, 8, 128), jnp.float32)
+    got = _run(mesh8, kernel, x, out_shape=out_shape,
+               scratch_shapes=[pltpu.SemaphoreType.DMA((WORLD,)),
+                               pltpu.SemaphoreType.DMA((WORLD,))],
+               out_axes_spec=P("tp"))
+    got = np.asarray(got).reshape(WORLD, WORLD, 8, 128)
+    for r in range(WORLD):
+        for src in range(WORLD):
+            assert (got[r, src] == src).all(), (r, src)
+
+
+def test_putmem_block_blocking(mesh8):
+    """shmem.putmem_block: the blocking put (send side complete on
+    return)."""
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        me = dl.rank("tp")
+        dst = jax.lax.rem(me + 1, dl.num_ranks("tp"))
+        cp = shmem.putmem_block(o_ref, x_ref, dst, send_sem, recv_sem)
+        # putmem_block completes the SEND side; the receiver still
+        # observes delivery via its recv semaphore (NVSHMEM contract).
+        cp.wait_recv()
+
+    x = (jnp.arange(WORLD)[:, None, None]
+         * jnp.ones((WORLD, 8, 128))).astype(jnp.float32).reshape(-1, 128)
+    got = np.asarray(_run(mesh8, kernel, x, scratch_shapes=[
+        pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())])
+    ).reshape(WORLD, 8, 128)
+    for r in range(WORLD):
+        assert (got[r] == (r - 1) % WORLD).all(), r
+
+
+def test_putmem_signal_nbi_block_and_wait_until(mesh8):
+    """putmem_signal_nbi: on TPU the recv semaphore IS the delivery
+    signal (shmem.py docstring), so the receiver gates on wait_recv —
+    the analog of the reference's putmem_signal + signal_wait_until."""
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        me = dl.rank("tp")
+        n = dl.num_ranks("tp")
+        dst = jax.lax.rem(me + 1, n)
+        cp = shmem.putmem_signal_nbi_block(o_ref, x_ref, dst, send_sem,
+                                           recv_sem, axis="tp")
+        cp.wait_recv()
+        cp.wait_send()
+        o_ref[:] = o_ref[:] + 100.0
+
+    x = (jnp.arange(WORLD)[:, None, None]
+         * jnp.ones((WORLD, 8, 128))).astype(jnp.float32).reshape(-1, 128)
+    got = np.asarray(_run(mesh8, kernel, x, scratch_shapes=[
+        pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())])
+    ).reshape(WORLD, 8, 128)
+    for r in range(WORLD):
+        assert (got[r] == (r - 1) % WORLD + 100.0).all(), r
+
+
+def test_signal_op_add(mesh8):
+    """shmem.signal_op: bare remote signal (SIGNAL_ADD), no data."""
+    def kernel(x_ref, o_ref, flag):
+        me = dl.rank("tp")
+        n = dl.num_ranks("tp")
+        shmem.signal_op(flag, jax.lax.rem(me + 1, n), inc=4, axis="tp")
+        shmem.signal_wait_until(flag, shmem.CMP_GE, 4)
+        o_ref[:] = x_ref[:] + 1.0
+
+    x = jnp.zeros((WORLD * 8, 128), jnp.float32)
+    got = _run(mesh8, kernel, x,
+               scratch_shapes=[pltpu.SemaphoreType.REGULAR])
+    assert (np.asarray(got) == 1.0).all()
+
+
+def test_fence_and_quiet(mesh8):
+    """fence/quiet complete the send side of prior puts (reference
+    libshmem_device.fence/quiet semantics)."""
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        me = dl.rank("tp")
+        n = dl.num_ranks("tp")
+        dst = jax.lax.rem(me + 1, n)
+        cp = shmem.putmem_nbi_block(o_ref, x_ref, dst, send_sem, recv_sem,
+                                    axis="tp")
+        shmem.fence(cp)      # send-side ordering point
+        shmem.quiet()        # vacuous quiet (no descriptors) is legal
+        cp.wait_recv()
+        o_ref[:] = o_ref[:] * 3.0
+
+    x = (jnp.arange(WORLD)[:, None, None]
+         * jnp.ones((WORLD, 8, 128))).astype(jnp.float32).reshape(-1, 128)
+    got = np.asarray(_run(mesh8, kernel, x, scratch_shapes=[
+        pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())])
+    ).reshape(WORLD, 8, 128)
+    for r in range(WORLD):
+        assert (got[r] == 3.0 * ((r - 1) % WORLD)).all(), r
+
+
+# --- barriers ---------------------------------------------------------------
+
+def test_barrier_neighbors_ring_step(mesh8):
+    """barrier_neighbors is sufficient to order ring-neighbor puts."""
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        me = dl.rank("tp")
+        n = dl.num_ranks("tp")
+        dst = jax.lax.rem(me + 1, n)
+        cp = shmem.putmem_nbi_block(o_ref, x_ref, dst, send_sem, recv_sem,
+                                    axis="tp")
+        cp.wait()
+        dl.barrier_neighbors("tp")
+        o_ref[:] = o_ref[:] + 0.5
+
+    x = (jnp.arange(WORLD)[:, None, None]
+         * jnp.ones((WORLD, 8, 128))).astype(jnp.float32).reshape(-1, 128)
+    got = np.asarray(_run(mesh8, kernel, x, scratch_shapes=[
+        pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())],
+        collective_id=2)).reshape(WORLD, 8, 128)
+    for r in range(WORLD):
+        assert (got[r] == (r - 1) % WORLD + 0.5).all(), r
+
+
+def test_shmem_barrier_all_alias(mesh8):
+    """shmem.barrier_all delegates to dl.barrier_all."""
+    def kernel(x_ref, o_ref):
+        shmem.barrier_all("tp")
+        o_ref[:] = x_ref[:] + 7.0
+
+    x = jnp.zeros((WORLD * 8, 128), jnp.float32)
+    got = _run(mesh8, kernel, x, collective_id=3)
+    assert (np.asarray(got) == 7.0).all()
+
+
+# --- multi-axis meshes -------------------------------------------------------
+
+@pytest.mark.parametrize("axis,other", [("tp", "ep"), ("ep", "tp")])
+def test_put_ring_2d_mesh_both_axes(mesh4x2, axis, other):
+    """Ring put along EITHER axis of a (tp=4, ep=2) mesh:
+    logical_device_id must translate axis-relative peers to global ids
+    (VERDICT r2 next 10 '2-D mesh variants')."""
+    world = mesh4x2.shape[axis]
+    mesh_axes = ("tp", "ep")
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        me = dl.rank(axis)
+        dst = jax.lax.rem(me + 1, jnp.int32(world))
+        cp = shmem.putmem_nbi_block(o_ref, x_ref, dst, send_sem, recv_sem,
+                                    axis=axis, mesh_axes=mesh_axes)
+        cp.wait()
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map, mesh=mesh4x2, in_specs=P(("tp", "ep")),
+        out_specs=P(("tp", "ep")), check_vma=False)
+    def run(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(())],
+            compiler_params=comm_params(0),
+            interpret=resolve_interpret(None),
+        )(x)
+
+    # value = global device index
+    x = (jnp.arange(WORLD)[:, None, None]
+         * jnp.ones((WORLD, 8, 128))).astype(jnp.float32).reshape(-1, 128)
+    got = np.asarray(run(x)).reshape(4, 2, 8, 128)
+    for tp in range(4):
+        for ep in range(2):
+            if axis == "tp":
+                src = ((tp - 1) % 4) * 2 + ep
+            else:
+                src = tp * 2 + (ep - 1) % 2
+            assert (got[tp, ep] == src).all(), (axis, tp, ep)

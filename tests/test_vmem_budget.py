@@ -1,0 +1,146 @@
+"""Bench-shape VMEM-budget checks (VERDICT r2 next 10).
+
+BENCH_r02's crash class — a default config whose VMEM scratch exceeds
+the v5e's 16 MB limit — must fail HERE, in CI on any host, not on the
+chip. ``check_entry_vmem`` traces each op's ``impl="pallas"`` entry at
+the exact bench.py shapes with ``jax.eval_shape`` (no execution) and
+asserts the static footprint of every ``pallas_call`` it contains.
+World=1 (the bench environment) and world=8 are both checked: round 2's
+failure was world=1-specific (n_loc = N, the largest B panel).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from triton_dist_tpu.testing.vmem import (
+    VmemBudgetError, assert_vmem_within, check_entry_vmem)
+
+bf16 = jnp.bfloat16
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("tp",))
+
+
+@pytest.mark.parametrize("world", [1, 8])
+def test_ag_gemm_bench_shape_fits(world):
+    from triton_dist_tpu.ops.allgather_gemm import (
+        create_ag_gemm_context, ag_gemm)
+    mesh = _mesh(world)
+    ctx = create_ag_gemm_context(mesh, "tp", interpret=True)
+    m, k, n = 2048, 4096, 4096  # bench.py shape
+    check_entry_vmem(
+        lambda a, b: ag_gemm(a, b, ctx, impl="pallas"),
+        jax.ShapeDtypeStruct((m, k), bf16),
+        jax.ShapeDtypeStruct((k, n), bf16))
+
+
+@pytest.mark.parametrize("world", [1, 8])
+def test_gemm_rs_bench_shape_fits(world):
+    from triton_dist_tpu.ops.gemm_reduce_scatter import (
+        create_gemm_rs_context, gemm_rs)
+    mesh = _mesh(world)
+    ctx = create_gemm_rs_context(mesh, "tp", interpret=True)
+    m, k, n = 2048, 4096, 4096
+    check_entry_vmem(
+        lambda a, b: gemm_rs(a, b, ctx, impl="pallas"),
+        jax.ShapeDtypeStruct((m, k), bf16),
+        jax.ShapeDtypeStruct((k, n), bf16))
+
+
+@pytest.mark.parametrize("world", [1, 8])
+def test_gemm_ar_bench_shape_fits(world):
+    from triton_dist_tpu.ops.gemm_reduce_scatter import (
+        create_gemm_rs_context, gemm_ar)
+    mesh = _mesh(world)
+    ctx = create_gemm_rs_context(mesh, "tp", interpret=True)
+    m, k, n = 128, 4096, 4096  # decode GEMM-AR bench shape
+    check_entry_vmem(
+        lambda a, b: gemm_ar(a, b, ctx, impl="pallas"),
+        jax.ShapeDtypeStruct((m, k), bf16),
+        jax.ShapeDtypeStruct((k, n), bf16))
+
+
+@pytest.mark.parametrize("world", [1, 8])
+def test_flash_decode_serving_shape_fits(world):
+    from triton_dist_tpu.ops.flash_decode import (
+        create_flash_decode_context, gqa_fwd_batch_decode)
+    mesh = _mesh(world)
+    ctx = create_flash_decode_context(mesh, "tp", interpret=True,
+                                      variant="tiled", t_blk=512)
+    b, hq, hkv, d, t = 8, 32, 8, 128, 8192  # bench.py serving shape
+    check_entry_vmem(
+        lambda q, kc, vc, n: gqa_fwd_batch_decode(q, kc, vc, n, ctx,
+                                                  impl="pallas"),
+        jax.ShapeDtypeStruct((b, hq, d), bf16),
+        jax.ShapeDtypeStruct((b, t, hkv, d), bf16),
+        jax.ShapeDtypeStruct((b, t, hkv, d), bf16),
+        jax.ShapeDtypeStruct((), jnp.int32))
+
+
+def test_sp_attention_fused_prefill_shape_fits():
+    """The fused SP kernel's documented envelope — q/o and the fp32
+    online-softmax state VMEM-resident, s_loc·hq·d·4B bounded — at a
+    realistic distributed prefill shape (16k positions over 8 ranks)."""
+    from triton_dist_tpu.ops.sp_attention import (
+        create_sp_attention_context, sp_ag_attention_fused)
+    mesh = _mesh(8)
+    ctx = create_sp_attention_context(mesh, "tp", causal=True,
+                                      interpret=True)
+    b, s, hq, hkv, d = 1, 16384, 8, 2, 128   # s_loc = 2048
+    check_entry_vmem(
+        lambda q, k, v: sp_ag_attention_fused(q, k, v, ctx),
+        jax.ShapeDtypeStruct((b, s, hq, d), bf16),
+        jax.ShapeDtypeStruct((b, s, hkv, d), bf16),
+        jax.ShapeDtypeStruct((b, s, hkv, d), bf16))
+
+
+def test_vmem_budget_catches_oversized_kernel():
+    """The helper itself must detect an oversized kernel — the BENCH_r02
+    config (16.5 MB of scratch on a 16 MB chip) reproduced in miniature."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(x_ref, o_ref, big):
+        o_ref[:] = x_ref[:]
+
+    def entry(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((128, 128), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.VMEM((2, 2048, 4096), jnp.float32)],
+            interpret=True,
+        )(x)
+
+    with pytest.raises(VmemBudgetError):
+        with assert_vmem_within(16 * 1024 * 1024):
+            jax.eval_shape(entry, jax.ShapeDtypeStruct((128, 128),
+                                                       jnp.float32))
+
+
+def test_vmem_budget_ignores_any_and_semaphores():
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(x_hbm, o_hbm, sem):
+        pass
+
+    def entry(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8192, 8192), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((8,))],
+            interpret=True,
+        )(x)
+
+    # 256 MB operands in ANY (HBM) space must not trip the VMEM budget.
+    with assert_vmem_within(16 * 1024 * 1024):
+        jax.eval_shape(entry, jax.ShapeDtypeStruct((8192, 8192),
+                                                   jnp.float32))

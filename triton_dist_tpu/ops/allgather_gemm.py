@@ -512,16 +512,10 @@ def _autotune_ag_gemm(a, bs, ctx, key, n_tot_loc):
         ctx2 = dataclasses.replace(ctx, autotune=False, **cfg)
         fn = jax.jit(lambda x, ws: ag_gemm_multi(x, ws, ctx2,
                                                  impl="pallas"))
-        counter = [0]
-
-        def run():
-            # Unique input per call: the tunneled device dedupes
-            # identical computations, which would void the ranking.
-            from triton_dist_tpu.runtime.utils import perturb_input
-            counter[0] += 1
-            return jax.block_until_ready(
-                fn(perturb_input(a, counter[0]), list(bs)))
-        return run
+        # Unique input per call: the tunneled device dedupes identical
+        # computations, which would void the ranking.
+        from triton_dist_tpu.runtime.utils import make_perturbed_runner
+        return make_perturbed_runner(fn, a, list(bs))
 
     result = autotune(make_fn, cfgs, key=f"ag_gemm:{key}", iters=8,
                       warmup_iters=2)

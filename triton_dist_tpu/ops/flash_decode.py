@@ -71,8 +71,12 @@ class FlashDecodeContext:
     interpret: bool | None = None
     # Local-partial variant: "tiled" | "einsum" | "auto" (by shard bytes).
     variant: str = "auto"
-    # KV positions per VMEM tile for the tiled variant (dense path).
+    # KV positions per VMEM tile for the tiled variant (dense path);
+    # auto-shrunk so the two double-buffered (B, t_blk, Hkv, D) K/V tiles
+    # fit ``vmem_budget`` (BENCH_r02 class: an infeasible tile size must
+    # never reach the compiler — tests/test_vmem_budget.py).
     t_blk: int = 512
+    vmem_budget: int = 10 * 1024 * 1024
     # Byte threshold for auto: einsum below (shard fits VMEM comfortably).
     einsum_max_bytes: int = 4 * 1024 * 1024
 
@@ -403,9 +407,19 @@ def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
                               interpret)
 
     # tiled variant: KV stays in HBM, dummy 1x1 table (dense addressing).
-    t_blk = min(ctx.t_blk, t_loc)
-    while t_loc % t_blk:
-        t_blk //= 2
+    def _div_leq(cap: int) -> int:
+        # Largest divisor of t_loc <= cap — tile slicing and the
+        # liveness mask both assume t_blk | t_loc.
+        cap = max(min(cap, t_loc), 1)
+        while t_loc % cap:
+            cap -= 1
+        return cap
+
+    t_blk = _div_leq(ctx.t_blk)
+    # 4 tiles (K+V, double-buffered) must fit the VMEM budget.
+    per_pos = 4 * b * hkv * d * cache_k.dtype.itemsize
+    while t_blk > 8 and t_blk * per_pos > ctx.vmem_budget:
+        t_blk = _div_leq(t_blk // 2)
     kernel = functools.partial(
         _tiled_decode_kernel, axis=axis, world=world, batch=b, hkv=hkv,
         groups=groups, d=d, t_loc=t_loc, t_blk=t_blk, paged=False)

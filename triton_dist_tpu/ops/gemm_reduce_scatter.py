@@ -113,16 +113,10 @@ def _autotune_gemm_rs(a, b, ctx, key, all_gather_epilogue):
     def make_fn(**cfg):
         ctx2 = dataclasses.replace(ctx, autotune=False, **cfg)
         fn = jax.jit(lambda x, w: entry(x, w, ctx2, impl="pallas"))
-        counter = [0]
-
-        def run():
-            # Unique input per call: the tunneled device dedupes
-            # identical computations, which would void the ranking.
-            from triton_dist_tpu.runtime.utils import perturb_input
-            counter[0] += 1
-            return jax.block_until_ready(
-                fn(perturb_input(a, counter[0]), b))
-        return run
+        # Unique input per call: the tunneled device dedupes identical
+        # computations, which would void the ranking.
+        from triton_dist_tpu.runtime.utils import make_perturbed_runner
+        return make_perturbed_runner(fn, a, b)
 
     result = autotune(make_fn, cfgs, key=f"gemm_rs:{key}", iters=8,
                       warmup_iters=2)
