@@ -282,6 +282,84 @@ def _bench_flash_decode(mesh, n, on_tpu, extras):
     return t_pallas, t_xla / t_pallas
 
 
+def _bench_ag_group_gemm(mesh, n, on_tpu, extras):
+    """Fused-Pallas vs ppermute-ring AG+grouped-GEMM (VERDICT r2 next 7:
+    measure both on the chip, keep whichever wins)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from triton_dist_tpu.ops.group_gemm import (
+        create_ag_group_gemm_context, ag_group_gemm)
+    from triton_dist_tpu.runtime.utils import perf_func_chained
+
+    m, k, nn, n_exp = (2048, 4096, 4096, 8) if on_tpu else (64, 64, 128, 4)
+    ctx = create_ag_group_gemm_context(mesh, "tp")
+    ctx.interpret = None if not on_tpu else False
+    x0 = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(0), (m, k), jnp.float32
+                          ).astype(jnp.bfloat16),
+        NamedSharding(mesh, P("tp")))
+    w = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (n_exp, k, nn),
+                          jnp.float32).astype(jnp.bfloat16),
+        NamedSharding(mesh, P(None, None, "tp")))
+    eid = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(2), (m,), 0, n_exp,
+                           jnp.int32),
+        NamedSharding(mesh, P("tp")))
+
+    def make_step(impl):
+        @jax.jit
+        def step(x):
+            c = ag_group_gemm(x, w, eid, n_exp, ctx, impl=impl)
+            return (c[:, :k].astype(jnp.float32) * 1e-3
+                    ).astype(jnp.bfloat16)
+        return step
+
+    t_fused = perf_func_chained(make_step("fused"), x0, (8, 24))
+    t_ring = perf_func_chained(make_step("ring"), x0, (8, 24))
+    extras["moe_ag_gg_fused_ms"] = round(t_fused, 4)
+    extras["moe_ag_gg_ring_ms"] = round(t_ring, 4)
+    extras["moe_ag_gg_winner"] = ("fused" if t_fused <= t_ring
+                                  else "ring")
+
+    # MoE-RS: fused single kernel vs ppermute ring (same VERDICT item).
+    from triton_dist_tpu.ops.moe_reduce_rs import (
+        create_moe_rs_context, moe_reduce_rs)
+    topk = 2
+    t_tok, inter, hid = (2048, 4096, 4096) if on_tpu else (64, 128, 128)
+    mctx = create_moe_rs_context(mesh, "tp", num_experts=n_exp, topk=topk)
+    mctx.interpret = None if not on_tpu else False
+    act0 = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(3), (t_tok * topk, inter),
+                          jnp.float32).astype(jnp.bfloat16),
+        NamedSharding(mesh, P(None, "tp")))
+    wdn = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(4), (n_exp, inter, hid),
+                          jnp.float32).astype(jnp.bfloat16),
+        NamedSharding(mesh, P(None, "tp")))
+    eid2 = jax.random.randint(jax.random.PRNGKey(5), (t_tok * topk,), 0,
+                              n_exp, jnp.int32)
+    wts = jax.nn.softmax(jax.random.normal(
+        jax.random.PRNGKey(6), (t_tok, topk), jnp.float32))
+
+    def make_mrs(impl):
+        @jax.jit
+        def step(a):
+            out = moe_reduce_rs(a, wdn, eid2, wts, mctx, impl=impl)
+            reps = (t_tok * topk * inter) // (out.shape[0] * out.shape[1])
+            full = jnp.tile(out, (max(reps, 1), 1))[:t_tok * topk, :inter]
+            return (full.astype(jnp.float32) * 1e-3).astype(jnp.bfloat16)
+        return step
+
+    t_mf = perf_func_chained(make_mrs("fused"), act0, (8, 24))
+    t_mr = perf_func_chained(make_mrs("ring"), act0, (8, 24))
+    extras["moe_rs_fused_ms"] = round(t_mf, 4)
+    extras["moe_rs_ring_ms"] = round(t_mr, 4)
+    extras["moe_rs_winner"] = "fused" if t_mf <= t_mr else "ring"
+    return min(t_fused, t_ring), t_ring / t_fused
+
+
 def _bench_tp_mlp(mesh, n, on_tpu, extras):
     import jax
     import jax.numpy as jnp
@@ -347,6 +425,8 @@ def main():
                 ("gemm_ar", lambda: _bench_gemm_ar(mesh, n, on_tpu, extras)),
                 ("flash_decode",
                  lambda: _bench_flash_decode(mesh, n, on_tpu, extras)),
+                ("moe_ag_gg",
+                 lambda: _bench_ag_group_gemm(mesh, n, on_tpu, extras)),
                 ("tp_mlp", lambda: _bench_tp_mlp(mesh, n, on_tpu, extras)),
         ):
             try:

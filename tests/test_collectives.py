@@ -105,3 +105,19 @@ def test_all_reduce_jit_composes(mesh8, key):
     got = f(x)
     ref = np.asarray(x, np.float64).sum(axis=0) * 2 + 1
     assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast(mesh8, key, root):
+    """Root-push broadcast (reference LL-AG broadcast variants,
+    low_latency_allgather.py:48-210): every device ends with the root's
+    chunk."""
+    from triton_dist_tpu.ops.allgather import (
+        create_allgather_context, broadcast)
+    x = _mk(key, (WORLD * 16, 128), jnp.float32)
+    ctx = create_allgather_context(mesh8, "tp")
+    got = broadcast(x, root=root, ctx=ctx, impl="pallas")
+    expect = np.asarray(x).reshape(WORLD, 16, 128)[root]
+    np.testing.assert_allclose(np.asarray(got), expect)
+    gold = broadcast(x, root=root, ctx=ctx, impl="xla")
+    np.testing.assert_allclose(np.asarray(gold), expect)

@@ -304,3 +304,72 @@ def test_ep_moe_layer_matches_tp_moe(mesh8, key):
     out_tp = tp(tp_params, xs, mode="ag_rs")
     np.testing.assert_allclose(np.asarray(out_ep), np.asarray(out_tp),
                                rtol=1e-3, atol=1e-3)
+
+
+def test_ag_group_gemm_fused_kernel(mesh8, key):
+    """ONE-Pallas-kernel AG + grouped GEMM over the tile-aligned schedule
+    matches the xla golden (VERDICT r2 next 7; reference fused
+    producer/consumer allgather_group_gemm.py:608)."""
+    from triton_dist_tpu.ops.group_gemm import (
+        create_ag_group_gemm_context, ag_group_gemm)
+    world, n_exp = 8, 4
+    m, k, n = world * 16, 64, world * 32
+    rng = np.random.RandomState(3)
+    x = jax.device_put(jnp.asarray(rng.randn(m, k) / 4, jnp.float32),
+                       NamedSharding(mesh8, P("tp")))
+    w = jax.device_put(
+        jnp.asarray(rng.randn(n_exp, k, n) / 4, jnp.float32),
+        NamedSharding(mesh8, P(None, None, "tp")))
+    eid = jax.device_put(
+        jnp.asarray(rng.randint(0, n_exp, m), jnp.int32),
+        NamedSharding(mesh8, P("tp")))
+    ctx = create_ag_group_gemm_context(mesh8, "tp")
+    ctx.block_m, ctx.block_n = 8, 32
+    got = ag_group_gemm(x, w, eid, n_exp, ctx, impl="fused")
+    gold = ag_group_gemm(x, w, eid, n_exp, ctx, impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(gold),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_align_tokens_for_tiles_schedule():
+    """Every tile of the aligned layout touches exactly one expert and
+    dest maps rows back losslessly."""
+    from triton_dist_tpu.ops.group_gemm import align_tokens_for_tiles
+    rng = np.random.RandomState(0)
+    m, k, e, blk = 50, 8, 4, 8
+    tokens = jnp.asarray(rng.randn(m, k), jnp.float32)
+    ids = jnp.asarray(rng.randint(0, e, m), jnp.int32)
+    padded, tile_e, dest = align_tokens_for_tiles(tokens, ids, e, blk)
+    padded, tile_e, dest = map(np.asarray, (padded, tile_e, dest))
+    # round trip
+    np.testing.assert_allclose(padded[dest], np.asarray(tokens))
+    # one expert per tile: every live row's tile expert matches its id
+    for r in range(m):
+        t = dest[r] // blk
+        assert tile_e[t] == int(ids[r]), (r, t)
+
+
+def test_moe_reduce_rs_fused_kernel(mesh8, key):
+    """Single-kernel MoE down-proj + topk-reduce + ring RS matches the
+    xla golden (VERDICT r2 next 7; reference fused producer/reducer
+    moe_reduce_rs.py:167-546)."""
+    from triton_dist_tpu.ops.moe_reduce_rs import (
+        create_moe_rs_context, moe_reduce_rs)
+    world, n_exp, topk = 8, 4, 2
+    t, inter, hid = world * 8, 128, 256
+    rng = np.random.RandomState(5)
+    ctx = create_moe_rs_context(mesh8, "tp", num_experts=n_exp, topk=topk)
+    ctx.block_m, ctx.block_h = 8, 64
+    act = jax.device_put(
+        jnp.asarray(rng.randn(t * topk, inter) / 4, jnp.float32),
+        NamedSharding(mesh8, P(None, "tp")))
+    wdown = jax.device_put(
+        jnp.asarray(rng.randn(n_exp, inter, hid) / 4, jnp.float32),
+        NamedSharding(mesh8, P(None, "tp")))
+    eid = jnp.asarray(rng.randint(0, n_exp, t * topk), jnp.int32)
+    wts = jnp.asarray(
+        np.abs(rng.randn(t, topk)) / topk, jnp.float32)
+    got = moe_reduce_rs(act, wdown, eid, wts, ctx, impl="fused")
+    gold = moe_reduce_rs(act, wdown, eid, wts, ctx, impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(gold),
+                               rtol=2e-3, atol=2e-3)

@@ -144,3 +144,35 @@ def test_vmem_budget_ignores_any_and_semaphores():
     with assert_vmem_within(16 * 1024 * 1024):
         jax.eval_shape(entry, jax.ShapeDtypeStruct((8192, 8192),
                                                    jnp.float32))
+
+
+@pytest.mark.parametrize("world", [1, 8])
+def test_ag_group_gemm_fused_bench_shape_fits(world):
+    from triton_dist_tpu.ops.group_gemm import (
+        create_ag_group_gemm_context, ag_group_gemm)
+    mesh = _mesh(world)
+    ctx = create_ag_group_gemm_context(mesh, "tp")
+    ctx.interpret = True
+    m, k, n, e = 2048, 4096, 4096, 8
+    check_entry_vmem(
+        lambda x, w, ids: ag_group_gemm(x, w, ids, e, ctx, impl="fused"),
+        jax.ShapeDtypeStruct((m, k), bf16),
+        jax.ShapeDtypeStruct((e, k, n), bf16),
+        jax.ShapeDtypeStruct((m,), jnp.int32))
+
+
+@pytest.mark.parametrize("world", [1, 8])
+def test_moe_reduce_rs_fused_bench_shape_fits(world):
+    from triton_dist_tpu.ops.moe_reduce_rs import (
+        create_moe_rs_context, moe_reduce_rs)
+    mesh = _mesh(world)
+    t, topk, inter, hid, e = 2048, 2, 4096, 4096, 8
+    ctx = create_moe_rs_context(mesh, "tp", num_experts=e, topk=topk)
+    ctx.interpret = True
+    check_entry_vmem(
+        lambda a, w, ids, wts: moe_reduce_rs(a, w, ids, wts, ctx,
+                                             impl="fused"),
+        jax.ShapeDtypeStruct((t * topk, inter), bf16),
+        jax.ShapeDtypeStruct((e, inter, hid), bf16),
+        jax.ShapeDtypeStruct((t * topk,), jnp.int32),
+        jax.ShapeDtypeStruct((t, topk), jnp.float32))

@@ -146,6 +146,19 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     case("ag_gemm_multi",
          lambda: ag_gemm_multi(a, [b, b2], ctx, impl="pallas"))
 
+    # Bench-shape hbm cases (VERDICT r2: smoke at 512^2 missed the
+    # 16.5 MB VMEM crash that killed BENCH_r02 at 2048x4096x4096).
+    ab = sharded(randn((2048, 4096)), P("tp"))
+    bb = sharded(randn((4096, 4096), k=13), P(None, "tp"))
+    bench_ctx = create_ag_gemm_context(mesh, "tp", interpret=interpret)
+    case("ag_gemm/bench_shape",
+         lambda: ag_gemm(ab, bb, bench_ctx, impl="pallas"))
+    inj_ctx = create_ag_gemm_context(mesh, "tp", interpret=interpret)
+    inj_ctx.for_correctness = True
+    inj_ctx.straggler_option = (0, 10000)
+    case("ag_gemm/injection",
+         lambda: ag_gemm(a, b, inj_ctx, impl="pallas"))
+
     from triton_dist_tpu.ops.gemm_reduce_scatter import (
         create_gemm_rs_context, gemm_rs, gemm_ar)
     rs_ctx2 = create_gemm_rs_context(mesh, "tp", interpret=interpret)
@@ -153,6 +166,15 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     b_rs = sharded(randn((512, 512), k=3), P("tp"))
     case("gemm_rs", lambda: gemm_rs(a_rs, b_rs, rs_ctx2, impl="pallas"))
     case("gemm_ar", lambda: gemm_ar(a_rs, b_rs, rs_ctx2, impl="pallas"))
+    a_rsb = sharded(randn((2048, 4096)), P(None, "tp"))
+    b_rsb = sharded(randn((4096, 4096), k=14), P("tp"))
+    case("gemm_rs/bench_shape",
+         lambda: gemm_rs(a_rsb, b_rsb, rs_ctx2, impl="pallas"))
+    # Decode GEMM-AR at production width via the hbm epilogue path
+    # (VERDICT r2 next 5).
+    a_ar = sharded(randn((128, 4096)), P(None, "tp"))
+    case("gemm_ar/decode_shape",
+         lambda: gemm_ar(a_ar, b_rsb, rs_ctx2, impl="pallas"))
 
     # --- EP / MoE ---------------------------------------------------------
     from triton_dist_tpu.ops.all_to_all import (
@@ -171,6 +193,8 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     eid = sharded(jax.random.randint(key, (128,), 0, 4, jnp.int32), P("tp"))
     case("ag_group_gemm",
          lambda: ag_group_gemm(xg, wg, eid, 4, gg_ctx, impl="ring"))
+    case("ag_group_gemm/fused",
+         lambda: ag_group_gemm(xg, wg, eid, 4, gg_ctx, impl="fused"))
 
     from triton_dist_tpu.ops.moe_reduce_rs import (
         create_moe_rs_context, moe_reduce_rs)
@@ -184,6 +208,9 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     case("moe_reduce_rs",
          lambda: moe_reduce_rs(act, wdown, eid2, wts, mrs_ctx,
                                impl="ring"))
+    case("moe_reduce_rs/fused",
+         lambda: moe_reduce_rs(act, wdown, eid2, wts, mrs_ctx,
+                               impl="fused"))
 
     # --- SP attention -----------------------------------------------------
     from triton_dist_tpu.ops.flash_decode import (
@@ -215,6 +242,18 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
              q, pool_k, pool_v, table, jnp.int32(n_pages * page // 2),
              fd_paged))
 
+    # Serving shape (bench.py flash_decode line: B=8, 32 heads, t=8k).
+    def fd_serving():
+        bs, hqs, hkvs, ds, ts = 8, 32, 8, 128, 8192
+        qv = randn((bs, hqs, ds), k=15)
+        kcs = sharded(randn((bs, ts, hkvs, ds), k=16), P(None, "tp"))
+        vcs = sharded(randn((bs, ts, hkvs, ds), k=17), P(None, "tp"))
+        ctx = create_flash_decode_context(mesh, "tp", variant="tiled",
+                                          t_blk=512, interpret=interpret)
+        return gqa_fwd_batch_decode(qv, kcs, vcs, jnp.int32(ts - 7), ctx,
+                                    impl="pallas")
+    case("flash_decode/serving_shape", fd_serving)
+
     from triton_dist_tpu.ops.sp_attention import (
         create_sp_attention_context, sp_ag_attention)
     sp_ctx = create_sp_attention_context(mesh, "tp", causal=True,
@@ -227,6 +266,17 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         case(f"sp_ag_attention/{impl}",
              lambda impl=impl: sp_ag_attention(qs, ks, vs, sp_ctx,
                                                impl=impl))
+
+    # EP-mode MoE layer end-to-end, world=1-compilable (VERDICT r2
+    # next 6; reference test_ep_moe_inference.py).
+    def ep_moe_case():
+        from triton_dist_tpu.layers.ep_moe import EPMoE
+        layer = EPMoE(256, 512, num_experts=4, topk=2, mesh=mesh,
+                      axis="tp", dtype=bf16)
+        params = layer.init(jax.random.PRNGKey(3))
+        xe = sharded(randn((64, 256), k=18), P("tp"))
+        return layer(params, xe)
+    case("ep_moe", ep_moe_case)
 
     # --- PP ---------------------------------------------------------------
     from triton_dist_tpu.ops.p2p import create_p2p_context, pp_shift

@@ -47,6 +47,21 @@ class AllGatherMethod(enum.Enum):
     RING_1D = "ring_1d"
     RING_BIDIR = "ring_bidir"
     FULL_MESH_PUSH = "full_mesh_push"
+    # One source rank pushes its buffer to every peer (reference
+    # low_latency_allgather.py broadcast variants :48-210).
+    BROADCAST = "broadcast"
+
+
+# LL (flag-in-data) packet mapping: the reference's low-latency AG packs
+# an 8-byte flag into each 16-byte data quantum so the receiver can spin
+# on the DATA buffer instead of a separate signal
+# (low_latency_allgather.py:531-549 _pack_ll_block/_recv_ll_block) — an
+# artifact of NVLink writes carrying no completion signal. On TPU the
+# transport signals the receiver's DMA semaphore ON DELIVERY of each
+# remote copy, so every `impl="pallas"` method here already has LL
+# semantics: the per-chunk recv-semaphore wait IS the flag spin, with no
+# bandwidth tax and no two-pass packing. The 2d/3d multinode variants
+# (:48-780) map to ops/hierarchical.all_gather_2d (ICI x DCN two-level).
 
 
 def get_auto_all_gather_method(world_size: int, nbytes_per_rank: int,
@@ -194,6 +209,42 @@ def _ring_ag_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis: str,
     lax.fori_loop(0, max(n_fwd, n_bwd), drain, None)
 
 
+def _broadcast_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis: str,
+                      world: int, root: int):
+    """Root pushes its full buffer to every peer (reference LL-AG
+    broadcast, low_latency_allgather.py:48-210). Non-root ranks just
+    wait for delivery on their recv semaphore (the LL flag analog)."""
+    me = lax.axis_index(axis)
+
+    @pl.when(me == root)
+    def _():
+        o_ref[...] = x_ref[...]
+    if world == 1:
+        return
+    dl.barrier_all(axis)
+
+    def copy_to(peer):
+        return dl.remote_copy(o_ref, o_ref, peer, send_sem.at[peer],
+                              recv_sem, axis=axis)
+
+    @pl.when(me == root)
+    def _():
+        def send(p, _):
+            peer = lax.rem(root + p, world)
+            copy_to(peer).start()
+            return _
+        lax.fori_loop(1, world, send, None)
+
+        def drain(p, _):
+            copy_to(lax.rem(root + p, world)).wait_send()
+            return _
+        lax.fori_loop(1, world, drain, None)
+
+    @pl.when(me != root)
+    def _():
+        copy_to(me).wait_recv()
+
+
 def _full_mesh_push_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis: str,
                            world: int, rows: int, straggler_option=None,
                            for_correctness=False, interp=False):
@@ -273,6 +324,11 @@ def all_gather(x: jax.Array, ctx: AllGatherContext | None = None,
 
     interpret = resolve_interpret(ctx.interpret)
 
+    if method is AllGatherMethod.BROADCAST:
+        raise ValueError(
+            "BROADCAST is one-to-all, not an all-gather — call "
+            "ops.allgather.broadcast(x, root, ctx) instead")
+
     inject = dict(straggler_option=ctx.straggler_option,
                   for_correctness=ctx.for_correctness,
                   interp=bool(interpret))
@@ -302,4 +358,53 @@ def all_gather(x: jax.Array, ctx: AllGatherContext | None = None,
 
     f = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
                       out_specs=out_spec, check_vma=False)
+    return sync_interpret(f(x), interpret)
+
+
+def broadcast(x: jax.Array, root: int = 0,
+              ctx: AllGatherContext | None = None,
+              impl: str = "pallas") -> jax.Array:
+    """Rank ``root``'s row-chunk of ``x`` on every device (reference
+    LL-AG broadcast variants, low_latency_allgather.py:48-210).
+
+    Args:
+      x: (w·M, N) row-sharded over ``ctx.axis`` — chunk r is rank r's
+        buffer.
+    Returns:
+      (M, N) — the root's chunk, replicated.
+    """
+    ctx = ctx or create_allgather_context()
+    mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    assert x.shape[0] % world == 0
+    if not 0 <= root < world:
+        raise ValueError(f"root {root} out of range for world {world}")
+    rows = x.shape[0] // world
+
+    if impl == "xla":
+        def body(xs):
+            src = jnp.zeros((world,), x.dtype).at[root].set(1).reshape(
+                (world,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+            return lax.psum(xs * src[lax.axis_index(axis)], axis)
+        f = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+                          out_specs=P(), check_vma=False)
+        return f(x)
+
+    interpret = resolve_interpret(ctx.interpret)
+    kernel = functools.partial(_broadcast_kernel, axis=axis, world=world,
+                               root=root)
+
+    def body(xs):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((rows,) + x.shape[1:], x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((world,)),
+                            pltpu.SemaphoreType.DMA],
+            compiler_params=comm_params(collective_id=1, world=world),
+            interpret=interpret,
+        )(xs)
+
+    f = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+                      out_specs=P(), check_vma=False)
     return sync_interpret(f(x), interpret)

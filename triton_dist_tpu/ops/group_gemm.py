@@ -19,12 +19,18 @@ the collective-matmul schedule XLA's latency-hiding scheduler can overlap
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+import triton_dist_tpu.language as dl
+from triton_dist_tpu.ops.common import (
+    any_spec, comm_params, resolve_interpret, round_up, sync_interpret)
 from triton_dist_tpu.ops.moe_utils import sort_by_group
 
 
@@ -74,6 +80,190 @@ def grouped_expert_ffn(tokens: jax.Array, w_gate: jax.Array, w_up: jax.Array,
     return down.astype(tokens.dtype)[unsort]
 
 
+def align_tokens_for_tiles(tokens: jax.Array, ids: jax.Array,
+                           num_experts: int, m_blk: int):
+    """Tile-align tokens by expert (traced; static shapes).
+
+    The TPU analog of the reference's token→tile alignment
+    (``moe_ag_scatter_align_block_size`` csrc/lib/moe_utils.cu:61 +
+    threadblock_swizzle_ag_moe): rows are expert-sorted and each expert
+    group is padded to an ``m_blk`` boundary, so every (m_blk, K) tile of
+    the padded layout touches EXACTLY ONE expert — the schedule the fused
+    kernel iterates.
+
+    Returns:
+      padded: (M_pad, K) expert-sorted, group-padded tokens (pad rows 0).
+      tile_experts: (M_pad // m_blk,) int32 expert of each tile.
+      dest: (M,) int32 — padded row index of each original row (invalid
+        rows, ``ids == num_experts``, collide into the trailing trash
+        tile and must be masked by callers).
+    """
+    m, k = tokens.shape
+    e = num_experts
+    # Worst case: every group padded by m_blk-1, plus one trash tile.
+    m_pad = round_up(m + e * (m_blk - 1), m_blk) + m_blk
+    valid = ids < e
+    eids = jnp.clip(ids, 0, e - 1)
+    sizes = jnp.sum(
+        jax.nn.one_hot(jnp.where(valid, eids, e), e + 1, dtype=jnp.int32),
+        axis=0)[:e]                                    # live rows per expert
+    gs_pad = ((sizes + m_blk - 1) // m_blk) * m_blk
+    offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(sizes)[:-1]])
+    offs_pad = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(gs_pad)[:-1]])
+    order = jnp.argsort(jnp.where(valid, eids, e), stable=True)
+    e_sorted = eids[order]
+    valid_sorted = valid[order]
+    rank_in_group = jnp.arange(m, dtype=jnp.int32) - offs[e_sorted]
+    dest_sorted = jnp.where(valid_sorted,
+                            offs_pad[e_sorted] + rank_in_group,
+                            m_pad - 1)                 # trash slot
+    padded = jnp.zeros((m_pad, k), tokens.dtype).at[dest_sorted].set(
+        tokens[order])
+    dest = jnp.zeros((m,), jnp.int32).at[order].set(dest_sorted)
+    tile_starts = jnp.arange(m_pad // m_blk, dtype=jnp.int32) * m_blk
+    tile_experts = jnp.clip(
+        jnp.searchsorted(jnp.cumsum(gs_pad), tile_starts, side="right"),
+        0, e - 1).astype(jnp.int32)
+    return padded, tile_experts, dest
+
+
+def _ag_group_gemm_kernel(x_hbm, te_ref, w_hbm, ag_hbm, c_hbm, a_tile,
+                          b_panel, c_stage, copy_sem, a_sem, b_sem, c_sem,
+                          send_sem, recv_sem, *, axis: str, world: int,
+                          m_pad: int, k: int, n_loc: int, m_blk: int,
+                          n_blk: int, acc_dtype):
+    """Fused ring-AG + grouped GEMM over the tile-aligned schedule.
+
+    One Pallas kernel per device (VERDICT r2 next 7: the answer to the
+    reference's fused producer/consumer, allgather_group_gemm.py:608):
+    the ring AG of aligned token chunks runs during the first N-block
+    (chunk-boundary ``wait_recv`` ≙ the reference's per-rank signal
+    wait); every (m_blk, K) A tile belongs to a single expert, whose
+    (K, n_blk) B panel stays resident until the expert RUN ends — the
+    sorted schedule makes panel reloads O(#experts), not O(#tiles).
+    """
+    me = lax.axis_index(axis)
+    right = lax.rem(me + 1, world)
+    m_tiles = m_pad // m_blk
+    n_blocks = n_loc // n_blk
+    per_nb = world * m_tiles
+    total = n_blocks * per_nb
+
+    cp = pltpu.make_async_copy(
+        x_hbm, ag_hbm.at[pl.ds(me * m_pad, m_pad), :], copy_sem)
+    cp.start()
+    cp.wait()
+    if world > 1:
+        dl.barrier_all(axis)
+
+    def chunk_idx(i):
+        return lax.rem(me - lax.rem(i, per_nb) // m_tiles + world, world)
+
+    def tile_of(i):
+        return chunk_idx(i) * m_tiles + lax.rem(i, m_tiles)
+
+    def row_of(i):
+        return chunk_idx(i) * m_pad + lax.rem(i, m_tiles) * m_blk
+
+    def chunk_copy(idx):
+        return dl.remote_copy(
+            ag_hbm.at[pl.ds(idx * m_pad, m_pad), :],
+            ag_hbm.at[pl.ds(idx * m_pad, m_pad), :],
+            right, send_sem.at[idx], recv_sem.at[idx], axis=axis)
+
+    def a_dma(slot, i):
+        return pltpu.make_async_copy(
+            ag_hbm.at[pl.ds(row_of(i), m_blk), :], a_tile.at[slot],
+            a_sem.at[slot])
+
+    def b_dma(slot, i):
+        e = te_ref[tile_of(i)]
+        return pltpu.make_async_copy(
+            w_hbm.at[e, :, pl.ds((i // per_nb) * n_blk, n_blk)],
+            b_panel.at[slot], b_sem.at[slot])
+
+    def need_b(i):
+        # Panel reloads happen at N-block starts and expert-run
+        # boundaries only (the point of the aligned schedule).
+        prev = jnp.maximum(i - 1, 0)
+        return (lax.rem(i, per_nb) == 0) | (
+            te_ref[tile_of(i)] != te_ref[tile_of(prev)])
+
+    def c_dma(slot, i):
+        return pltpu.make_async_copy(
+            c_stage.at[slot],
+            c_hbm.at[pl.ds(row_of(i), m_blk),
+                     pl.ds((i // per_nb) * n_blk, n_blk)],
+            c_sem.at[slot])
+
+    def ring_advance(i):
+        if world == 1:
+            return
+
+        @pl.when((i < per_nb) & (lax.rem(i, m_tiles) == 0))
+        def _():
+            s = i // m_tiles
+
+            @pl.when(s > 0)
+            def _():
+                chunk_copy(chunk_idx(i)).wait_recv()
+
+            @pl.when(s < world - 1)
+            def _():
+                chunk_copy(chunk_idx(i)).start()
+
+    ring_advance(0)
+    a_dma(0, 0).start()
+    b_dma(0, 0).start()
+
+    def step(i, cur):
+        """``cur`` carries the slot holding tile i-1's panel; reloads
+        alternate slots, and the NEXT reload is prefetched one tile
+        ahead (the expert schedule is known in te_ref), so panel
+        fetches ride under the current run's dots instead of stalling
+        the MXU (code-review r3b finding 4)."""
+        slot = lax.rem(i, 2)
+        ring_advance(i + 1)
+
+        @pl.when(i + 1 < total)
+        def _():
+            a_dma(lax.rem(i + 1, 2), i + 1).start()
+
+        nb_i = need_b(i)
+
+        @pl.when(nb_i)
+        def _():
+            b_dma(1 - cur, i).wait()
+        cur = jnp.where(nb_i, 1 - cur, cur)
+
+        @pl.when((i + 1 < total) & need_b(i + 1))
+        def _():
+            b_dma(1 - cur, i + 1).start()   # prefetch next panel
+
+        a_dma(slot, i).wait()
+        out = jnp.dot(a_tile[slot], b_panel[cur],
+                      preferred_element_type=acc_dtype)
+
+        @pl.when(i >= 2)
+        def _():
+            c_dma(slot, i - 2).wait()
+        c_stage[slot] = out.astype(c_stage.dtype)
+        c_dma(slot, i).start()
+        return cur
+
+    lax.fori_loop(0, total, step, jnp.int32(1))
+    for i_last in range(max(0, total - 2), total):
+        c_dma(i_last % 2, i_last).wait()
+
+    if world > 1:
+        def drain(s, _):
+            chunk_copy(lax.rem(me - s + world, world)).wait_send()
+            return _
+        lax.fori_loop(0, world - 1, drain, None)
+
+
 @dataclasses.dataclass
 class AGGroupGEMMContext:
     """Analog of ``create_ag_group_gemm_context``
@@ -81,6 +271,11 @@ class AGGroupGEMMContext:
     mesh: Mesh
     axis: str = "tp"
     ring: bool = True   # ring-overlap schedule vs one-shot AG
+    interpret: bool | None = None
+    # Tile sizes for the fused Pallas kernel (impl="fused").
+    block_m: int = 128
+    block_n: int = 512
+    vmem_budget: int = 12 * 1024 * 1024
 
     @property
     def world_size(self) -> int:
@@ -112,12 +307,19 @@ def ag_group_gemm(x: jax.Array, w: jax.Array, expert_ids: jax.Array,
     ``impl="ring"``: w-1 ``ppermute`` hops; chunk s's ragged dot runs
     while chunk s+1 is in flight (collective matmul — the overlap the
     reference gets from its producer/consumer split).
+    ``impl="fused"``: ONE Pallas kernel — in-kernel ring AG of
+    tile-aligned expert-sorted chunks feeding tiled MXU dots
+    (:func:`_ag_group_gemm_kernel`; the reference's fused design,
+    allgather_group_gemm.py:608).
     ``impl="xla"``: one-shot all-gather golden.
     """
     ctx = ctx or create_ag_group_gemm_context()
     mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
     m, k = x.shape
     assert w.ndim == 3 and w.shape[1] == k
+
+    if impl == "fused":
+        return _ag_group_gemm_fused(x, w, expert_ids, num_experts, ctx)
 
     def oneshot(xs, ids, ws):
         ag = lax.all_gather(xs, axis, tiled=True)
@@ -154,3 +356,69 @@ def ag_group_gemm(x: jax.Array, w: jax.Array, expert_ids: jax.Array,
                       in_specs=(P(axis), P(axis), P(None, None, axis)),
                       out_specs=P(None, axis), check_vma=False)
     return f(x, expert_ids, w)
+
+
+def _ag_group_gemm_fused(x, w, expert_ids, num_experts, ctx):
+    """Entry for the fused Pallas AG + grouped-GEMM kernel."""
+    mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    m, k = x.shape
+    e, _, n = w.shape
+    n_loc = n // world
+    m_loc = m // world
+    interpret = resolve_interpret(ctx.interpret)
+
+    # m_blk need not divide m_loc — the alignment pass pads per group.
+    m_blk = ctx.block_m
+    m_pad = round_up(m_loc + num_experts * (m_blk - 1), m_blk) + m_blk
+    n_blk = ctx.block_n
+    while n_blk > n_loc or n_loc % n_blk:
+        n_blk //= 2
+    n_blk = max(n_blk, 1)
+    # 2 B panels (double-buffered prefetch) + A tiles + C stages must
+    # fit the budget.
+    item = x.dtype.itemsize
+    while n_blk > 128 and (2 * k * n_blk + 2 * m_blk * k
+                           + 2 * m_blk * n_blk) * item > ctx.vmem_budget:
+        n_blk //= 2
+
+    kernel = functools.partial(
+        _ag_group_gemm_kernel, axis=axis, world=world, m_pad=m_pad, k=k,
+        n_loc=n_loc, m_blk=m_blk, n_blk=n_blk, acc_dtype=jnp.float32)
+
+    def body(xs, ids_s, ws):
+        padded, tile_e, dest = align_tokens_for_tiles(
+            xs, ids_s, num_experts, m_blk)
+        tile_e_all = lax.all_gather(tile_e, axis, tiled=True)
+        dest_all = lax.all_gather(dest, axis, tiled=True)
+        _, cpad = pl.pallas_call(
+            kernel,
+            out_shape=(jax.ShapeDtypeStruct((world * m_pad, k), x.dtype),
+                       jax.ShapeDtypeStruct((world * m_pad, n_loc),
+                                            x.dtype)),
+            in_specs=[any_spec(),
+                      pl.BlockSpec(memory_space=pltpu.SMEM),
+                      any_spec()],
+            out_specs=(any_spec(), any_spec()),
+            scratch_shapes=[
+                pltpu.VMEM((2, m_blk, k), x.dtype),
+                pltpu.VMEM((2, k, n_blk), x.dtype),
+                pltpu.VMEM((2, m_blk, n_blk), x.dtype),
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((world,)),
+                pltpu.SemaphoreType.DMA((world,)),
+            ],
+            compiler_params=comm_params(collective_id=8, world=world),
+            interpret=interpret,
+        )(padded, tile_e_all, ws)
+        # Unsort: global row j lives at chunk(j)*m_pad + dest_all[j].
+        rows = (jnp.arange(world * m_loc) // m_loc) * m_pad + dest_all
+        return cpad[rows]
+
+    f = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(None, None, axis)),
+        out_specs=P(None, axis), check_vma=False)
+    return sync_interpret(f(x, expert_ids, w), interpret)

@@ -21,14 +21,179 @@ split, moe_reduce_rs.py:380-546, re-expressed as a collective matmul).
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
-from triton_dist_tpu.ops.group_gemm import grouped_matmul
+import triton_dist_tpu.language as dl
+from triton_dist_tpu.ops.common import (
+    any_spec, comm_params, resolve_interpret, sync_interpret)
+from triton_dist_tpu.ops.group_gemm import (
+    align_tokens_for_tiles, grouped_matmul)
 from triton_dist_tpu.ops.moe_utils import topk_reduce
+
+
+def _moe_rs_fused_kernel(act_hbm, w_hbm, sel_hbm, te_ref, o_hbm, send_hbm,
+                         recv_hbm, a_tile, b_panel, sel_tile, acc, r_tile,
+                         c_stage, a_sem, b_sem, s_sem, r_sem, c_sem,
+                         send_sem, recv_sem, *, axis: str, world: int,
+                         rows: int, m_pad: int, i_loc: int, h: int,
+                         m_blk: int, h_blk: int):
+    """Single-kernel MoE second-projection + topk-reduce + ring RS.
+
+    The TPU answer to the reference's fused producer/reducer
+    (moe_reduce_rs.py:167-546, VERDICT r2 next 7 second half): per ring
+    step the kernel computes one token-chunk's rank-partial — streaming
+    expert-aligned (m_blk, I_loc) pair tiles through VMEM, one full-K
+    dot per tile with the expert's resident (I_loc, h_blk) down-proj
+    panel — and folds the topk scatter-reduce into a second small MXU
+    dot against a precomputed (rows, m_blk) routing-weight selection
+    tile (≈ rows/I_loc extra FLOPs, no in-kernel scatter). The reduced
+    chunk rides the ring under the next chunk's compute, exactly the
+    GEMM-RS schedule.
+    """
+    me = lax.axis_index(axis)
+    right = lax.rem(me + 1, world)
+    m_tiles = m_pad // m_blk
+    n_blocks = h // h_blk
+    per = n_blocks * m_tiles
+
+    def rs_copy(s):
+        return dl.remote_copy(send_hbm.at[s], recv_hbm.at[s], right,
+                              send_sem.at[s], recv_sem.at[s], axis=axis)
+
+    def chunk_gemm(chunk, s, dst):
+        def tile_of(i):
+            return chunk * m_tiles + lax.rem(i, m_tiles)
+
+        def a_dma(slot, i):
+            row0 = chunk * m_pad + lax.rem(i, m_tiles) * m_blk
+            return pltpu.make_async_copy(
+                act_hbm.at[pl.ds(row0, m_blk), :], a_tile.at[slot],
+                a_sem.at[slot])
+
+        def sel_dma(slot, i):
+            return pltpu.make_async_copy(
+                sel_hbm.at[tile_of(i)], sel_tile.at[slot], s_sem.at[slot])
+
+        def b_dma(slot, i):
+            e = te_ref[tile_of(i)]
+            return pltpu.make_async_copy(
+                w_hbm.at[e, :, pl.ds((i // m_tiles) * h_blk, h_blk)],
+                b_panel.at[slot], b_sem.at[slot])
+
+        def need_b(i):
+            prev = jnp.maximum(i - 1, 0)
+            return (lax.rem(i, m_tiles) == 0) | (
+                te_ref[tile_of(i)] != te_ref[tile_of(prev)])
+
+        def r_dma(nb):
+            return pltpu.make_async_copy(
+                recv_hbm.at[jnp.maximum(s - 1, 0), :,
+                            pl.ds(nb * h_blk, h_blk)],
+                r_tile, r_sem)
+
+        def c_dma(nb):
+            return pltpu.make_async_copy(
+                c_stage, dst.at[:, pl.ds(nb * h_blk, h_blk)], c_sem)
+
+        a_dma(0, 0).start()
+        sel_dma(0, 0).start()
+        b_dma(0, 0).start()
+
+        def istep(i, cur):
+            # ``cur`` = slot of the current B panel; the next reload is
+            # prefetched one tile ahead so panel fetches overlap dots
+            # (code-review r3b finding 4).
+            slot = lax.rem(i, 2)
+            nb = i // m_tiles
+
+            @pl.when(i + 1 < per)
+            def _():
+                a_dma(lax.rem(i + 1, 2), i + 1).start()
+                sel_dma(lax.rem(i + 1, 2), i + 1).start()
+
+            @pl.when((lax.rem(i, m_tiles) == 0) & (s > 0))
+            def _():
+                r_dma(nb).start()   # travelling partial for this h-block
+
+            nb_i = need_b(i)
+
+            @pl.when(nb_i)
+            def _():
+                b_dma(1 - cur, i).wait()
+            cur = jnp.where(nb_i, 1 - cur, cur)
+
+            @pl.when((i + 1 < per) & need_b(i + 1))
+            def _():
+                b_dma(1 - cur, i + 1).start()   # prefetch next panel
+
+            a_dma(slot, i).wait()
+            sel_dma(slot, i).wait()
+            pair_out = jnp.dot(a_tile[slot], b_panel[cur],
+                               preferred_element_type=jnp.float32)
+            contrib = jnp.dot(sel_tile[slot], pair_out,
+                              preferred_element_type=jnp.float32)
+
+            @pl.when(lax.rem(i, m_tiles) == 0)
+            def _():
+                acc[:] = contrib
+
+            @pl.when(lax.rem(i, m_tiles) > 0)
+            def _():
+                acc[:] = acc[:] + contrib
+
+            @pl.when(lax.rem(i, m_tiles) == m_tiles - 1)
+            def _():
+                @pl.when(nb > 0)
+                def _():
+                    c_dma(nb - 1).wait()
+
+                @pl.when(s > 0)
+                def _():
+                    r_dma(nb).wait()
+                    c_stage[:] = (acc[:] + r_tile[:].astype(jnp.float32)
+                                  ).astype(c_stage.dtype)
+
+                @pl.when(s == 0)
+                def _():
+                    c_stage[:] = acc[:].astype(c_stage.dtype)
+                c_dma(nb).start()
+            return cur
+
+        lax.fori_loop(0, per, istep, jnp.int32(1))
+        c_dma(n_blocks - 1).wait()
+
+    if world == 1:
+        chunk_gemm(jnp.int32(0), jnp.int32(0), o_hbm)
+        return
+
+    dl.barrier_all(axis)
+
+    def rs_step(s, _):
+        send_idx = lax.rem(me - s - 1 + world, world)
+
+        @pl.when(s > 0)
+        def _():
+            rs_copy(jnp.maximum(s - 1, 0)).wait_recv()
+        chunk_gemm(send_idx, s, send_hbm.at[s])
+        rs_copy(s).start()
+        return _
+
+    lax.fori_loop(0, world - 1, rs_step, None)
+    rs_copy(world - 2).wait_recv()
+    chunk_gemm(me, jnp.int32(world - 1), o_hbm)
+
+    def drain(s, _):
+        rs_copy(s).wait_send()
+        return _
+
+    lax.fori_loop(0, world - 1, drain, None)
 
 
 @dataclasses.dataclass
@@ -39,6 +204,11 @@ class MoEReduceRSContext:
     axis: str = "tp"
     num_experts: int = 8
     topk: int = 2
+    interpret: bool | None = None
+    # Tile sizes for the fused Pallas kernel (impl="fused").
+    block_m: int = 128
+    block_h: int = 512
+    vmem_budget: int = 12 * 1024 * 1024
 
     @property
     def world_size(self) -> int:
@@ -114,9 +284,107 @@ def moe_reduce_rs(act: jax.Array, w_down: jax.Array, expert_ids: jax.Array,
                             jnp.zeros((rows, h), jnp.float32))
         return acc.astype(act.dtype)
 
+    if impl == "fused":
+        return _moe_rs_fused(act, w_down, expert_ids, weights, ctx)
+
     body = oneshot if (impl == "xla" or world == 1) else ring
     f = jax.shard_map(
         body, mesh=mesh,
         in_specs=(P(None, axis), P(None, axis, None), P(), P()),
         out_specs=P(axis), check_vma=False)
     return f(act, w_down, expert_ids, weights)
+
+
+def _moe_rs_fused(act, w_down, expert_ids, weights, ctx):
+    """Entry for :func:`_moe_rs_fused_kernel`: builds the expert-aligned
+    pair layout and the per-tile routing-weight selection tensors
+    (traced; the analog of the reference's gather_a_ptrs + topk-reduce
+    planning, moe_reduce_rs.py:167-380), then runs the single kernel."""
+    mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    t, topk = weights.shape
+    rows = t // world
+    n_exp = ctx.num_experts
+    m_blk = ctx.block_m
+    pairs = rows * topk
+    from triton_dist_tpu.ops.common import round_up
+    m_pad = round_up(pairs + n_exp * (m_blk - 1), m_blk) + m_blk
+    m_tiles = m_pad // m_blk
+    interpret = resolve_interpret(ctx.interpret)
+
+    def body(a_shard, wd, ids, wts):
+        i_loc = a_shard.shape[1]
+        h = wd.shape[-1]
+        h_blk = ctx.block_h
+        while h_blk > h or h % h_blk:
+            h_blk //= 2
+        h_blk = max(h_blk, 1)
+        item = a_shard.dtype.itemsize
+        while h_blk > 128 and (
+                (2 * m_blk * i_loc + 2 * i_loc * h_blk) * item
+                + 4 * (2 * rows * m_blk + rows * h_blk)
+                + 2 * rows * h_blk * item) > ctx.vmem_budget:
+            h_blk //= 2
+
+        # Per token-chunk alignment (identical on every device: ids and
+        # weights are replicated; only the I-slice of act differs).
+        a_chunks = a_shard.reshape(world, pairs, i_loc)
+        id_chunks = ids.reshape(world, pairs)
+        padded, tile_e, dest = jax.vmap(
+            lambda av, iv: align_tokens_for_tiles(av, iv, n_exp, m_blk)
+        )(a_chunks, id_chunks)
+        padded_all = padded.reshape(world * m_pad, i_loc)
+        te_all = tile_e.reshape(world * m_tiles)
+
+        # Selection tensors: sel[tile, tok, col] = routing weight of the
+        # pair that landed at aligned position (tile, col), for its
+        # token row within the chunk; 0 elsewhere.
+        p_idx = jnp.arange(pairs)
+        chunk_idx = jnp.arange(world)[:, None]
+        tile_idx = chunk_idx * m_tiles + dest // m_blk       # (w, pairs)
+        col_idx = dest % m_blk
+        tok_idx = jnp.broadcast_to(p_idx // topk, (world, pairs))
+        w_vals = wts.reshape(world, rows, topk).reshape(world, pairs)
+        sel = jnp.zeros((world * m_tiles, rows, m_blk), jnp.float32)
+        sel = sel.at[tile_idx.ravel(), tok_idx.ravel(),
+                     col_idx.ravel()].add(w_vals.ravel())
+
+        kernel = functools.partial(
+            _moe_rs_fused_kernel, axis=axis, world=world, rows=rows,
+            m_pad=m_pad, i_loc=i_loc, h=h, m_blk=m_blk, h_blk=h_blk)
+
+        out, *_ = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((rows, h), act.dtype),
+                jax.ShapeDtypeStruct((max(world - 1, 1), rows, h),
+                                     act.dtype),
+                jax.ShapeDtypeStruct((max(world - 1, 1), rows, h),
+                                     act.dtype)),
+            in_specs=[any_spec(), any_spec(), any_spec(),
+                      pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_specs=(any_spec(),) * 3,
+            scratch_shapes=[
+                pltpu.VMEM((2, m_blk, i_loc), act.dtype),
+                pltpu.VMEM((2, i_loc, h_blk), act.dtype),
+                pltpu.VMEM((2, rows, m_blk), jnp.float32),
+                pltpu.VMEM((rows, h_blk), jnp.float32),
+                pltpu.VMEM((rows, h_blk), act.dtype),
+                pltpu.VMEM((rows, h_blk), act.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+                pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+            ],
+            compiler_params=comm_params(collective_id=9, world=world),
+            interpret=interpret,
+        )(padded_all, wd, sel, te_all)
+        return out
+
+    f = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis, None), P(), P()),
+        out_specs=P(axis), check_vma=False)
+    return sync_interpret(f(act, w_down, expert_ids, weights), interpret)
