@@ -65,7 +65,8 @@ def test_reduce_scatter(mesh8, key, method, dtype):
 
 
 @pytest.mark.parametrize("method", [AllReduceMethod.ONE_SHOT,
-                                    AllReduceMethod.TWO_SHOT])
+                                    AllReduceMethod.TWO_SHOT,
+                                    AllReduceMethod.RECURSIVE_DOUBLING])
 def test_all_reduce(mesh8, key, method):
     x = _mk(key, (WORLD, 32, 128), jnp.float32)
     ctx = create_allreduce_context(mesh8, method=method)
@@ -121,3 +122,14 @@ def test_broadcast(mesh8, key, root):
     np.testing.assert_allclose(np.asarray(got), expect)
     gold = broadcast(x, root=root, ctx=ctx, impl="xla")
     np.testing.assert_allclose(np.asarray(gold), expect)
+
+
+def test_all_reduce_recursive_doubling_odd_rows(mesh8, key):
+    """RECURSIVE_DOUBLING has no row-divisibility requirement (unlike
+    TWO_SHOT) — odd M exercises the full-buffer exchange."""
+    x = _mk(key, (WORLD, 24, 128), jnp.float32)
+    ctx = create_allreduce_context(
+        mesh8, method=AllReduceMethod.RECURSIVE_DOUBLING)
+    got = all_reduce(x, ctx, impl="pallas")
+    assert_allclose(got, np.asarray(x, np.float64).sum(axis=0),
+                    rtol=1e-4, atol=1e-4)

@@ -125,7 +125,8 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
 
     from triton_dist_tpu.ops.allreduce import (
         AllReduceMethod, create_allreduce_context, all_reduce)
-    for method in (AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT):
+    for method in (AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT,
+                   AllReduceMethod.RECURSIVE_DOUBLING):
         ctx = create_allreduce_context(mesh, "tp", interpret=interpret)
         ctx.method = method
         case(f"allreduce/{method.value}",

@@ -12,8 +12,9 @@ Method mapping (reference → TPU):
   latency-optimal.
 - two-shot push                  → ``TWO_SHOT``: ring reduce-scatter then
   ring all-gather inside one kernel; bandwidth-optimal.
-- double-tree                    → subsumed by the ring on a torus (trees
-  help on switch hierarchies, not ICI neighbor links); not implemented.
+- double-tree                    → ``RECURSIVE_DOUBLING``: log-depth
+  XOR-partner exchange (the same latency class; tree topologies
+  themselves don't map to ICI neighbor links).
 - one/two-shot multimem (NVLS)   → no ICI multicast exists; the XLA
   ``psum`` path is the hardware-tuned equivalent. Documented gap.
 
@@ -43,6 +44,10 @@ class AllReduceMethod(enum.Enum):
     AUTO = "auto"
     ONE_SHOT = "one_shot"
     TWO_SHOT = "two_shot"
+    # Log-depth exchange (the latency class of the reference's
+    # double-tree, allreduce.py:214-683 double-tree rows): requires a
+    # power-of-two world.
+    RECURSIVE_DOUBLING = "recursive_doubling"
 
 
 def get_auto_allreduce_method(world_size: int, nbytes: int,
@@ -144,6 +149,41 @@ def _one_shot_ar_kernel(x_ref, o_ref, stage_ref, send_sem, recv_sem, *,
     lax.fori_loop(1, world, wait_send, None)
 
 
+def _recursive_doubling_ar_kernel(x_ref, o_ref, send_stage, recv_stage,
+                                  send_sem, recv_sem, *, axis: str,
+                                  world: int, straggler_option=None):
+    """Log-depth allreduce: step j exchanges the running partial with
+    partner ``me XOR 2^j`` and adds — log2(w) hops of the full buffer.
+
+    The TPU answer to the reference's double-tree kernels (log-latency
+    class, allreduce.py:214-683): on a torus the XOR partner at step j is
+    2^j links away, so total traffic matches one-shot but the incast is
+    pairwise (2 flows/link) instead of (w-1)-way. The exchange is
+    symmetric: both partners use step-slot j, so one descriptor serves
+    start (my push), wait_recv (partner's delivery into my stage) and
+    wait_send (my push drained)."""
+    me = lax.axis_index(axis)
+    o_ref[:] = x_ref[:]
+    if world == 1:
+        return
+    n_steps = world.bit_length() - 1
+    _maybe_straggle(straggler_option, axis)
+    dl.barrier_all(axis)
+
+    cps = []
+    for j in range(n_steps):                 # static log2(w) unroll
+        partner = jnp.bitwise_xor(me, 1 << j)
+        send_stage[j] = o_ref[:]
+        cp = dl.remote_copy(send_stage.at[j], recv_stage.at[j], partner,
+                            send_sem.at[j], recv_sem.at[j], axis=axis)
+        cp.start()
+        cp.wait_recv()                       # partner's partial landed
+        o_ref[:] = o_ref[:] + recv_stage[j]
+        cps.append(cp)
+    for cp in cps:
+        cp.wait_send()
+
+
 def _two_shot_ar_kernel(x_ref, o_ref, send_buf, recv_buf, send_sem, recv_sem,
                         ag_send_sem, ag_recv_sem, *, axis: str, world: int,
                         rows: int, straggler_option=None):
@@ -225,6 +265,9 @@ def all_reduce(x: jax.Array, ctx: AllReduceContext | None = None,
         method = get_auto_allreduce_method(world, m * n * x.dtype.itemsize)
     if method is AllReduceMethod.TWO_SHOT and m % world != 0:
         method = AllReduceMethod.ONE_SHOT
+    if (method is AllReduceMethod.RECURSIVE_DOUBLING
+            and world & (world - 1)):
+        method = AllReduceMethod.ONE_SHOT    # needs power-of-two world
 
     out_spec = P(axis) if stacked else P()
 
@@ -245,6 +288,15 @@ def all_reduce(x: jax.Array, ctx: AllReduceContext | None = None,
         scratch = [pltpu.VMEM((world, m, n), x.dtype),
                    pltpu.SemaphoreType.DMA((world,)),
                    pltpu.SemaphoreType.DMA((world,))]
+    elif method is AllReduceMethod.RECURSIVE_DOUBLING:
+        n_steps = max(world.bit_length() - 1, 1)
+        kernel = functools.partial(
+            _recursive_doubling_ar_kernel, axis=axis, world=world,
+            straggler_option=ctx.straggler_option)
+        scratch = [pltpu.VMEM((n_steps, m, n), x.dtype),
+                   pltpu.VMEM((n_steps, m, n), x.dtype),
+                   pltpu.SemaphoreType.DMA((n_steps,)),
+                   pltpu.SemaphoreType.DMA((n_steps,))]
     else:
         rows = m // world
         kernel = functools.partial(_two_shot_ar_kernel, axis=axis,
