@@ -1,0 +1,107 @@
+"""Tutorial 06: GEMM-ReduceScatter overlap + two-level (inter-node)
+collectives.
+
+Analog of the reference's tutorials/05-06 (intra/inter-node
+reduce-scatter) and 08 (overlapping GEMM-ReduceScatter): run the
+standalone ring reduce-scatter, the fused GEMM-RS collective matmul and
+the decode-path GEMM-AR, verify each against its XLA golden, then show
+the two-level ICI+DCN hierarchical collectives on a 2-D mesh — the TPU
+shape of the reference's inter-node staging (reduce_scatter.py:857
+``reduce_scatter_2d_op``).
+
+Run:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/06_gemm_rs_hierarchical.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+import jax
+
+if not os.environ.get("TDT_EXAMPLES_ON_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.ops.reduce_scatter import (
+    create_reduce_scatter_context, reduce_scatter)
+from triton_dist_tpu.ops.gemm_reduce_scatter import (
+    create_gemm_rs_context, gemm_ar, gemm_rs)
+from triton_dist_tpu.ops.hierarchical import (
+    create_hier_context, all_reduce_2d, reduce_scatter_2d)
+from triton_dist_tpu.runtime.utils import assert_allclose
+
+
+def main():
+    devs = jax.devices()
+    world = len(devs)
+    mesh = Mesh(np.array(devs), ("tp",))
+
+    # 1. Standalone ring reduce-scatter: (w, M, N) partials → summed
+    #    row-chunks (reference tutorials/05).
+    x = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(0), (world, world * 8, 128),
+                          jnp.float32),
+        NamedSharding(mesh, P("tp")))
+    rs_ctx = create_reduce_scatter_context(mesh, "tp")
+    got = reduce_scatter(x, rs_ctx, impl="pallas")
+    assert_allclose(got, np.asarray(x, np.float64).sum(axis=0),
+                    rtol=1e-5, atol=1e-5)
+    print("ring reduce-scatter OK")
+
+    # 2. Fused GEMM-RS: the row-parallel linear's collective matmul
+    #    (reference tutorials/08) — the ring hop of chunk c rides under
+    #    chunk c+1's MXU work inside ONE kernel.
+    m, k, n = world * 8, world * 16, 128
+    a = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (m, k), jnp.float32) / 4,
+        NamedSharding(mesh, P(None, "tp")))
+    b = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(2), (k, n), jnp.float32) / 4,
+        NamedSharding(mesh, P("tp")))
+    ctx = create_gemm_rs_context(mesh, "tp")
+    fused = gemm_rs(a, b, ctx, impl="pallas")
+    gold = gemm_rs(a, b, ctx, impl="xla")
+    assert_allclose(fused, gold, rtol=1e-4, atol=1e-4)
+    print("fused GEMM-RS OK")
+
+    # 3. GEMM-AR: the decode path — small M, replicated output
+    #    (reference gemm_allreduce.py).
+    a_dec = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(3), (world * 2, k),
+                          jnp.float32) / 4,
+        NamedSharding(mesh, P(None, "tp")))
+    out = gemm_ar(a_dec, b, ctx, impl="pallas")
+    full = (np.asarray(a_dec, np.float64) @ np.asarray(b, np.float64))
+    assert_allclose(out, full, rtol=1e-3, atol=1e-3)
+    print("fused GEMM-AR OK")
+
+    # 4. Two-level collectives on a (node, chip) 2-D mesh: reduce inside
+    #    the fast inner axis first, then across the slow outer axis —
+    #    the reference's intra-node staging + inter-node exchange.
+    mesh2 = Mesh(np.array(devs).reshape(2, world // 2), ("dcn", "ici"))
+    hctx = create_hier_context(mesh2, inner="ici", outer="dcn")
+    xh = jax.random.normal(jax.random.PRNGKey(4), (16, 128), jnp.float32)
+    # Each device contributes the (replicated) partial; sum = world * x.
+    ar = all_reduce_2d(xh, hctx)
+    assert_allclose(ar, world * np.asarray(xh, np.float64),
+                    rtol=1e-4, atol=1e-4)
+    rs2 = reduce_scatter_2d(xh, hctx)
+    assert_allclose(
+        np.asarray(rs2),
+        world * np.asarray(xh, np.float64)[: rs2.shape[0]],
+        rtol=1e-4, atol=1e-4)
+    print("two-level ICI+DCN collectives OK")
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()
