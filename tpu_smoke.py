@@ -43,7 +43,7 @@ def _init_backend(retries: int = 3, backoff_s: float = 20.0):
 
 
 def run_smoke(log_path: str | None = None, only: str | None = None,
-              interpret: bool = False) -> int:
+              interpret: bool = False, list_only: bool = False) -> int:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -62,8 +62,16 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         return True
 
     def case(name, fn):
-        if only and only not in name:
+        if list_only:
+            print(name)
             return
+        if only:
+            # "=name" selects exactly; otherwise substring filter.
+            if only.startswith("="):
+                if name != only[1:]:
+                    return
+            elif only not in name:
+                return
         t0 = time.perf_counter()
         try:
             out = fn()
@@ -83,15 +91,23 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         print(f"  {results[-1][0]:<28} {results[-1][1]:<9} "
               f"{results[-1][2]}", flush=True)
 
-    try:
-        devices = _init_backend()
-    except Exception:  # noqa: BLE001
-        traceback.print_exc()
-        print("SMOKE: backend unavailable")
-        return 2
+    if list_only:
+        # Name-collection runs on CPU (works even while the TPU tunnel
+        # is wedged); the inter-case data setup executes there but every
+        # case() body returns before running its kernel.
+        jax.config.update("jax_platforms", "cpu")
+        devices = jax.devices()
+    else:
+        try:
+            devices = _init_backend()
+        except Exception:  # noqa: BLE001
+            traceback.print_exc()
+            print("SMOKE: backend unavailable")
+            return 2
     dev = devices[0]
-    print(f"SMOKE on {dev.platform}:{getattr(dev, 'device_kind', '?')}",
-          flush=True)
+    if not list_only:
+        print(f"SMOKE on {dev.platform}:{getattr(dev, 'device_kind', '?')}",
+              flush=True)
     mesh = Mesh(np.array(devices[:1]), ("tp",))
     key = jax.random.PRNGKey(0)
     bf16 = jnp.bfloat16
@@ -321,6 +337,8 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     case("mega_qwen3", mega_step)
 
     # --- report -----------------------------------------------------------
+    if list_only:
+        return 0
     n_fail = sum(1 for _, st, _ in results if st != "PASS")
     width = max(len(n) for n, _, _ in results) if results else 1
     lines = [f"{n:<{width}}  {st:<9} {d}" for n, st, d in results]
@@ -333,12 +351,59 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     return 1 if n_fail else 0
 
 
+def run_subproc(log_path: str, timeout_s: float) -> int:
+    """Run every case in its OWN subprocess with a hard deadline.
+
+    A Mosaic compile hang through the tunnel has been observed to wedge
+    the backend for hours (round 3); per-case isolation bounds the blast
+    radius: a hung case is killed and reported HANG instead of taking
+    the whole smoke (and possibly the tunnel session) with it."""
+    import subprocess
+    names = subprocess.run(
+        [sys.executable, __file__, "--list"], capture_output=True,
+        text=True, timeout=600).stdout.split()
+    n_fail = 0
+    lines = []
+    for name in names:
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run(
+                [sys.executable, __file__, "--only", f"={name}",
+                 "--log", log_path + ".case"],
+                capture_output=True, text=True, timeout=timeout_s)
+            ok = r.returncode == 0
+            tail = [ln for ln in r.stdout.splitlines() if name in ln]
+            detail = tail[-1].split(None, 1)[-1] if tail else f"rc={r.returncode}"
+            status = "PASS" if ok else "FAIL"
+        except subprocess.TimeoutExpired:
+            status, detail = "HANG", f"killed after {timeout_s:.0f}s"
+        dt = time.perf_counter() - t0
+        n_fail += status != "PASS"
+        line = f"{name:<28} {status:<9} {dt:.0f}s {detail}"
+        lines.append(line)
+        print(line, flush=True)
+    report = "\n".join(lines + [f"TOTAL {len(names)} ops, {n_fail} failing"])
+    with open(log_path, "a") as f:
+        f.write(report + "\n")
+    print(f"TOTAL {len(names)} ops, {n_fail} failing")
+    return 1 if n_fail else 0
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--log", default="tpu_smoke.log")
     ap.add_argument("--only", default=None,
-                    help="substring filter on case names")
+                    help="substring filter on case names (=name exact)")
+    ap.add_argument("--list", action="store_true",
+                    help="print case names (CPU; no kernels run)")
+    ap.add_argument("--subproc", action="store_true",
+                    help="one subprocess per case with a hard timeout")
+    ap.add_argument("--case-timeout", type=float, default=420.0)
     args = ap.parse_args()
+    if args.list:
+        sys.exit(run_smoke(None, None, list_only=True))
     with open(args.log, "w") as f:
         f.write(f"tpu_smoke @ {time.strftime('%Y-%m-%d %H:%M:%S')}\n")
+    if args.subproc:
+        sys.exit(run_subproc(args.log, args.case_timeout))
     sys.exit(run_smoke(args.log, args.only))
