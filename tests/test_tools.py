@@ -146,3 +146,30 @@ def test_reduce_scatter_auto_crossover():
     ctx = create_reduce_scatter_context(mesh, "tp")
     assert ctx.resolve_method(8 * 1024) is ReduceScatterMethod.ONE_SHOT
     assert ctx.resolve_method(64 * 1024 * 1024) is ReduceScatterMethod.RING
+
+
+def test_autotune_isolates_failing_config():
+    """A config that fails to compile/run scores inf instead of killing
+    the sweep (aggressive-tier configs rely on this)."""
+    from triton_dist_tpu.tools.autotuner import autotune, clear_cache
+    clear_cache()
+
+    def make_fn(ok):
+        if not ok:
+            def boom():
+                raise RuntimeError("synthetic compile failure")
+            return boom
+
+        def fine():
+            return jnp.ones((8,)).sum()
+        return fine
+
+    res = autotune(make_fn, [{"ok": False}, {"ok": True}],
+                   key="isolate-test", iters=2, warmup_iters=1)
+    assert res.config == {"ok": True}
+    assert res.all_ms[0] == float("inf")
+
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="every autotune config"):
+        autotune(make_fn, [{"ok": False}], key="isolate-test-2",
+                 iters=2, warmup_iters=1)

@@ -492,6 +492,21 @@ def ag_gemm_configs(m: int, rows: int, k: int, n_tot_loc: int,
             if fp <= vmem_budget:
                 cfgs.append({"variant": "hbm_kt", "block_m": bm,
                              "block_k": bk})
+    # Aggressive tier — listed LAST so the default path (first feasible)
+    # never picks them; the autotuner sweeps them under per-config
+    # failure isolation. Larger m-tiles halve A re-reads and can compile
+    # when live intermediates are small, even past the soft budget.
+    hard_cap = 15 * 1024 * 1024
+    for bn in (1024, 512):
+        if bn > n_tot_loc or n_tot_loc % bn:
+            continue
+        for bm in (512, 256):
+            if bm > rows or rows % bm:
+                continue
+            fp = _hbm_footprint(bm, bn, k, itemsize)
+            if vmem_budget < fp <= hard_cap:
+                cfgs.append({"variant": "hbm", "block_m": bm,
+                             "block_n": bn})
     return cfgs or [{"variant": "hbm_kt", "block_m": 128, "block_k": 256}]
 
 
@@ -582,9 +597,14 @@ def ag_gemm_multi(a: jax.Array, bs,
         m_blk = _pick_block_k(rows, ctx.block_m)
         n_blk = _pick_block_k(n_tot_loc, ctx.block_n)
         if _hbm_footprint(m_blk, n_blk, k, item) > ctx.vmem_budget:
+            # Re-filter by footprint: the table's aggressive tier
+            # (over-budget, autotune-only) must never become the
+            # default (code-review r3d finding 2).
             cand = [c for c in ag_gemm_configs(m, rows, k, n_tot_loc,
                                                item, ctx.vmem_budget)
-                    if c["variant"] == "hbm"]
+                    if c["variant"] == "hbm"
+                    and _hbm_footprint(c["block_m"], c["block_n"], k,
+                                       item) <= ctx.vmem_budget]
             if cand:
                 m_blk, n_blk = cand[0]["block_m"], cand[0]["block_n"]
             else:

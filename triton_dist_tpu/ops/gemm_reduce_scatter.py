@@ -87,6 +87,20 @@ def gemm_rs_configs(m: int, rows: int, k_loc: int, n: int, itemsize: int,
             if fp <= vmem_budget:
                 cfgs.append({"variant": "hbm_kt", "block_m": bm,
                              "block_k": bk})
+    # Aggressive tier — LAST so defaults never pick them; the autotuner
+    # sweeps them under per-config failure isolation (larger m-tiles
+    # halve A re-reads; may compile past the soft budget).
+    hard_cap = 15 * 1024 * 1024
+    for bn in (1024, 512):
+        if bn > n or n % bn:
+            continue
+        for bm in (512, 256):
+            if bm > rows or rows % bm:
+                continue
+            fp = _hbm_nb_footprint(bm, bn, k_loc, itemsize)
+            if vmem_budget < fp <= hard_cap:
+                cfgs.append({"variant": "hbm", "block_m": bm,
+                             "block_n": bn})
     return cfgs or [{"variant": "hbm_kt", "block_m": 128, "block_k": 256}]
 
 
@@ -591,9 +605,14 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
         m_blk = _pick_block(rows, ctx.block_m)
         n_blk = _pick_block(n, ctx.block_n)
         if _hbm_nb_footprint(m_blk, n_blk, k_loc, item) > ctx.vmem_budget:
+            # Re-filter by footprint: the table's aggressive tier
+            # (over-budget, autotune-only) must never become the
+            # default (code-review r3d finding 3).
             cand = [c for c in gemm_rs_configs(m, rows, k_loc, n, item,
                                                world, ctx.vmem_budget)
-                    if c["variant"] == "hbm"]
+                    if c["variant"] == "hbm"
+                    and _hbm_nb_footprint(c["block_m"], c["block_n"],
+                                          k_loc, item) <= ctx.vmem_budget]
             if cand:
                 m_blk, n_blk = cand[0]["block_m"], cand[0]["block_n"]
             else:

@@ -50,24 +50,49 @@ def autotune(make_fn: Callable[..., Callable], configs: Sequence[dict],
       key: cache key — one sweep per key per process (reference caches on
         the Autotuner instance).
     Returns the winning TuneResult (same on every process).
+
+    Failure isolation: a config that raises scores inf (skipped, like
+    the reference's OutOfResources handling). On multi-host sweeps the
+    per-config scores are agreed as the WORST rank's time, so a config
+    failing anywhere loses everywhere; note that a non-SPMD-deterministic
+    failure (raising on only some ranks mid-collective) can still desync
+    the sweep itself — only configs whose failures are deterministic
+    across ranks are fully safe to list.
     """
     if key is not None and key in _CACHE:
         return _CACHE[key]
 
     times = []
+    errors = []
     for cfg in configs:
-        fn = make_fn(**cfg)
-        _, ms = perf_func(fn, iters=iters, warmup_iters=warmup_iters,
-                          return_output=False)
+        # A config that fails to compile/run (e.g. VMEM overflow on this
+        # chip generation) scores inf instead of killing the sweep — the
+        # reference's Triton autotuner likewise skips OutOfResources
+        # configs. This keeps aggressive candidates safe to list.
+        try:
+            fn = make_fn(**cfg)
+            _, ms = perf_func(fn, iters=iters, warmup_iters=warmup_iters,
+                              return_output=False)
+        except Exception as e:  # noqa: BLE001 — per-config isolation
+            ms = float("inf")
+            errors.append((cfg, repr(e)[:200]))
         times.append(ms)
 
-    best = int(np.argmin(times))
     if jax.process_count() > 1:
-        # Rank-0's choice wins everywhere (reference: synchronized sweep +
-        # identical pick; we make the agreement explicit).
+        # Agree on scores BEFORE picking: a config that failed on ANY
+        # rank must lose everywhere (worst-rank time), and the cached
+        # avg_ms must be the agreed number, not this rank's local inf
+        # (code-review r3d findings 1/4). Residual hazard documented
+        # above: a config failing on only SOME ranks may already have
+        # desynced the sweep itself — per-config isolation is fully safe
+        # only where failures are SPMD-deterministic.
         from jax.experimental import multihost_utils
-        best = int(multihost_utils.broadcast_one_to_all(
-            np.int32(best)))
+        allt = np.asarray(multihost_utils.process_allgather(
+            np.asarray(times, np.float64)))
+        times = list(allt.reshape(jax.process_count(), -1).max(axis=0))
+    if not np.isfinite(times).any():
+        raise RuntimeError(f"every autotune config failed: {errors}")
+    best = int(np.argmin(times))
     result = TuneResult(config=dict(configs[best]), avg_ms=times[best],
                         all_ms=tuple(times))
     if key is not None:
