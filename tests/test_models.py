@@ -225,3 +225,41 @@ def test_moe_ep_engine_serve(mesh8, key):
                     decode_mode="xla_ar")
     out_tp = eng_tp.serve(tp.init(key), ids, gen_len=2)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(out_tp))
+
+
+def test_kv_cache_manager_contract(mesh8):
+    """Offset bookkeeping + allocation shape/sharding contract
+    (reference KV_Cache kv_cache.py: inc_offset, overflow guard)."""
+    from triton_dist_tpu.models.kv_cache import KVCacheManager
+    kv = KVCacheManager(2, 2, 8, 8, 4, mesh=mesh8, axis="tp",
+                        dtype=jnp.float32)
+    caches = kv.init()
+    assert len(caches) == 2
+    k0, v0 = caches[0]
+    assert k0.shape == (2, 8, 8, 4) and v0.shape == (2, 8, 8, 4)
+    assert kv.inc_offset(5) == 5
+    assert kv.inc_offset(3) == 8      # exactly full is legal
+    with pytest.raises(AssertionError):
+        kv.inc_offset(1)              # overflow must be caught
+    kv.reset()
+    assert kv.offset == 0
+
+
+def test_kv_cache_incremental_decode_matches_full(dense, key):
+    """Token-by-token decode through the cache must equal one full
+    forward over the same ids (cache write/read positions exact)."""
+    b, s, t = 2, 6, 16
+    params = dense.init(key)
+    ids = jax.random.randint(jax.random.PRNGKey(3), (b, s), 0,
+                             dense.config.vocab_size, jnp.int32)
+    full, _ = dense.forward(params, ids, _caches(dense, b, t), 0,
+                            mode="xla_ar")
+    caches = _caches(dense, b, t)
+    logits_steps = []
+    for i in range(s):
+        lg, caches = dense.forward(params, ids[:, i:i + 1], caches,
+                                   jnp.int32(i), mode="xla_ar")
+        logits_steps.append(lg)
+    step_logits = jnp.concatenate(logits_steps, axis=1)
+    np.testing.assert_allclose(np.asarray(step_logits),
+                               np.asarray(full), rtol=2e-4, atol=2e-4)
