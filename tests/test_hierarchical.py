@@ -8,7 +8,8 @@ import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.ops.hierarchical import (
-    all_gather_2d, all_reduce_2d, create_hier_context, reduce_scatter_2d)
+    all_gather_2d, all_gather_nd, all_reduce_2d, all_reduce_nd,
+    create_hier_context, reduce_scatter_2d, reduce_scatter_nd)
 
 
 @pytest.fixture()
@@ -39,6 +40,51 @@ def test_all_reduce_2d(mesh2d, key):
     out = all_reduce_2d(x, ctx)
     np.testing.assert_allclose(np.asarray(out), 8 * np.asarray(x),
                                rtol=1e-5)
+
+
+@pytest.fixture()
+def mesh3d(devices):
+    # 3-level ladder: two ICI dimensions + DCN (reference 3d multinode
+    # variants, low_latency_allgather.py:617-780)
+    return Mesh(np.array(devices).reshape(2, 2, 2), ("dcn", "iciy", "icix"))
+
+
+AXES3 = ("icix", "iciy", "dcn")  # fastest → slowest
+
+
+def test_all_gather_3d(mesh3d, key):
+    x = jax.random.normal(key, (16, 32), jnp.float32)
+    xs = jax.device_put(
+        x, NamedSharding(mesh3d, P(("dcn", "iciy", "icix"))))
+    out = all_gather_nd(xs, mesh3d, AXES3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_reduce_scatter_3d(mesh3d, key):
+    x = jax.random.normal(key, (16, 8), jnp.float32)
+    out = reduce_scatter_nd(x, mesh3d, AXES3)
+    np.testing.assert_allclose(np.asarray(out), 8 * np.asarray(x),
+                               rtol=1e-5)
+
+
+def test_all_reduce_3d_matches_flat(mesh3d, key):
+    x = jax.random.normal(key, (8, 8), jnp.float32)
+
+    def flat(xs):
+        return jax.lax.psum(xs, ("dcn", "iciy", "icix"))
+    ref = jax.shard_map(flat, mesh=mesh3d, in_specs=P(), out_specs=P(),
+                        check_vma=False)(x)
+    out = all_reduce_nd(x, mesh3d, AXES3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_nd_matches_2d(mesh2d, key):
+    """The n-level schedule at n=2 must reproduce the 2-level op."""
+    ctx = create_hier_context(mesh2d)
+    x = jax.random.normal(key, (16, 8), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(all_reduce_nd(x, mesh2d, ("ici", "dcn"))),
+        np.asarray(all_reduce_2d(x, ctx)), rtol=1e-5)
 
 
 def test_all_reduce_2d_matches_flat(mesh2d, key):

@@ -59,21 +59,11 @@ def create_hier_context(mesh: Mesh | None = None, inner: str = "ici",
     return HierCollectiveContext(mesh=mesh, inner=inner, outer=outer)
 
 
-def _spec2(ctx):
-    # data sharded jointly over (outer, inner) on dim 0
-    return P((ctx.outer, ctx.inner))
-
-
 def all_gather_2d(x: jax.Array, ctx: HierCollectiveContext) -> jax.Array:
     """Gather dim-0 shards across both axes: ICI stage then DCN stage
     (reference 2D AG: intra-node ring + inter-node ring,
     low_latency_allgather.py 2d variants)."""
-    def body(xs):
-        g_in = lax.all_gather(xs, ctx.inner, tiled=True)
-        return lax.all_gather(g_in, ctx.outer, tiled=True)
-    f = jax.shard_map(body, mesh=ctx.mesh, in_specs=_spec2(ctx),
-                      out_specs=P(), check_vma=False)
-    return f(x)
+    return all_gather_nd(x, ctx.mesh, (ctx.inner, ctx.outer))
 
 
 def reduce_scatter_2d(x: jax.Array, ctx: HierCollectiveContext) -> jax.Array:
@@ -87,25 +77,69 @@ def reduce_scatter_2d(x: jax.Array, ctx: HierCollectiveContext) -> jax.Array:
     the AG layout, exactly like the reference's 2D RS whose per-node
     staging leaves node-interleaved segments.
     """
-    def body(xs):
-        part = lax.psum_scatter(xs, ctx.inner, scatter_dimension=0,
-                                tiled=True)
-        return lax.psum_scatter(part, ctx.outer, scatter_dimension=0,
-                                tiled=True)
-    f = jax.shard_map(body, mesh=ctx.mesh, in_specs=P(),
-                      out_specs=P((ctx.inner, ctx.outer)),
-                      check_vma=False)
-    return f(x)
+    return reduce_scatter_nd(x, ctx.mesh, (ctx.inner, ctx.outer))
 
 
 def all_reduce_2d(x: jax.Array, ctx: HierCollectiveContext) -> jax.Array:
     """AllReduce via RS(ici) → AR(dcn) → AG(ici): minimum DCN traffic
     (the reference's double-tree/2D AR role, allreduce.py:1101)."""
+    return all_reduce_nd(x, ctx.mesh, (ctx.inner, ctx.outer))
+
+
+# --- n-level generalization (reference 2d/3d multinode variants,
+# low_latency_allgather.py:48-780: intra-numa / inter-numa / inter-node).
+# A TPU pod exposes the same laddered topology — e.g. a 3D mesh with two
+# ICI dimensions plus DCN — so the schedule generalizes: run each stage on
+# the fastest remaining transport while the payload (AG) is still small,
+# or so the payload is maximally reduced (RS) before touching slower
+# links. ``axes`` is ordered fastest → slowest.
+
+
+def all_gather_nd(x: jax.Array, mesh: Mesh,
+                  axes: tuple[str, ...]) -> jax.Array:
+    """Gather dim-0 shards across every axis in ``axes`` (fastest first):
+    stage k gathers the stage-(k-1) result over the next-slower transport,
+    so each link class carries its payload exactly once (reference 3d AG
+    low_latency_allgather.py:617-780)."""
     def body(xs):
-        part = lax.psum_scatter(xs, ctx.inner, scatter_dimension=0,
-                                tiled=True)
-        part = lax.psum(part, ctx.outer)
-        return lax.all_gather(part, ctx.inner, tiled=True)
-    f = jax.shard_map(body, mesh=ctx.mesh, in_specs=P(), out_specs=P(),
+        for ax in axes:
+            xs = lax.all_gather(xs, ax, tiled=True)
+        return xs
+    f = jax.shard_map(body, mesh=mesh, in_specs=P(tuple(reversed(axes))),
+                      out_specs=P(), check_vma=False)
+    return f(x)
+
+
+def reduce_scatter_nd(x: jax.Array, mesh: Mesh,
+                      axes: tuple[str, ...]) -> jax.Array:
+    """Reduce-scatter replicated partials over every axis, fastest first,
+    so each slower transport carries payload already divided by the faster
+    world sizes. Resulting dim-0 layout is fastest-major
+    (``P(axes)``) — the n-level analog of :func:`reduce_scatter_2d`'s
+    inner-major note."""
+    def body(xs):
+        for ax in axes:
+            xs = lax.psum_scatter(xs, ax, scatter_dimension=0, tiled=True)
+        return xs
+    f = jax.shard_map(body, mesh=mesh, in_specs=P(),
+                      out_specs=P(tuple(axes)), check_vma=False)
+    return f(x)
+
+
+def all_reduce_nd(x: jax.Array, mesh: Mesh,
+                  axes: tuple[str, ...]) -> jax.Array:
+    """AllReduce as RS down the ladder (fastest first), one AR on the
+    slowest link over 1/prod(faster worlds) of the data, then AG back up
+    (slowest-remaining first) — the n-level extension of
+    :func:`all_reduce_2d`'s minimum-slow-traffic schedule."""
+    *fast, slow = axes
+    def body(xs):
+        for ax in fast:
+            xs = lax.psum_scatter(xs, ax, scatter_dimension=0, tiled=True)
+        xs = lax.psum(xs, slow)
+        for ax in reversed(fast):
+            xs = lax.all_gather(xs, ax, tiled=True)
+        return xs
+    f = jax.shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
                       check_vma=False)
     return f(x)
