@@ -1,0 +1,161 @@
+"""Fused ops composed under an outer data-parallel axis.
+
+The reference delegates DP to torchrun replication (SURVEY.md §2.9 "DP:
+not a subsystem"). Here DP is a mesh axis: the user wraps a step in
+``shard_map(..., axis_names={"dp"})`` and every fused op nests inside it
+— ``nestable_shard_map`` reuses the context mesh, making both axes
+manual inside the op, so ``logical_device_id`` keeps the dp coordinate
+and remote DMAs stay within the dp slice automatically.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@pytest.fixture()
+def mesh_dp(devices):
+    return Mesh(np.array(devices).reshape(2, 4), ("dp", "tp"))
+
+
+def _dp_wrap(mesh, fn, in_specs, out_specs):
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, axis_names={"dp"},
+                                 check_vma=False))
+
+
+# impl="pallas" under an outer dp axis requires compiled (TPU) mode: the
+# interpreter's io_callback crashes XLA when nested in a manual region
+# (see ops.common.resolve_interpret guard); tpu_smoke covers the
+# compiled nesting path.
+@pytest.mark.parametrize("impl", ["xla"])
+def test_ag_gemm_under_dp(mesh_dp, key, impl):
+    from triton_dist_tpu.ops.allgather_gemm import (
+        ag_gemm, create_ag_gemm_context)
+    ctx = create_ag_gemm_context(mesh_dp, "tp")
+    m, k, n = 32, 32, 64
+    k1, k2 = jax.random.split(key)
+    x = jax.random.normal(k1, (m, k), jnp.float32)
+    b = jax.random.normal(k2, (k, n), jnp.float32) / 8
+    xs = jax.device_put(x, NamedSharding(mesh_dp, P(("dp", "tp"), None)))
+    bs = jax.device_put(b, NamedSharding(mesh_dp, P(None, "tp")))
+
+    f = _dp_wrap(mesh_dp, lambda a, w: ag_gemm(a, w, ctx, impl=impl),
+                 (P("dp", None), P(None, None)), P("dp", None))
+    out = f(xs, bs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) @ np.asarray(b),
+                               rtol=2e-3, atol=2e-3)
+
+
+# impl="pallas" under an outer dp axis requires compiled (TPU) mode: the
+# interpreter's io_callback crashes XLA when nested in a manual region
+# (see ops.common.resolve_interpret guard); tpu_smoke covers the
+# compiled nesting path.
+@pytest.mark.parametrize("impl", ["xla"])
+def test_gemm_rs_under_dp(mesh_dp, key, impl):
+    from triton_dist_tpu.ops.gemm_reduce_scatter import (
+        create_gemm_rs_context, gemm_rs)
+    ctx = create_gemm_rs_context(mesh_dp, "tp")
+    m, k, n = 32, 32, 64
+    k1, k2 = jax.random.split(key)
+    x = jax.random.normal(k1, (m, k), jnp.float32)
+    b = jax.random.normal(k2, (k, n), jnp.float32) / 8
+    # within each dp slice: x cols sharded over tp, out rows sharded over tp
+    xs = jax.device_put(x, NamedSharding(mesh_dp, P("dp", "tp")))
+    bs = jax.device_put(b, NamedSharding(mesh_dp, P("tp", None)))
+
+    f = _dp_wrap(mesh_dp, lambda a, w: gemm_rs(a, w, ctx, impl=impl),
+                 (P("dp", None), P(None, None)), P("dp", None))
+    out = f(xs, bs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) @ np.asarray(b),
+                               rtol=2e-3, atol=2e-3)
+
+
+# impl="pallas" under an outer dp axis requires compiled (TPU) mode: the
+# interpreter's io_callback crashes XLA when nested in a manual region
+# (see ops.common.resolve_interpret guard); tpu_smoke covers the
+# compiled nesting path.
+@pytest.mark.parametrize("impl", ["xla"])
+def test_flash_decode_under_dp(mesh_dp, key, impl):
+    """SP decode inside a dp slice: each dp group holds its own batch and
+    combines split-KV partials across its own tp ranks only."""
+    from triton_dist_tpu.ops.flash_decode import (
+        create_flash_decode_context, gqa_fwd_batch_decode)
+    ctx = create_flash_decode_context(mesh_dp, "tp")
+    b, hq, hkv, d, t = 2, 8, 4, 16, 32
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (b, hq, d), jnp.float32)
+    kk = jax.random.normal(ks[1], (b, t, hkv, d), jnp.float32)
+    vv = jax.random.normal(ks[2], (b, t, hkv, d), jnp.float32)
+
+    def golden(q, kk, vv):
+        g = hq // hkv
+        qh = q.reshape(b, hkv, g, d)
+        s = np.einsum("bkgd,btkd->bkgt", qh, kk) / np.sqrt(d)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        return np.einsum("bkgt,btkd->bkgd", p, vv).reshape(b, hq, d)
+
+    qs = jax.device_put(q, NamedSharding(mesh_dp, P("dp")))
+    kvs = NamedSharding(mesh_dp, P("dp", "tp"))
+    kks, vvs = jax.device_put(kk, kvs), jax.device_put(vv, kvs)
+
+    f = _dp_wrap(
+        mesh_dp,
+        lambda q, kk, vv: gqa_fwd_batch_decode(
+            q, kk, vv, jnp.int32(t), ctx, impl=impl),
+        (P("dp"), P("dp", None), P("dp", None)), P("dp"))
+    out = f(qs, kks, vvs)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        golden(np.asarray(q), np.asarray(kk), np.asarray(vv)),
+        rtol=2e-3, atol=2e-3)
+
+
+def test_pallas_under_dp_raises_on_interpreter(mesh_dp, key):
+    """The interpret-mode nesting limitation must surface as a clear error,
+    not an XLA process abort."""
+    from triton_dist_tpu.ops.allgather_gemm import (
+        ag_gemm, create_ag_gemm_context)
+    ctx = create_ag_gemm_context(mesh_dp, "tp")
+    x = jax.device_put(
+        jax.random.normal(key, (32, 32), jnp.float32),
+        NamedSharding(mesh_dp, P(("dp", "tp"), None)))
+    b = jax.device_put(
+        jax.random.normal(key, (32, 64), jnp.float32),
+        NamedSharding(mesh_dp, P(None, "tp")))
+    f = _dp_wrap(mesh_dp, lambda a, w: ag_gemm(a, w, ctx, impl="pallas"),
+                 (P("dp", None), P(None, None)), P("dp", None))
+    with pytest.raises(NotImplementedError, match="interpret-mode"):
+        f(x, b)
+
+
+@pytest.mark.parametrize("mode", ["ag_rs", "gemm_ar"])
+def test_tp_mlp_under_dp(mesh_dp, key, mode):
+    """A whole fused layer under dp: per-dp-slice batches through the
+    AG-GEMM/GEMM-RS (or GEMM-AR) forward."""
+    from triton_dist_tpu.layers.tp_mlp import TPMLP
+    mlp = TPMLP(hidden_size=32, intermediate_size=64, mesh=mesh_dp,
+                axis="tp", dtype=jnp.float32, impl="xla")
+    params = mlp.init(key)
+    x = jax.random.normal(jax.random.fold_in(key, 1), (16, 32), jnp.float32)
+    # ag_rs wants row-sharded input; gemm_ar wants it replicated within
+    # the slice — either way the batch dim carries dp outermost.
+    xs = jax.device_put(x, NamedSharding(mesh_dp, P(("dp", "tp"), None))
+                        if mode == "ag_rs"
+                        else NamedSharding(mesh_dp, P("dp", None)))
+
+    wg, wu, wd = (np.asarray(params[k], np.float64)
+                  for k in ("w_gate", "w_up", "w_down"))
+    xf = np.asarray(x, np.float64)
+
+    def silu(v):
+        return v / (1 + np.exp(-v))
+    ref = (silu(xf @ wg) * (xf @ wu)) @ wd
+
+    f = _dp_wrap(mesh_dp, lambda p, v: mlp(p, v, mode=mode),
+                 (P(None, None), P("dp", None)), P("dp", None))
+    out = f(params, xs)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=5e-2, atol=5e-2)

@@ -336,6 +336,27 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         return out
     case("mega_qwen3", mega_step)
 
+    # Fused kernel nested under an outer DP axis (compiled-mode path the
+    # CPU suite cannot cover — tests/test_dp_compose.py docstring).
+    def dp_nested():
+        # Use a real 2-slice dp axis when the host has >1 device; the
+        # 1-chip bench host degenerates to 1x1 (structure-only check).
+        nd = len(devices) if len(devices) % 2 == 0 else 1
+        shape = (2, nd // 2) if nd >= 2 else (1, 1)
+        mesh2 = Mesh(np.array(devices[:max(nd, 1)]).reshape(shape),
+                     ("dp", "tp"))
+        ctx = create_ag_gemm_context(mesh2, "tp", interpret=interpret)
+        ad = jax.device_put(randn((256, 256)),
+                            NamedSharding(mesh2, P(("dp", "tp"), None)))
+        bd = jax.device_put(randn((256, 256), k=19),
+                            NamedSharding(mesh2, P(None, "tp")))
+        f = jax.jit(jax.shard_map(
+            lambda a, b: ag_gemm(a, b, ctx, impl="pallas"),
+            mesh=mesh2, in_specs=(P("dp", None), P(None, None)),
+            out_specs=P("dp", None), axis_names={"dp"}, check_vma=False))
+        return f(ad, bd)
+    case("dp_compose/nested", dp_nested)
+
     # --- report -----------------------------------------------------------
     if list_only:
         return 0

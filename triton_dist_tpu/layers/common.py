@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from triton_dist_tpu.ops.common import nestable_shard_map
 
 
 def rms_norm(x: jax.Array, w: jax.Array, eps: float = 1e-6) -> jax.Array:
@@ -60,7 +61,7 @@ def col_parallel_matmul(x: jax.Array, w: jax.Array, mesh: Mesh,
 
     The local GEMM of the reference's replicated-activation modes
     (tp_attn.py torch_fwd / gemm-ar path)."""
-    f = jax.shard_map(
+    f = nestable_shard_map(
         lambda xs, ws: jnp.dot(xs, ws, preferred_element_type=jnp.float32
                                ).astype(xs.dtype),
         mesh=mesh, in_specs=(P(), P(None, axis)),
@@ -77,6 +78,6 @@ def row_parallel_matmul_ar(x: jax.Array, w: jax.Array, mesh: Mesh,
         part = jnp.dot(xs, ws, preferred_element_type=jnp.float32
                        ).astype(xs.dtype)
         return lax.psum(part, axis)
-    f = jax.shard_map(body, mesh=mesh, in_specs=(P(None, axis), P(axis)),
+    f = nestable_shard_map(body, mesh=mesh, in_specs=(P(None, axis), P(axis)),
                       out_specs=P(), check_vma=False)
     return f(x, w)

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+from triton_dist_tpu.ops.common import nestable_shard_map
 
 from triton_dist_tpu.ops.all_to_all import (
     AllToAllContext, create_all_to_all_context, fast_all_to_all)
@@ -78,7 +79,7 @@ class EPAll2AllLayer:
         def body(a):
             return lax.all_to_all(a, axis, split_axis=0, concat_axis=0,
                                   tiled=True)
-        return jax.shard_map(body, mesh=self.mesh, in_specs=P(axis),
+        return nestable_shard_map(body, mesh=self.mesh, in_specs=P(axis),
                              out_specs=P(axis), check_vma=False)(arr)
 
     # -- dispatch ----------------------------------------------------------
@@ -108,7 +109,7 @@ class EPAll2AllLayer:
             return (buf, extras["local_expert"], meta["send_counts"],
                     meta["dest"], meta["pos"], meta["valid"])
 
-        pack = jax.shard_map(
+        pack = nestable_shard_map(
             local_pack, mesh=self.mesh, in_specs=(P(axis), P(axis)),
             out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis)),
             check_vma=False)
@@ -126,7 +127,7 @@ class EPAll2AllLayer:
             exp = jnp.where(live, re, self.experts_per_rank)
             return rb.reshape(world * cap, -1), exp.reshape(-1)
 
-        unpack = jax.shard_map(
+        unpack = nestable_shard_map(
             local_unpack, mesh=self.mesh,
             in_specs=(P(axis), P(axis), P(axis)),
             out_specs=(P(axis), P(axis)), check_vma=False)
@@ -155,7 +156,7 @@ class EPAll2AllLayer:
 
         def reshape_slabs(eo):
             return eo.reshape(world, cap, -1)
-        slabs = jax.shard_map(reshape_slabs, mesh=self.mesh,
+        slabs = nestable_shard_map(reshape_slabs, mesh=self.mesh,
                               in_specs=P(axis), out_specs=P(axis),
                               check_vma=False)(expert_out)
 
@@ -172,7 +173,7 @@ class EPAll2AllLayer:
             rows = jnp.where(valid.reshape(-1)[:, None], rows, 0)
             return topk_reduce(rows.reshape(t, k, -1), wts)
 
-        gather = jax.shard_map(
+        gather = nestable_shard_map(
             local_gather, mesh=self.mesh,
             in_specs=(P(axis),) * 5, out_specs=P(axis), check_vma=False)
         return gather(back_buf, handle.dest, handle.pos, handle.valid,

@@ -21,6 +21,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from triton_dist_tpu.ops.common import nestable_shard_map
 
 from triton_dist_tpu.layers.common import shard_param
 from triton_dist_tpu.layers.ep_a2a import EPAll2AllLayer
@@ -121,7 +122,7 @@ class EPMoE:
         def local_ffn(tok, exp, wg, wu, wd):
             return grouped_expert_ffn(tok, wg, wu, wd, exp, e_loc)
 
-        ffn = jax.shard_map(
+        ffn = nestable_shard_map(
             local_ffn, mesh=self.mesh,
             in_specs=(P(self.axis), P(self.axis), P(self.axis),
                       P(self.axis), P(self.axis)),

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh
+from triton_dist_tpu.ops.common import nestable_shard_map
 
 from triton_dist_tpu.ops.p2p import P2PContext, create_p2p_context, pp_shift
 
@@ -68,10 +69,74 @@ def pipeline_forward(stage_fn, x: jax.Array, mesh: Mesh | None = None,
         def body(hs):
             me = lax.axis_index(axis)
             return stage_fn(me, hs)
-        return jax.shard_map(body, mesh=ctx.mesh, in_specs=P(axis),
+        return nestable_shard_map(body, mesh=ctx.mesh, in_specs=P(axis),
                              out_specs=P(axis), check_vma=False)(h)
 
     h = x
     for _ in range(world):
         h = pp_shift(apply(h), ctx, delta=1, impl=impl)
     return h
+
+
+def pipeline_schedule(stage_fn, stage_params, microbatches,
+                      mesh: Mesh | None = None,
+                      axis: str = "pp") -> jax.Array:
+    """GPipe-style microbatched pipeline forward over the pp axis.
+
+    The reference stops at p2p buffers + a test (SURVEY.md §2.9 "PP:
+    partial — no scheduler"); this is the missing scheduler, built
+    TPU-first: one ``lax.scan`` over ``m + w - 1`` ticks inside a single
+    shard_map — at each tick every stage applies itself to the
+    activation it holds and the results rotate one hop along the pp ring
+    (``lax.ppermute`` riding ICI), so all stages are busy in steady
+    state. No data-dependent control flow: fill/drain bubbles are
+    masked, not branched.
+
+    Args:
+      stage_fn: ``stage_fn(params_s, h) -> h`` — one pipeline stage;
+        every activation must keep the same shape/dtype.
+      stage_params: pytree whose leaves are stacked per-stage on dim 0
+        (length = pp world size); sharded over ``axis`` so each device
+        holds its own stage's slice.
+      microbatches: (m, ...) microbatch stack, replicated.
+    Returns:
+      (m, ...) outputs of the full stage stack, replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    ctx = create_p2p_context(mesh, axis)
+    w = ctx.world_size
+    m = microbatches.shape[0]
+    perm = [(i, (i + 1) % w) for i in range(w)]
+
+    def body(params, mb):
+        me = lax.axis_index(axis)
+        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        h0 = jnp.zeros_like(mb[0])
+        out0 = jnp.zeros_like(mb)
+
+        def tick(carry, t):
+            h, out = carry
+            # stage 0 ingests microbatch t (clamped during drain);
+            # later stages consume the hop received last tick.
+            mb_t = lax.dynamic_index_in_dim(
+                mb, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+            h_in = jnp.where(me == 0, mb_t, h)
+            y = stage_fn(local, h_in)
+            # the last stage finishes microbatch j = t - (w-1)
+            j = t - (w - 1)
+            jc = jnp.clip(j, 0, m - 1)
+            valid = (me == w - 1) & (j >= 0)
+            prev = lax.dynamic_index_in_dim(out, jc, 0, keepdims=False)
+            out = lax.dynamic_update_index_in_dim(
+                out, jnp.where(valid, y, prev), jc, 0)
+            return (lax.ppermute(y, axis, perm), out), None
+
+        (_, out), _ = lax.scan(tick, (h0, out0), jnp.arange(m + w - 1))
+        # only the last stage wrote real outputs; everyone else holds
+        # zeros, so a psum replicates the result.
+        return lax.psum(out, axis)
+
+    f = nestable_shard_map(body, mesh=ctx.mesh, in_specs=(P(axis), P()),
+                          out_specs=P(), check_vma=False)
+    return f(stage_params, microbatches)

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+from triton_dist_tpu.ops.common import nestable_shard_map
 
 from triton_dist_tpu.layers.common import (
     apply_rope, col_parallel_matmul, rms_norm, shard_param)
@@ -151,7 +152,7 @@ class TPAttn:
         groups = self.num_heads // self.num_kv_heads
         core = functools.partial(_attention_core, groups=groups)
         spec = P(None, None, axis, None)
-        f = jax.shard_map(
+        f = nestable_shard_map(
             core, mesh=self.mesh,
             in_specs=(spec, spec, spec, spec, spec, P()),
             out_specs=(spec, spec, spec), check_vma=False)

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+from triton_dist_tpu.ops.common import nestable_shard_map
 
 from triton_dist_tpu.layers.common import (
     col_parallel_matmul, row_parallel_matmul_ar, shard_param)
@@ -115,7 +116,7 @@ class TPMLP:
                            ).astype(xs.dtype)
             return lax.psum_scatter(part, axis, scatter_dimension=0,
                                     tiled=True)
-        f = jax.shard_map(
+        f = nestable_shard_map(
             body, mesh=self.mesh,
             in_specs=(P(axis), P(None, axis), P(None, axis), P(axis)),
             out_specs=P(axis), check_vma=False)

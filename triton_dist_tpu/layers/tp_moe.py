@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+from triton_dist_tpu.ops.common import nestable_shard_map
 
 from triton_dist_tpu.layers.common import shard_param
 from triton_dist_tpu.ops.allgather import (
@@ -133,5 +134,5 @@ class TPMoE:
 
         def body(a):
             return lax.all_gather(a, axis, tiled=True)
-        return jax.shard_map(body, mesh=self.mesh, in_specs=P(axis),
+        return nestable_shard_map(body, mesh=self.mesh, in_specs=P(axis),
                              out_specs=P(), check_vma=False)(arr)

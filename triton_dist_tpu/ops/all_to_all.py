@@ -42,7 +42,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
-    cdiv, comm_params, maybe_noise, maybe_straggle, resolve_interpret,
+    cdiv,
+    comm_params,
+    maybe_noise,
+    maybe_straggle,
+    nestable_shard_map,
+    resolve_interpret,
     sync_interpret)
 
 
@@ -205,7 +210,7 @@ def fast_all_to_all(send_buf: jax.Array, send_counts: jax.Array,
             rc = lax.all_to_all(counts, axis, split_axis=0, concat_axis=0,
                                 tiled=True)
             return rb, rc
-        f = jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P(axis)),
+        f = nestable_shard_map(body, mesh=mesh, in_specs=(P(axis), P(axis)),
                           out_specs=(P(axis), P(axis)), check_vma=False)
         return f(send_buf, send_counts)
 
@@ -237,6 +242,6 @@ def fast_all_to_all(send_buf: jax.Array, send_counts: jax.Array,
                                  tiled=True)
         return body(buf, counts, rcounts), rcounts
 
-    f = jax.shard_map(outer, mesh=mesh, in_specs=(P(axis), P(axis)),
+    f = nestable_shard_map(outer, mesh=mesh, in_specs=(P(axis), P(axis)),
                       out_specs=(P(axis), P(axis)), check_vma=False)
     return sync_interpret(f(send_buf, send_counts), interpret)

@@ -38,7 +38,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
-    comm_params, maybe_noise, maybe_straggle, resolve_interpret,
+    comm_params,
+    maybe_noise,
+    maybe_straggle,
+    nestable_shard_map,
+    resolve_interpret,
     sync_interpret)
 
 
@@ -318,7 +322,7 @@ def all_gather(x: jax.Array, ctx: AllGatherContext | None = None,
         def body(xs):
             g = lax.all_gather(xs, axis, tiled=True)
             return g
-        f = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+        f = nestable_shard_map(body, mesh=mesh, in_specs=P(axis),
                           out_specs=out_spec, check_vma=False)
         return f(x)
 
@@ -356,7 +360,7 @@ def all_gather(x: jax.Array, ctx: AllGatherContext | None = None,
             interpret=interpret,
         )(xs)
 
-    f = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+    f = nestable_shard_map(body, mesh=mesh, in_specs=P(axis),
                       out_specs=out_spec, check_vma=False)
     return sync_interpret(f(x), interpret)
 
@@ -385,7 +389,7 @@ def broadcast(x: jax.Array, root: int = 0,
             src = jnp.zeros((world,), x.dtype).at[root].set(1).reshape(
                 (world,) + (1,) * (x.ndim - 1)).astype(x.dtype)
             return lax.psum(xs * src[lax.axis_index(axis)], axis)
-        f = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+        f = nestable_shard_map(body, mesh=mesh, in_specs=P(axis),
                           out_specs=P(), check_vma=False)
         return f(x)
 
@@ -405,6 +409,6 @@ def broadcast(x: jax.Array, root: int = 0,
             interpret=interpret,
         )(xs)
 
-    f = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+    f = nestable_shard_map(body, mesh=mesh, in_specs=P(axis),
                       out_specs=P(), check_vma=False)
     return sync_interpret(f(x), interpret)

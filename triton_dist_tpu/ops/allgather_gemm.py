@@ -35,7 +35,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
-    any_spec, comm_params, maybe_noise, maybe_straggle, resolve_interpret,
+    any_spec,
+    comm_params,
+    maybe_noise,
+    maybe_straggle,
+    nestable_shard_map,
+    resolve_interpret,
     sync_interpret)
 
 
@@ -568,7 +573,7 @@ def ag_gemm_multi(a: jax.Array, bs,
             cs = [jnp.dot(ag, w, preferred_element_type=ctx.acc_dtype
                           ).astype(xs.dtype) for w in ws]
             return tuple(cs) + ((ag,) if ctx.return_gathered else ())
-        f = jax.shard_map(body, mesh=mesh,
+        f = nestable_shard_map(body, mesh=mesh,
                           in_specs=(P(axis),) + (P(None, axis),) * n_b,
                           out_specs=out_specs, check_vma=False)
         return list(f(a, *bs))
@@ -645,7 +650,7 @@ def ag_gemm_multi(a: jax.Array, bs,
                 off += wdt
             return tuple(cs) + ((ag,) if ctx.return_gathered else ())
 
-        f = jax.shard_map(body, mesh=mesh,
+        f = nestable_shard_map(body, mesh=mesh,
                           in_specs=(P(axis),) + (P(None, axis),) * n_b,
                           out_specs=out_specs, check_vma=False)
         return list(sync_interpret(f(a, *bs), interpret))
@@ -695,7 +700,7 @@ def ag_gemm_multi(a: jax.Array, bs,
                 off += wdt
             return tuple(cs) + ((ag,) if ctx.return_gathered else ())
 
-        f = jax.shard_map(body, mesh=mesh,
+        f = nestable_shard_map(body, mesh=mesh,
                           in_specs=(P(axis),) + (P(None, axis),) * n_b,
                           out_specs=out_specs, check_vma=False)
         return list(sync_interpret(f(a, *bs), interpret))
@@ -722,7 +727,7 @@ def ag_gemm_multi(a: jax.Array, bs,
         ag, cs = out[0], out[1:]
         return tuple(cs) + ((ag,) if ctx.return_gathered else ())
 
-    f = jax.shard_map(body, mesh=mesh,
+    f = nestable_shard_map(body, mesh=mesh,
                       in_specs=(P(axis),) + (P(None, axis),) * n_b,
                       out_specs=out_specs, check_vma=False)
     return list(sync_interpret(f(a, *bs), interpret))

@@ -37,7 +37,11 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
-from triton_dist_tpu.ops.common import comm_params, resolve_interpret, sync_interpret
+from triton_dist_tpu.ops.common import (
+    comm_params,
+    nestable_shard_map,
+    resolve_interpret,
+    sync_interpret)
 
 
 class AllReduceMethod(enum.Enum):
@@ -275,7 +279,7 @@ def all_reduce(x: jax.Array, ctx: AllReduceContext | None = None,
         def body(xs):
             r = lax.psum(xs[0], axis)
             return r[None] if stacked else r
-        f = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+        f = nestable_shard_map(body, mesh=mesh, in_specs=P(axis),
                           out_specs=out_spec, check_vma=False)
         return f(x)
 
@@ -321,6 +325,6 @@ def all_reduce(x: jax.Array, ctx: AllReduceContext | None = None,
         )(xs[0])
         return r[None] if stacked else r
 
-    f = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+    f = nestable_shard_map(body, mesh=mesh, in_specs=P(axis),
                       out_specs=out_spec, check_vma=False)
     return sync_interpret(f(x), interpret)

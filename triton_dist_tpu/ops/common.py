@@ -29,6 +29,23 @@ def resolve_interpret(interpret: bool | None):
     if interpret is None:
         interpret = default_interpret()
     if interpret:
+        # A *mixed* mesh context — some axes already Manual (an enclosing
+        # user shard_map, e.g. a DP wrap) while this op's axis is still
+        # Auto — means the op's own shard_map will nest, which the
+        # interpreter cannot lower (io_callback trips an XLA
+        # sharding-validation CHECK). All-Manual (called from inside a
+        # kernel-level shard_map body) and empty (host) contexts are the
+        # normal working paths.
+        am = jax.sharding.get_abstract_mesh()
+        if am is not None and not am.empty:
+            manual = [t == jax.sharding.AxisType.Manual
+                      for t in am.axis_types]
+            if any(manual) and not all(manual):
+                raise NotImplementedError(
+                    "interpret-mode Pallas cannot run nested inside an "
+                    "outer manual shard_map. Under DP composition on the "
+                    "CPU simulator use impl='xla'; compiled TPU mode is "
+                    "the path for nested fused kernels.")
         from triton_dist_tpu.runtime.interpret_compat import (
             patch_interpreter_spin)
         patch_interpreter_spin()
@@ -137,10 +154,33 @@ def min_tile(dtype) -> tuple[int, int]:
     return (sublane, 128)
 
 
+def nestable_shard_map(fn, *, mesh=None, in_specs, out_specs,
+                       check_vma: bool = False):
+    """``jax.shard_map`` for op entry points, callable inside an enclosing
+    shard_map.
+
+    When an op runs under an outer manual region — e.g. the user wraps a
+    whole model step in ``shard_map(..., axis_names={"dp"})`` for data
+    parallelism and the op communicates along "tp" inside it — the inner
+    shard_map must reuse the context's AbstractMesh (passing the concrete
+    mesh raises a context-mismatch error). Inside the nested region every
+    mesh axis is manual, so ``language.logical_device_id`` sees the outer
+    (dp) coordinate via ``lax.axis_index`` and remote DMAs stay within the
+    dp slice — every fused op composes with outer DP/FSDP axes,
+    parallelism the reference delegates to torchrun replication
+    (SURVEY.md §2.9 "DP: not a subsystem").
+    """
+    am = jax.sharding.get_abstract_mesh()
+    if am is not None and not am.empty and any(
+            t == jax.sharding.AxisType.Manual for t in am.axis_types):
+        mesh = am
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=check_vma)
+
+
 def shard_map_1d(fn, mesh, axis: str = "tp"):
     """Wrap ``fn`` in a shard_map over a single mesh axis with everything
     sharded on its leading dim. Convenience for op entry points."""
     from jax.sharding import PartitionSpec as P
     spec = P(axis)
-    return jax.shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec,
-                         check_vma=False)
+    return nestable_shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec)

@@ -57,7 +57,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
-    any_spec, comm_params, resolve_interpret, sync_interpret)
+    any_spec,
+    comm_params,
+    nestable_shard_map,
+    resolve_interpret,
+    sync_interpret)
 
 _NEG = -1e30
 
@@ -370,7 +374,7 @@ def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
             out = num / jnp.maximum(den, 1e-20)[..., None]
             return out.reshape(b, hq, d).astype(qs.dtype)
 
-        f = jax.shard_map(
+        f = nestable_shard_map(
             body, mesh=mesh,
             in_specs=(P(), P(None, axis), P(None, axis), P()),
             out_specs=P(), check_vma=False)
@@ -399,7 +403,7 @@ def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
             )(qs, ks, vs, n)
             return out
 
-        f = jax.shard_map(
+        f = nestable_shard_map(
             body, mesh=mesh,
             in_specs=(P(), P(None, axis), P(None, axis), P()),
             out_specs=P(), check_vma=False)
@@ -448,7 +452,7 @@ def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
         )(qs, n, table, ks, vs)
         return out
 
-    f = jax.shard_map(
+    f = nestable_shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(), P(None, axis), P(None, axis)),
         out_specs=P(), check_vma=False)
@@ -516,7 +520,7 @@ def gqa_fwd_batch_decode_paged(q: jax.Array, pool_k: jax.Array,
         )(qs, n, table.reshape(b, n_pages), ks, vs)
         return out
 
-    f = jax.shard_map(
+    f = nestable_shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(), P(axis), P(axis), P(axis)),
         out_specs=P(), check_vma=False)

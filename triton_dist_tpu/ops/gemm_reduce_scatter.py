@@ -33,7 +33,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
-    any_spec, comm_params, resolve_interpret, sync_interpret)
+    any_spec,
+    comm_params,
+    nestable_shard_map,
+    resolve_interpret,
+    sync_interpret)
 
 
 def _pick_block(total: int, want: int) -> int:
@@ -575,7 +579,7 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
                 return lax.psum(part, axis)
             return lax.psum_scatter(part, axis, scatter_dimension=0,
                                     tiled=True)
-        f = jax.shard_map(body, mesh=mesh, in_specs=(P(None, axis), P(axis)),
+        f = nestable_shard_map(body, mesh=mesh, in_specs=(P(None, axis), P(axis)),
                           out_specs=out_spec, check_vma=False)
         return f(a, b)
 
@@ -663,7 +667,7 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
             )(xs, ws)
             return out
 
-        f = jax.shard_map(nb_body, mesh=mesh,
+        f = nestable_shard_map(nb_body, mesh=mesh,
                           in_specs=(P(None, axis), P(axis)),
                           out_specs=out_spec, check_vma=False)
         return sync_interpret(f(a, b), interpret)
@@ -713,7 +717,7 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
             )(xs, ws)
             return out
 
-        f = jax.shard_map(hbm_body, mesh=mesh,
+        f = nestable_shard_map(hbm_body, mesh=mesh,
                           in_specs=(P(None, axis), P(axis)),
                           out_specs=out_spec, check_vma=False)
         return sync_interpret(f(a, b), interpret)
@@ -748,7 +752,7 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
             interpret=interpret,
         )(xs, ws)
 
-    f = jax.shard_map(body, mesh=mesh, in_specs=(P(None, axis), P(axis)),
+    f = nestable_shard_map(body, mesh=mesh, in_specs=(P(None, axis), P(axis)),
                       out_specs=out_spec, check_vma=False)
     return sync_interpret(f(a, b), interpret)
 

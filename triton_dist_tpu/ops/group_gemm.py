@@ -30,7 +30,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
-    any_spec, comm_params, resolve_interpret, round_up, sync_interpret)
+    any_spec,
+    comm_params,
+    nestable_shard_map,
+    resolve_interpret,
+    round_up,
+    sync_interpret)
 from triton_dist_tpu.ops.moe_utils import sort_by_group
 
 
@@ -352,7 +357,7 @@ def ag_group_gemm(x: jax.Array, w: jax.Array, expert_ids: jax.Array,
         return out
 
     body = oneshot if (impl == "xla" or world == 1) else ring
-    f = jax.shard_map(body, mesh=mesh,
+    f = nestable_shard_map(body, mesh=mesh,
                       in_specs=(P(axis), P(axis), P(None, None, axis)),
                       out_specs=P(None, axis), check_vma=False)
     return f(x, expert_ids, w)
@@ -417,7 +422,7 @@ def _ag_group_gemm_fused(x, w, expert_ids, num_experts, ctx):
         rows = (jnp.arange(world * m_loc) // m_loc) * m_pad + dest_all
         return cpad[rows]
 
-    f = jax.shard_map(
+    f = nestable_shard_map(
         body, mesh=mesh,
         in_specs=(P(axis), P(axis), P(None, None, axis)),
         out_specs=P(None, axis), check_vma=False)

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+from triton_dist_tpu.ops.common import nestable_shard_map
 
 
 @dataclasses.dataclass
@@ -105,7 +106,7 @@ def all_gather_nd(x: jax.Array, mesh: Mesh,
         for ax in axes:
             xs = lax.all_gather(xs, ax, tiled=True)
         return xs
-    f = jax.shard_map(body, mesh=mesh, in_specs=P(tuple(reversed(axes))),
+    f = nestable_shard_map(body, mesh=mesh, in_specs=P(tuple(reversed(axes))),
                       out_specs=P(), check_vma=False)
     return f(x)
 
@@ -121,7 +122,7 @@ def reduce_scatter_nd(x: jax.Array, mesh: Mesh,
         for ax in axes:
             xs = lax.psum_scatter(xs, ax, scatter_dimension=0, tiled=True)
         return xs
-    f = jax.shard_map(body, mesh=mesh, in_specs=P(),
+    f = nestable_shard_map(body, mesh=mesh, in_specs=P(),
                       out_specs=P(tuple(axes)), check_vma=False)
     return f(x)
 
@@ -140,6 +141,6 @@ def all_reduce_nd(x: jax.Array, mesh: Mesh,
         for ax in reversed(fast):
             xs = lax.all_gather(xs, ax, tiled=True)
         return xs
-    f = jax.shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
+    f = nestable_shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
                       check_vma=False)
     return f(x)

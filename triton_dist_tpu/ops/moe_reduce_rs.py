@@ -32,7 +32,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
-    any_spec, comm_params, resolve_interpret, sync_interpret)
+    any_spec,
+    comm_params,
+    nestable_shard_map,
+    resolve_interpret,
+    sync_interpret)
 from triton_dist_tpu.ops.group_gemm import (
     align_tokens_for_tiles, grouped_matmul)
 from triton_dist_tpu.ops.moe_utils import topk_reduce
@@ -288,7 +292,7 @@ def moe_reduce_rs(act: jax.Array, w_down: jax.Array, expert_ids: jax.Array,
         return _moe_rs_fused(act, w_down, expert_ids, weights, ctx)
 
     body = oneshot if (impl == "xla" or world == 1) else ring
-    f = jax.shard_map(
+    f = nestable_shard_map(
         body, mesh=mesh,
         in_specs=(P(None, axis), P(None, axis, None), P(), P()),
         out_specs=P(axis), check_vma=False)
@@ -383,7 +387,7 @@ def _moe_rs_fused(act, w_down, expert_ids, weights, ctx):
         )(padded_all, wd, sel, te_all)
         return out
 
-    f = jax.shard_map(
+    f = nestable_shard_map(
         body, mesh=mesh,
         in_specs=(P(None, axis), P(None, axis, None), P(), P()),
         out_specs=P(axis), check_vma=False)

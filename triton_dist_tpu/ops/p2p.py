@@ -29,7 +29,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
-    comm_params, resolve_interpret, sync_interpret)
+    comm_params,
+    nestable_shard_map,
+    resolve_interpret,
+    sync_interpret)
 
 
 @dataclasses.dataclass
@@ -91,7 +94,7 @@ def pp_shift(x: jax.Array, ctx: P2PContext | None = None, delta: int = 1,
 
         def body(xs):
             return lax.ppermute(xs, axis, perm)
-        return jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+        return nestable_shard_map(body, mesh=mesh, in_specs=P(axis),
                              out_specs=P(axis), check_vma=False)(x)
 
     interpret = resolve_interpret(ctx.interpret)
@@ -110,6 +113,6 @@ def pp_shift(x: jax.Array, ctx: P2PContext | None = None, delta: int = 1,
             interpret=interpret,
         )(xs)
 
-    out = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+    out = nestable_shard_map(body, mesh=mesh, in_specs=P(axis),
                         out_specs=P(axis), check_vma=False)(x)
     return sync_interpret(out, interpret)

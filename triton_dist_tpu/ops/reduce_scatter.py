@@ -33,7 +33,11 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
-from triton_dist_tpu.ops.common import comm_params, resolve_interpret, sync_interpret
+from triton_dist_tpu.ops.common import (
+    comm_params,
+    nestable_shard_map,
+    resolve_interpret,
+    sync_interpret)
 
 
 class ReduceScatterMethod(enum.Enum):
@@ -212,7 +216,7 @@ def reduce_scatter(x: jax.Array, ctx: ReduceScatterContext | None = None,
             local = xs[0]  # (M, N) partial
             return lax.psum_scatter(local, axis, scatter_dimension=0,
                                     tiled=True)[None]
-        f = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+        f = nestable_shard_map(body, mesh=mesh, in_specs=P(axis),
                           out_specs=P(axis), check_vma=False)
         return f(x).reshape(m, n)
 
@@ -243,6 +247,6 @@ def reduce_scatter(x: jax.Array, ctx: ReduceScatterContext | None = None,
             interpret=interpret,
         )(xs[0])
 
-    f = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+    f = nestable_shard_map(body, mesh=mesh, in_specs=P(axis),
                       out_specs=P(axis), check_vma=False)
     return sync_interpret(f(x), interpret)

@@ -50,7 +50,11 @@ import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.allgather import (
     AllGatherContext, create_allgather_context, all_gather)
 from triton_dist_tpu.ops.common import (
-    any_spec, comm_params, resolve_interpret, sync_interpret)
+    any_spec,
+    comm_params,
+    nestable_shard_map,
+    resolve_interpret,
+    sync_interpret)
 
 _NEG = -1e30
 
@@ -357,7 +361,7 @@ def sp_ag_attention_fused(q: jax.Array, k: jax.Array, v: jax.Array,
         )(qs, ks, vs)
         return out
 
-    f = jax.shard_map(body, mesh=mesh,
+    f = nestable_shard_map(body, mesh=mesh,
                       in_specs=(P(None, axis),) * 3,
                       out_specs=P(None, axis), check_vma=False)
     return sync_interpret(f(q, k, v), interpret)
@@ -434,7 +438,7 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     if impl in ("xla", "ring"):
         body = ag_body if (impl == "xla" or world == 1) else ring_body
-        f = jax.shard_map(
+        f = nestable_shard_map(
             body, mesh=mesh,
             in_specs=(P(None, axis), P(None, axis), P(None, axis)),
             out_specs=P(None, axis), check_vma=False)
@@ -468,7 +472,7 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                              vgs.astype(jnp.float32))
             return finish((m, l, acc), qs.dtype)
 
-        f = jax.shard_map(body, mesh=mesh,
+        f = nestable_shard_map(body, mesh=mesh,
                           in_specs=(P(None, axis), P(), P()),
                           out_specs=P(None, axis), check_vma=False)
         return f(q, kg, vg)
