@@ -39,6 +39,12 @@ import os
 import time
 
 os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+# Persist autotune sweeps next to the repo so later rounds (and reruns
+# after a tunnel outage) skip the 20-40 s Mosaic compile per candidate.
+os.environ.setdefault(
+    "TDT_AUTOTUNE_CACHE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 ".tdt_autotune_cache.json"))
 
 
 def _err(e: BaseException) -> str:

@@ -48,6 +48,71 @@ def test_autotune_cache():
     assert len(calls) == n and r1 == r2
 
 
+def test_autotune_disk_cache(tmp_path, monkeypatch):
+    """A sweep persisted to disk is served without re-running configs in
+    a fresh process (simulated by clearing the in-memory cache)."""
+    monkeypatch.setenv("TDT_AUTOTUNE_CACHE", str(tmp_path / "tune.json"))
+    autotuner.clear_cache()
+    calls = []
+
+    def make_fn(v):
+        calls.append(v)
+        return lambda: None
+
+    cfgs = [{"v": 1}, {"v": 2}]
+    r1 = autotune(make_fn, cfgs, key="dk", iters=1, warmup_iters=1)
+    n = len(calls)
+    autotuner.clear_cache()  # "new process"
+    r2 = autotune(make_fn, cfgs, key="dk", iters=1, warmup_iters=1)
+    assert len(calls) == n, "disk hit must not re-run configs"
+    assert r1.config == r2.config
+    # corrupt file degrades to a re-sweep, not an error
+    (tmp_path / "tune.json").write_text("{not json")
+    autotuner.clear_cache()
+    r3 = autotune(make_fn, cfgs, key="dk", iters=1, warmup_iters=1)
+    assert len(calls) > n and r3.config in cfgs
+
+
+def test_autotune_disk_cache_stale_config_resweeps(tmp_path, monkeypatch):
+    """A persisted winner absent from the current candidate list (config
+    table changed, e.g. a tightened VMEM filter) must NOT be served."""
+    monkeypatch.setenv("TDT_AUTOTUNE_CACHE", str(tmp_path / "t.json"))
+    autotuner.clear_cache()
+    calls = []
+
+    def make_fn(v):
+        calls.append(v)
+        return lambda: None
+
+    autotune(make_fn, [{"v": 1}, {"v": 2}], key="sk", iters=1,
+             warmup_iters=1)
+    n = len(calls)
+    autotuner.clear_cache()
+    r = autotune(make_fn, [{"v": 3}, {"v": 4}], key="sk", iters=1,
+                 warmup_iters=1)
+    assert len(calls) > n and r.config in ({"v": 3}, {"v": 4})
+
+
+def test_autotune_disk_cache_failed_config_roundtrip(tmp_path, monkeypatch):
+    """inf scores (failed configs) survive the JSON round trip as
+    losers."""
+    monkeypatch.setenv("TDT_AUTOTUNE_CACHE", str(tmp_path / "t.json"))
+    autotuner.clear_cache()
+
+    def make_fn(v):
+        if v == 1:
+            raise RuntimeError("boom")
+        return lambda: None
+
+    r1 = autotune(make_fn, [{"v": 1}, {"v": 2}], key="fk", iters=1,
+                  warmup_iters=1)
+    autotuner.clear_cache()
+    r2 = autotune(make_fn, [{"v": 1}, {"v": 2}], key="fk", iters=1,
+                  warmup_iters=1)
+    assert r2.config == r1.config == {"v": 2}
+    assert r2.all_ms[0] == float("inf")
+
+
 def test_perf_model_monotonic():
     spec = get_chip_spec()
     t1 = estimate_gemm_sol_time_ms(1024, 1024, 1024, spec)

@@ -37,6 +37,74 @@ def clear_cache():
     _CACHE.clear()
 
 
+def _disk_cache_path() -> str | None:
+    """Persistent sweep cache (off unless ``TDT_AUTOTUNE_CACHE`` is set —
+    a path, or ``1`` for the default location). Worth it on TPU, where
+    each candidate costs a 20-40 s Mosaic compile; the reference's
+    autotuner caches only per Autotuner instance."""
+    import os
+    val = os.environ.get("TDT_AUTOTUNE_CACHE")
+    if not val:
+        return None
+    if val == "1":
+        return os.path.expanduser("~/.cache/triton_dist_tpu/autotune.json")
+    return os.path.expanduser(val)
+
+
+def _disk_key(key: str) -> str:
+    kind = getattr(jax.devices()[0], "device_kind", "cpu")
+    return f"{kind}::{key}"
+
+
+def _disk_load(key: str) -> TuneResult | None:
+    path = _disk_cache_path()
+    if path is None:
+        return None
+    import json
+    import os
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        ent = data.get(_disk_key(key))
+        if ent is None:
+            return None
+        return TuneResult(
+            config=dict(ent["config"]), avg_ms=float(ent["avg_ms"]),
+            all_ms=tuple(float("inf") if t is None else float(t)
+                         for t in ent["all_ms"]))
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def _disk_store(key: str, result: TuneResult) -> None:
+    path = _disk_cache_path()
+    if path is None or jax.process_index() != 0:
+        return
+    import json
+    import os
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        data = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except (OSError, ValueError):
+                data = {}
+        data[_disk_key(key)] = {
+            "config": result.config, "avg_ms": result.avg_ms,
+            "all_ms": [t if np.isfinite(t) else None
+                       for t in result.all_ms]}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def autotune(make_fn: Callable[..., Callable], configs: Sequence[dict],
              key: str | None = None, iters: int = 20,
              warmup_iters: int = 5) -> TuneResult:
@@ -61,6 +129,38 @@ def autotune(make_fn: Callable[..., Callable], configs: Sequence[dict],
     """
     if key is not None and key in _CACHE:
         return _CACHE[key]
+    if key is not None:
+        hit = _disk_load(key)
+        # A persisted winner that is no longer in the candidate list is
+        # stale (the config table changed — e.g. a tightened VMEM-budget
+        # filter excluded it): fall through to a fresh sweep rather than
+        # resurrect a config the current filter rejects.
+        if hit is not None and hit.config not in [dict(c) for c in configs]:
+            hit = None
+        if jax.process_count() > 1:
+            # The hit/miss decision must be AGREED, not per-process: the
+            # cache file may exist on only some hosts, and a partial hit
+            # would leave the missing ranks blocking in the sweep's
+            # process_allgather forever. Process 0 decides; the winner
+            # index + time are broadcast (configs are identical and
+            # identically ordered on every process by construction).
+            from jax.experimental import multihost_utils
+            idx = -1.0
+            avg = float("nan")
+            if hit is not None and jax.process_index() == 0:
+                idx = float(next(i for i, c in enumerate(configs)
+                                 if dict(c) == hit.config))
+                avg = hit.avg_ms
+            agreed = np.asarray(multihost_utils.broadcast_one_to_all(
+                np.asarray([idx, avg], np.float64)))
+            if agreed[0] >= 0:
+                hit = TuneResult(config=dict(configs[int(agreed[0])]),
+                                 avg_ms=float(agreed[1]), all_ms=())
+            else:
+                hit = None
+        if hit is not None:
+            _CACHE[key] = hit
+            return hit
 
     times = []
     errors = []
@@ -97,4 +197,5 @@ def autotune(make_fn: Callable[..., Callable], configs: Sequence[dict],
                         all_ms=tuple(times))
     if key is not None:
         _CACHE[key] = result
+        _disk_store(key, result)
     return result
