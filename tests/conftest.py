@@ -53,3 +53,11 @@ def mesh4x2(devices):
 @pytest.fixture()
 def key():
     return jax.random.PRNGKey(0)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_autotune_cache(monkeypatch):
+    """Tests must not read or write the developer's persistent autotune
+    cache (TDT_AUTOTUNE_CACHE); the disk-cache tests opt back in with
+    their own tmp_path setenv."""
+    monkeypatch.delenv("TDT_AUTOTUNE_CACHE", raising=False)

@@ -135,3 +135,113 @@ def test_all_to_all_2d(mesh4x2, axis):
     rb = np.asarray(ref_buf).reshape(w, w, cap, 128)
     np.testing.assert_allclose(gb[:, :, :8], rb[:, :, :8], rtol=1e-5,
                                atol=1e-5)
+
+
+@pytest.mark.parametrize("axis", ["tp", "ep"])
+def test_reduce_scatter_2d(mesh4x2, axis, key):
+    from triton_dist_tpu.ops.reduce_scatter import (
+        ReduceScatterMethod, create_reduce_scatter_context, reduce_scatter)
+    w = mesh4x2.shape[axis]
+    x = jax.random.normal(key, (w, w * 8, 128), jnp.float32)
+    ref = np.asarray(x, np.float64).sum(axis=0)
+    for method in (ReduceScatterMethod.RING, ReduceScatterMethod.ONE_SHOT):
+        ctx = create_reduce_scatter_context(mesh4x2, axis, method=method)
+        got = reduce_scatter(x, ctx, impl="pallas")
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4,
+                                   atol=1e-4, err_msg=f"{axis}/{method}")
+
+
+@pytest.mark.parametrize("axis", ["tp", "ep"])
+def test_allreduce_2d(mesh4x2, axis, key):
+    from triton_dist_tpu.ops.allreduce import (
+        AllReduceMethod, all_reduce, create_allreduce_context)
+    w = mesh4x2.shape[axis]
+    x = jax.random.normal(key, (w, 16, 128), jnp.float32)
+    ref = np.asarray(x, np.float64).sum(axis=0)
+    for method in (AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT,
+                   AllReduceMethod.RECURSIVE_DOUBLING):
+        ctx = create_allreduce_context(mesh4x2, axis, method=method)
+        got = np.asarray(all_reduce(x, ctx, impl="pallas", stacked=True))
+        for d in range(w):
+            np.testing.assert_allclose(got[d], ref, rtol=1e-4, atol=1e-4,
+                                       err_msg=f"{axis}/{method}/{d}")
+
+
+@pytest.mark.parametrize("axis", ["tp", "ep"])
+def test_gemm_ar_2d(mesh4x2, axis, key):
+    from triton_dist_tpu.ops.gemm_reduce_scatter import (
+        create_gemm_rs_context, gemm_ar)
+    w = mesh4x2.shape[axis]
+    ka, kb = jax.random.split(key)
+    a = (jax.random.normal(ka, (32, 16 * w)) / 4).astype(jnp.float32)
+    b = (jax.random.normal(kb, (16 * w, 64)) / 4).astype(jnp.float32)
+    a_s = _put(mesh4x2, a, P(None, axis))
+    b_s = _put(mesh4x2, b, P(axis))
+    ctx = create_gemm_rs_context(mesh4x2, axis)
+    got = gemm_ar(a_s, b_s, ctx, impl="pallas")
+    full = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
+    np.testing.assert_allclose(np.asarray(got), full, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("axis", ["tp", "ep"])
+@pytest.mark.parametrize("impl", ["ring", "fused"])
+def test_ag_group_gemm_2d(mesh4x2, axis, impl, key):
+    from triton_dist_tpu.ops.group_gemm import (
+        ag_group_gemm, create_ag_group_gemm_context)
+    w = mesh4x2.shape[axis]
+    rows, kdim, n, e = 4, 16, 32 * w, 4
+    m = w * rows
+    x = jax.random.normal(key, (m, kdim), jnp.float32)
+    wt = jax.random.normal(jax.random.PRNGKey(5), (e, kdim, n), jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(6), (m,), 0, e, jnp.int32)
+    xs = _put(mesh4x2, x, P(axis))
+    ws = _put(mesh4x2, wt, P(None, None, axis))
+    ids_s = _put(mesh4x2, ids, P(axis))
+    ctx = create_ag_group_gemm_context(mesh4x2, axis)
+    if impl == "fused":
+        ctx.block_m, ctx.block_n = 8, 16
+    out = ag_group_gemm(xs, ws, ids_s, e, ctx, impl=impl)
+    ref = np.stack([np.asarray(x[i]) @ np.asarray(wt[int(ids[i])])
+                    for i in range(m)])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("axis", ["tp", "ep"])
+@pytest.mark.parametrize("impl", ["ring", "fused"])
+def test_moe_reduce_rs_2d(mesh4x2, axis, impl, key):
+    from triton_dist_tpu.ops.moe_reduce_rs import (
+        create_moe_rs_context, moe_reduce_rs)
+    w = mesh4x2.shape[axis]
+    rows, i, h, e, topk = 4, 16 * w, 16, 4, 2
+    t = w * rows
+    act = jax.random.normal(key, (t * topk, i), jnp.float32)
+    wd = jax.random.normal(jax.random.PRNGKey(2), (e, i, h), jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(3), (t * topk,), 0, e,
+                             jnp.int32)
+    wts = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(4), (t, topk)), axis=-1)
+    ctx = create_moe_rs_context(mesh4x2, axis, num_experts=e, topk=topk)
+    if impl == "fused":
+        ctx.block_m, ctx.block_h = 8, 16
+    act_s = _put(mesh4x2, act, P(None, axis))
+    wd_s = _put(mesh4x2, wd, P(None, axis, None))
+    out = moe_reduce_rs(act_s, wd_s, ids, wts, ctx, impl=impl)
+    pair = np.stack([np.asarray(act[j]) @ np.asarray(wd[int(ids[j])])
+                     for j in range(t * topk)]).reshape(t, topk, h)
+    ref = (pair * np.asarray(wts)[..., None]).sum(1)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("axis", ["tp", "ep"])
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_pp_shift_2d(mesh4x2, axis, impl, key):
+    from triton_dist_tpu.ops.p2p import create_p2p_context, pp_shift
+    w = mesh4x2.shape[axis]
+    rows, f = 4, 128
+    x = jax.random.normal(key, (w * rows, f), jnp.float32)
+    xs = _put(mesh4x2, x, P(axis))
+    ctx = create_p2p_context(mesh4x2, axis)
+    out = pp_shift(xs, ctx, delta=1, impl=impl)
+    ref = np.roll(np.asarray(x).reshape(w, rows, f), 1, axis=0)
+    np.testing.assert_array_equal(
+        np.asarray(out).reshape(w, rows, f), ref)

@@ -85,7 +85,9 @@ def _disk_store(key: str, result: TuneResult) -> None:
     import json
     import os
     try:
-        os.makedirs(os.path.dirname(path), exist_ok=True)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
         data = {}
         if os.path.exists(path):
             try:
@@ -98,10 +100,17 @@ def _disk_store(key: str, result: TuneResult) -> None:
             "all_ms": [t if np.isfinite(t) else None
                        for t in result.all_ms]}
         tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(data, f, indent=1)
-        os.replace(tmp, path)
-    except OSError:
+        try:
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=1)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    except (OSError, TypeError, ValueError):
+        # Persistence is best-effort and, on multi-host, runs on process
+        # 0 only — raising here (e.g. a non-JSON config value) would
+        # desync ranks after an otherwise successful sweep.
         pass
 
 
@@ -147,15 +156,22 @@ def autotune(make_fn: Callable[..., Callable], configs: Sequence[dict],
             from jax.experimental import multihost_utils
             idx = -1.0
             avg = float("nan")
+            allms = [float("nan")] * len(configs)
             if hit is not None and jax.process_index() == 0:
                 idx = float(next(i for i, c in enumerate(configs)
                                  if dict(c) == hit.config))
                 avg = hit.avg_ms
+                # keep the per-config scores (incl. inf losers) so the
+                # TuneResult contract matches the single-host hit
+                for i, t in enumerate(hit.all_ms[:len(configs)]):
+                    allms[i] = t
             agreed = np.asarray(multihost_utils.broadcast_one_to_all(
-                np.asarray([idx, avg], np.float64)))
+                np.asarray([idx, avg] + allms, np.float64)))
             if agreed[0] >= 0:
                 hit = TuneResult(config=dict(configs[int(agreed[0])]),
-                                 avg_ms=float(agreed[1]), all_ms=())
+                                 avg_ms=float(agreed[1]),
+                                 all_ms=tuple(float(t)
+                                              for t in agreed[2:]))
             else:
                 hit = None
         if hit is not None:
