@@ -49,6 +49,26 @@ def test_all_gather_auto_method():
         AllGatherMethod.RING_BIDIR
 
 
+@pytest.mark.parametrize("method", [AllGatherMethod.RING_1D,
+                                    AllGatherMethod.RING_BIDIR,
+                                    AllGatherMethod.FULL_MESH_PUSH])
+@pytest.mark.parametrize("shape,dtype", [
+    ((WORLD * 8, 128), jnp.float32),    # one (8,128) f32 tile per rank
+    ((WORLD * 16, 128), jnp.bfloat16),  # one (16,128) bf16 tile per rank
+])
+def test_all_gather_small_msg(mesh8, key, method, shape, dtype):
+    """Latency-class payloads — one minimum TPU tile per rank (4 KB) —
+    must stay correct on every method (reference test_ag_small_msg.py:
+    the LL-allgather family's domain; here the same kernels serve both
+    regimes and AUTO picks FULL_MESH_PUSH below the perf-model
+    crossover)."""
+    x = _mk(key, shape, dtype)
+    ctx = create_allgather_context(mesh8, method=method)
+    got = all_gather(x, ctx, impl="pallas", stacked=True)
+    ref = all_gather(x, ctx, impl="xla", stacked=True)
+    assert bitwise_equal(got, ref)
+
+
 @pytest.mark.parametrize("method", [ReduceScatterMethod.RING,
                                     ReduceScatterMethod.ONE_SHOT])
 @pytest.mark.parametrize("dtype", [jnp.float32])

@@ -130,6 +130,15 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         case(f"allgather/{method.name.lower()}",
              lambda ctx=ctx: all_gather(x, ctx, impl="pallas"))
 
+    # Latency-class payload: one (16,128) bf16 tile per rank (reference
+    # test_ag_small_msg.py / LL-allgather regime).
+    xsm = sharded(randn((16, 128)), P("tp"))
+    sm_ctx = create_allgather_context(
+        mesh, "tp", method=AllGatherMethod.FULL_MESH_PUSH,
+        interpret=interpret)
+    case("allgather/small_msg",
+         lambda: all_gather(xsm, sm_ctx, impl="pallas"))
+
     from triton_dist_tpu.ops.reduce_scatter import (
         ReduceScatterMethod, create_reduce_scatter_context, reduce_scatter)
     xp = sharded(randn((1, 256, 256)), P("tp"))  # (w, M, N) partials
