@@ -71,6 +71,21 @@ def test_engine_serve_shapes_and_prefix(model, key):
                                   np.asarray(ids))
 
 
+def test_engine_decode_profile_hook(model, key, tmp_path):
+    """The decode profile window (reference engine.py:153-179) traces the
+    first N steps and leaves generation unchanged."""
+    params = model.init(key)
+    ids = jnp.asarray([[9, 8, 7]], jnp.int32)
+    plain = np.asarray(Engine(model, batch=1, max_seq=16)
+                       .serve(params, ids, 5))
+    eng = Engine(model, batch=1, max_seq=16,
+                 profile_dir=str(tmp_path), profile_steps=2)
+    prof = np.asarray(eng.serve(params, ids, 5))
+    np.testing.assert_array_equal(plain, prof)
+    from triton_dist_tpu.tools.profiler import trace_files
+    assert trace_files("engine_decode", str(tmp_path)), "no trace written"
+
+
 def test_engine_reuse_resets_cache(model, key):
     """Two serves from the same Engine must be independent (the KV cache
     resets between calls) — a stale cache would change the second run."""

@@ -169,3 +169,21 @@ def test_zigzag_roundtrip(key):
     r = zigzag_restore(z, world=4)
     np.testing.assert_array_equal(np.asarray(r), np.asarray(x))
     assert not np.array_equal(np.asarray(z), np.asarray(x))
+
+
+def test_zigzag_balances_causal_work():
+    """The point of the zigzag layout (reference intra-node schedule):
+    pairing chunk r with chunk 2w-1-r equalizes causal attention work
+    (sum of key positions attended) across shards."""
+    w, s = 4, 64
+    c = s // (2 * w)
+    # derive each shard's positions from the actual implementation
+    layout = np.asarray(zigzag_reorder(jnp.arange(s)[None], world=w,
+                                       seq_axis=1))[0]
+    shards = layout.reshape(w, 2 * c)
+    work = [int((shards[r] + 1).sum()) for r in range(w)]
+    assert len(set(work)) == 1, f"unbalanced shard work: {work}"
+    # contiguous sharding is maximally unbalanced by contrast
+    contig = [sum(p + 1 for p in range(r * 2 * c, (r + 1) * 2 * c))
+              for r in range(w)]
+    assert len(set(contig)) == w

@@ -42,7 +42,8 @@ class Engine:
 
     def __init__(self, model, batch: int, max_seq: int,
                  prefill_mode: str = "xla_ar", decode_mode: str = "gemm_ar",
-                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 profile_dir: str | None = None, profile_steps: int = 64):
         self.model = model
         c = model.config
         self.kv = KVCacheManager(
@@ -53,6 +54,12 @@ class Engine:
         self.temperature = temperature
         self.top_k = top_k
         self.key = jax.random.PRNGKey(seed)
+        # Decode-loop profile hook (reference engine.py:153-179: a
+        # 64-step torch-profiler window inside serve): when set, the
+        # first ``profile_steps`` decode steps of each serve() are traced
+        # per-host under ``profile_dir``.
+        self.profile_dir = profile_dir
+        self.profile_steps = profile_steps
         self._decode_step = None
 
     # -- decode step (jit once = graph capture, engine.py:75-105) ----------
@@ -87,10 +94,31 @@ class Engine:
         if self._decode_step is None:
             self._decode_step = self._build_decode_step()
         out = [input_ids, token[:, None]]
-        for _ in range(gen_len - 1):
+
+        def run_steps(n):
+            nonlocal token, caches
+            for _ in range(n):
+                self.key, sub = jax.random.split(self.key)
+                token, caches = self._decode_step(
+                    params, caches, token, jnp.int32(self.kv.offset), sub)
+                self.kv.inc_offset(1)
+                out.append(token[:, None])
+
+        n_total = gen_len - 1
+        if self.profile_dir and n_total > 0:
+            from triton_dist_tpu.tools.profiler import group_profile
+            # Compile the step BEFORE opening the trace window so the
+            # profile shows steady-state per-token replay, not one-off
+            # XLA compile time.
             self.key, sub = jax.random.split(self.key)
-            token, caches = self._decode_step(
-                params, caches, token, jnp.int32(self.kv.offset), sub)
-            self.kv.inc_offset(1)
-            out.append(token[:, None])
+            self._decode_step.lower(
+                params, caches, token, jnp.int32(self.kv.offset),
+                sub).compile()
+            n_prof = min(self.profile_steps, n_total)
+            with group_profile("engine_decode", self.profile_dir):
+                run_steps(n_prof)
+                jax.block_until_ready(token)
+            run_steps(n_total - n_prof)
+        else:
+            run_steps(n_total)
         return jnp.concatenate(out, axis=1)

@@ -50,21 +50,7 @@ def annotate(label: str):
         yield
 
 
-def decode_profile_hook(engine, steps: int = 64, name: str = "decode",
-                        out_dir: str = "/tmp/tdt_profile"):
-    """Profile N decode steps of an Engine (reference engine.py:153-179
-    64-step decode profile). Returns the trace dir."""
-    import jax.numpy as jnp
-
-    with group_profile(name, out_dir) as path:
-        params = getattr(engine, "_profile_params")
-        caches = engine.kv.init()
-        token = jnp.zeros((engine.kv.batch,), jnp.int32)
-        if engine._decode_step is None:
-            engine._decode_step = engine._build_decode_step()
-        key = jax.random.PRNGKey(0)
-        for s in range(steps):
-            token, caches = engine._decode_step(
-                params, caches, token, jnp.int32(s), key)
-        jax.block_until_ready(token)
-    return path
+# The Engine's decode-loop profile window (reference engine.py:153-179)
+# lives in models/engine.py: construct Engine(profile_dir=...,
+# profile_steps=...) and the first N decode steps of each serve() are
+# traced per-host via group_profile("engine_decode", profile_dir).
