@@ -43,7 +43,8 @@ def _init_backend(retries: int = 3, backoff_s: float = 20.0):
 
 
 def run_smoke(log_path: str | None = None, only: str | None = None,
-              interpret: bool = False, list_only: bool = False) -> int:
+              interpret: bool = False, list_only: bool = False,
+              skip: str | None = None) -> int:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -61,9 +62,13 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
                     return False
         return True
 
+    skips = [s for s in (skip or "").split(",") if s]
+
     def case(name, fn):
         if list_only:
             print(name)
+            return
+        if any(s == name for s in skips):
             return
         if only:
             # "=name" selects exactly; otherwise substring filter.
@@ -381,7 +386,8 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     return 1 if n_fail else 0
 
 
-def run_subproc(log_path: str, timeout_s: float) -> int:
+def run_subproc(log_path: str, timeout_s: float,
+                skip: str | None = None) -> int:
     """Run every case in its OWN subprocess with a hard deadline.
 
     A Mosaic compile hang through the tunnel has been observed to wedge
@@ -392,6 +398,8 @@ def run_subproc(log_path: str, timeout_s: float) -> int:
     names = subprocess.run(
         [sys.executable, __file__, "--list"], capture_output=True,
         text=True, timeout=600).stdout.split()
+    skips = [s for s in (skip or "").split(",") if s]
+    names = [n for n in names if n not in skips]
     n_fail = 0
     lines = []
     for name in names:
@@ -429,11 +437,15 @@ if __name__ == "__main__":
     ap.add_argument("--subproc", action="store_true",
                     help="one subprocess per case with a hard timeout")
     ap.add_argument("--case-timeout", type=float, default=420.0)
+    ap.add_argument("--skip", default=None,
+                    help="comma-separated exact case names to exclude "
+                         "(e.g. risky never-compiled kernels, run last "
+                         "separately)")
     args = ap.parse_args()
     if args.list:
         sys.exit(run_smoke(None, None, list_only=True))
     with open(args.log, "w") as f:
         f.write(f"tpu_smoke @ {time.strftime('%Y-%m-%d %H:%M:%S')}\n")
     if args.subproc:
-        sys.exit(run_subproc(args.log, args.case_timeout))
-    sys.exit(run_smoke(args.log, args.only))
+        sys.exit(run_subproc(args.log, args.case_timeout, skip=args.skip))
+    sys.exit(run_smoke(args.log, args.only, skip=args.skip))

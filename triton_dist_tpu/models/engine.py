@@ -105,20 +105,21 @@ class Engine:
                 out.append(token[:, None])
 
         n_total = gen_len - 1
-        if self.profile_dir and n_total > 0:
+        if self.profile_dir and n_total > 1:
             from triton_dist_tpu.tools.profiler import group_profile
-            # Compile the step BEFORE opening the trace window so the
-            # profile shows steady-state per-token replay, not one-off
-            # XLA compile time.
-            self.key, sub = jax.random.split(self.key)
-            self._decode_step.lower(
-                params, caches, token, jnp.int32(self.kv.offset),
-                sub).compile()
-            n_prof = min(self.profile_steps, n_total)
+            # One REAL warm-up step before the window: it populates the
+            # jit dispatch cache (AOT lower().compile() would not), so
+            # the trace shows steady-state per-token replay rather than
+            # the one-off XLA compile — and because it goes through the
+            # same run_steps path, the RNG stream matches an unprofiled
+            # serve() exactly.
+            run_steps(1)
+            jax.block_until_ready(token)
+            n_prof = min(self.profile_steps, n_total - 1)
             with group_profile("engine_decode", self.profile_dir):
                 run_steps(n_prof)
                 jax.block_until_ready(token)
-            run_steps(n_total - n_prof)
+            run_steps(n_total - 1 - n_prof)
         else:
             run_steps(n_total)
         return jnp.concatenate(out, axis=1)
