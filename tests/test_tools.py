@@ -146,6 +146,24 @@ def test_aot_export_roundtrip():
                                np.asarray(fn(a, b)))
 
 
+def test_aot_export_symbolic_dynamic_m():
+    """One symbolic-M artifact serves multiple batch sizes (the
+    reference's per-signature AOT spaces over M, compile_aot.py:61)."""
+    from triton_dist_tpu.tools.aot import aot_export_symbolic
+    w = jax.random.normal(jax.random.PRNGKey(0), (16, 8), jnp.float32)
+
+    def fn(x):
+        return x @ w
+
+    blob = aot_export_symbolic(fn, [("m, 16", jnp.float32)])
+    loaded = aot_load(blob)
+    for m in (4, 32):
+        x = jax.random.normal(jax.random.PRNGKey(m), (m, 16), jnp.float32)
+        np.testing.assert_allclose(np.asarray(loaded(x)),
+                                   np.asarray(x) @ np.asarray(w),
+                                   rtol=1e-5, atol=1e-5)
+
+
 def test_aot_compile_spaces(tmp_path):
     a = jnp.ones((4, 4), jnp.float32)
 

@@ -35,6 +35,30 @@ def aot_export(fn: Callable, example_args: Sequence,
     return bytes(exp.serialize())
 
 
+def aot_export_symbolic(fn: Callable, args_spec: Sequence,
+                        platforms: Sequence[str] | None = None) -> bytes:
+    """Export with symbolic dimensions — ONE artifact serving every size
+    of the dynamic axes (the reference instead enumerates a C source per
+    (kernel x config) signature; its flash-decode AOT spaces over M,
+    compile_aot.py:61-115, collapse into a single symbolic export here).
+
+    Args:
+      args_spec: one ``(shape_str, dtype)`` per argument; ``shape_str``
+        is a jax.export symbolic shape, e.g. ``("m, 4096", jnp.bfloat16)``
+        — the same symbol name means the same size across arguments.
+    """
+    scope = jax_export.SymbolicScope()
+    avals = tuple(
+        jax.ShapeDtypeStruct(
+            jax_export.symbolic_shape(s, scope=scope), dtype)
+        for s, dtype in args_spec)
+    exp = jax_export.export(
+        jax.jit(fn),
+        platforms=list(platforms) if platforms else None,
+    )(*avals)
+    return bytes(exp.serialize())
+
+
 def aot_load(blob: bytes) -> Callable:
     """Deserialize an exported artifact into a callable (reference
     registry.cc lookup + triton_aot_runtime launch)."""
