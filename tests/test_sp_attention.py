@@ -145,6 +145,33 @@ def test_sp_prefill_attention(mesh8, impl, causal, key):
 
 
 @pytest.mark.parametrize("causal", [True, False])
+def test_sp_ulysses_attention(mesh8, causal, key):
+    """All-to-all head parallelism (absent in the reference): exact match
+    with the dense golden — no online-softmax merging error at all."""
+    b, s, hq, hkv, d = 2, 64, 16, 8, 16
+    q = jax.random.normal(key, (b, s, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(5), (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(6), (b, s, hkv, d), jnp.float32)
+    ctx = create_sp_attention_context(mesh8, "tp", causal=causal)
+    sh = NamedSharding(mesh8, P(None, "tp"))
+    out = sp_ag_attention(jax.device_put(q, sh), jax.device_put(k, sh),
+                          jax.device_put(v, sh), ctx, impl="ulysses")
+    ref = attention_golden(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=3e-4, atol=3e-4)
+
+
+def test_sp_ulysses_rejects_indivisible_heads(mesh8, key):
+    b, s, hq, hkv, d = 1, 64, 4, 2, 16
+    q = jax.random.normal(key, (b, s, hq, d), jnp.float32)
+    k = jax.random.normal(key, (b, s, hkv, d), jnp.float32)
+    ctx = create_sp_attention_context(mesh8, "tp")
+    sh = NamedSharding(mesh8, P(None, "tp"))
+    with pytest.raises(AssertionError, match="divisible"):
+        sp_ag_attention(jax.device_put(q, sh), jax.device_put(k, sh),
+                        jax.device_put(k, sh), ctx, impl="ulysses")
+
+
+@pytest.mark.parametrize("causal", [True, False])
 def test_sp_fused_multi_tile(mesh8, causal, key):
     """Fused kernel with several KV subtiles and q tiles per chunk
     (n_sub=2, n_q=2) — exercises the double-buffered subtile DMA loop."""

@@ -9,12 +9,16 @@ reference lacks (SURVEY.md §5 flags it as the ICI-natural extension): on a
 torus each ppermute hop rides one neighbor link, KV is never materialized
 in full, and the online-softmax merge makes the schedule exact.
 
-Three implementations:
+Five implementations:
 
 - ``impl="ring"``  — ring attention: rotate the KV shard w-1 times; each
   step folds one shard into the running (m, l, acc) online-softmax state
   while the next shard is in flight (collective matmul schedule — XLA
   overlaps the ppermute with the einsums).
+- ``impl="ulysses"`` — all-to-all head parallelism (DeepSpeed-Ulysses
+  style; also absent in the reference): trade the sequence sharding for
+  a head sharding, one exact full-sequence pass on the local heads,
+  trade back. Needs heads divisible by the world size.
 - ``impl="xla"``   — AG-KV golden: one ``all_gather`` of KV + a single
   masked attention pass (the reference's semantic baseline).
 - ``impl="pallas"``— ONE fused kernel: in-kernel ring AG of KV chunks
@@ -440,6 +444,50 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         body = ag_body if (impl == "xla" or world == 1) else ring_body
         f = nestable_shard_map(
             body, mesh=mesh,
+            in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+            out_specs=P(None, axis), check_vma=False)
+        return f(q, k, v)
+
+    if impl == "ulysses":
+        # All-to-all head parallelism (DeepSpeed-Ulysses style; absent in
+        # the reference — SURVEY.md §2.9 "CP/Ulysses: Absent"): exchange
+        # the sequence sharding for a head sharding, run full-sequence
+        # attention on the local head subset, exchange back. Four
+        # all-to-alls (q/k/v in, out back), each moving S_loc*H/w
+        # elements per device — less traffic than AG-KV when heads are
+        # plentiful, and every score is computed exactly once (no
+        # online-softmax merges).
+        assert hkv % world == 0 and hq % world == 0, (
+            f"ulysses needs heads divisible by world: hq={hq}, "
+            f"hkv={hkv}, world={world}")
+
+        def ulysses_body(qs, ks, vs):
+            # (B, S_loc, H, D) -> (B, S, H/w, D): split heads, gather seq.
+            # Contiguous head split keeps GQA groups aligned (q head
+            # h = k*groups + g, so Hq/w q-heads pair with Hkv/w kv-heads).
+            qh = lax.all_to_all(qs, axis, split_axis=2, concat_axis=1,
+                                tiled=True)
+            kh = lax.all_to_all(ks, axis, split_axis=2, concat_axis=1,
+                                tiled=True)
+            vh = lax.all_to_all(vs, axis, split_axis=2, concat_axis=1,
+                                tiled=True)
+            hkv_loc = hkv // world
+            qf = qh.reshape(b, s, hkv_loc, groups, d
+                            ).transpose(0, 2, 3, 1, 4).astype(jnp.float32)
+            scores = _chunk_scores(qf, kh, 0, 0, causal)
+            m = jnp.max(scores, axis=-1)
+            p = jnp.exp(scores - m[..., None])
+            l = jnp.sum(p, axis=-1)
+            acc = jnp.einsum("bkgst,btkd->bkgsd", p, vh.astype(jnp.float32))
+            out = (acc / jnp.maximum(l, 1e-20)[..., None]
+                   ).transpose(0, 3, 1, 2, 4).reshape(
+                       b, s, hq // world, d).astype(qs.dtype)
+            # (B, S, H/w, D) -> (B, S_loc, H, D): split seq, gather heads.
+            return lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        f = nestable_shard_map(
+            ulysses_body, mesh=mesh,
             in_specs=(P(None, axis), P(None, axis), P(None, axis)),
             out_specs=P(None, axis), check_vma=False)
         return f(q, k, v)

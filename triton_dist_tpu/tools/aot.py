@@ -52,11 +52,7 @@ def aot_export_symbolic(fn: Callable, args_spec: Sequence,
         jax.ShapeDtypeStruct(
             jax_export.symbolic_shape(s, scope=scope), dtype)
         for s, dtype in args_spec)
-    exp = jax_export.export(
-        jax.jit(fn),
-        platforms=list(platforms) if platforms else None,
-    )(*avals)
-    return bytes(exp.serialize())
+    return aot_export(fn, avals, platforms=platforms)
 
 
 def aot_load(blob: bytes) -> Callable:
