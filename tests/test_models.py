@@ -178,6 +178,56 @@ def test_hf_state_dict_load(mesh8):
                                rtol=1e-6, atol=1e-6)
 
 
+def test_llama_style_checkpoint_load(mesh8, key):
+    """Llama-3 / Seed-OSS-class dense checkpoints (no q/k-norm weights —
+    reference AutoLLM maps Meta-Llama-3-70B and Seed-OSS-36B to DenseLLM,
+    models/__init__.py:33-42) load and run."""
+    import dataclasses
+    cfg = dataclasses.replace(tiny_dense_cfg(), model_type="llama",
+                              qk_norm=False)
+    model = DenseLLM(cfg, mesh=mesh8, axis="tp")
+    rng = np.random.RandomState(1)
+
+    def w(*shape):
+        return rng.randn(*shape).astype(np.float32) * 0.05
+
+    h, d = cfg.hidden_size, cfg.head_dim
+    nq = cfg.num_attention_heads * d
+    nkv = cfg.num_key_value_heads * d
+    state = {"model.embed_tokens.weight": w(cfg.vocab_size, h),
+             "model.norm.weight": np.ones(h, np.float32),
+             "lm_head.weight": w(cfg.vocab_size, h)}
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        state.update({
+            p + "self_attn.q_proj.weight": w(nq, h),
+            p + "self_attn.k_proj.weight": w(nkv, h),
+            p + "self_attn.v_proj.weight": w(nkv, h),
+            p + "self_attn.o_proj.weight": w(h, nq),
+            p + "mlp.gate_proj.weight": w(cfg.intermediate_size, h),
+            p + "mlp.up_proj.weight": w(cfg.intermediate_size, h),
+            p + "mlp.down_proj.weight": w(h, cfg.intermediate_size),
+            p + "input_layernorm.weight": np.ones(h, np.float32),
+            p + "post_attention_layernorm.weight": np.ones(h, np.float32),
+        })
+    params = model.load_hf_state_dict(state)  # no q_norm keys required
+    assert "q_norm" not in params["layers"][0]["attn"]
+    ids = jnp.arange(8, dtype=jnp.int32).reshape(2, 4)
+    out, _ = model.forward(params, ids, _caches(model, 2, 16), 0,
+                           mode="xla_ar")
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+
+def test_model_config_qk_norm_by_model_type():
+    base = {"hidden_size": 64, "num_hidden_layers": 1,
+            "num_attention_heads": 4, "vocab_size": 100,
+            "intermediate_size": 128}
+    assert ModelConfig.from_hf_config({**base,
+                                       "model_type": "qwen3"}).qk_norm
+    assert not ModelConfig.from_hf_config({**base,
+                                           "model_type": "llama"}).qk_norm
+
+
 def test_autollm_build_dispatch(mesh8):
     assert isinstance(AutoLLM.build(tiny_dense_cfg(), mesh=mesh8), DenseLLM)
     assert isinstance(AutoLLM.build(tiny_moe_cfg(), mesh=mesh8), Qwen3MoE)

@@ -33,6 +33,10 @@ class ModelConfig:
     moe_intermediate_size: int = 0
     norm_topk_prob: bool = True
     model_type: str = "qwen3"
+    # Qwen3 applies RMSNorm to q/k heads; Llama-3 / Seed-OSS-class dense
+    # models (reference AutoLLM maps both to DenseLLM,
+    # models/__init__.py:33-42) do not.
+    qk_norm: bool = True
 
     @property
     def is_moe(self) -> bool:
@@ -70,4 +74,5 @@ class ModelConfig:
             moe_intermediate_size=cfg.get("moe_intermediate_size", 0),
             norm_topk_prob=cfg.get("norm_topk_prob", True),
             model_type=cfg.get("model_type", "qwen3"),
+            qk_norm=cfg.get("model_type", "qwen3").startswith("qwen3"),
         )

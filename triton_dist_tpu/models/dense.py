@@ -45,7 +45,8 @@ class DenseLLM:
         self.attn = TPAttn(c.hidden_size, c.num_attention_heads,
                            c.num_key_value_heads, c.head_dim, mesh=mesh,
                            axis=axis, dtype=c.dtype, fwd_mode=fwd_mode,
-                           impl=impl, rms_eps=c.rms_norm_eps)
+                           impl=impl, rms_eps=c.rms_norm_eps,
+                           qk_norm=c.qk_norm)
         self.mlp = TPMLP(c.hidden_size, c.intermediate_size, mesh=mesh,
                          axis=axis, dtype=c.dtype, fwd_mode=fwd_mode,
                          impl=impl)
@@ -156,15 +157,17 @@ class DenseLLM:
         layers = []
         for i in range(c.num_hidden_layers):
             p = f"model.layers.{i}."
+            attn = {
+                "w_q": lin(p + "self_attn.q_proj.weight"),
+                "w_k": lin(p + "self_attn.k_proj.weight"),
+                "w_v": lin(p + "self_attn.v_proj.weight"),
+                "w_o": lin(p + "self_attn.o_proj.weight"),
+            }
+            if c.qk_norm:  # absent in Llama-3 / Seed-OSS checkpoints
+                attn["q_norm"] = get(p + "self_attn.q_norm.weight")
+                attn["k_norm"] = get(p + "self_attn.k_norm.weight")
             layers.append({
-                "attn": {
-                    "w_q": lin(p + "self_attn.q_proj.weight"),
-                    "w_k": lin(p + "self_attn.k_proj.weight"),
-                    "w_v": lin(p + "self_attn.v_proj.weight"),
-                    "w_o": lin(p + "self_attn.o_proj.weight"),
-                    "q_norm": get(p + "self_attn.q_norm.weight"),
-                    "k_norm": get(p + "self_attn.k_norm.weight"),
-                },
+                "attn": attn,
                 "mlp": {
                     "w_gate": lin(p + "mlp.gate_proj.weight"),
                     "w_up": lin(p + "mlp.up_proj.weight"),
