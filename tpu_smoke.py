@@ -297,6 +297,8 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         case(f"sp_ag_attention/{impl}",
              lambda impl=impl: sp_ag_attention(qs, ks, vs, sp_ctx,
                                                impl=impl))
+    case("sp_ag_attention/ulysses",
+         lambda: sp_ag_attention(qs, ks, vs, sp_ctx, impl="ulysses"))
 
     # EP-mode MoE layer end-to-end, world=1-compilable (VERDICT r2
     # next 6; reference test_ep_moe_inference.py).
