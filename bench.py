@@ -302,6 +302,51 @@ def _bench_flash_decode(mesh, n, on_tpu, extras):
     return t_pallas, t_xla / t_pallas
 
 
+def _bench_sp_attention(mesh, n, on_tpu, extras):
+    """Long-context prefill attention: fused SP kernel vs XLA AG-KV
+    golden (reference sp_ag_attention_inter_node.py; at world=1 this is
+    the local flash-path comparison)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from triton_dist_tpu.ops.sp_attention import (
+        create_sp_attention_context, sp_ag_attention)
+    from triton_dist_tpu.runtime.utils import perf_func_chained
+
+    if on_tpu:
+        b, s, hq, hkv, d = 1, 4096, 16, 8, 128
+    else:
+        b, s, hq, hkv, d = 1, 256, 8, 4, 32
+    ctx = create_sp_attention_context(
+        mesh, "tp", causal=True,
+        interpret=None if not on_tpu else False)
+    sh = NamedSharding(mesh, P(None, "tp"))
+    q0 = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(0), (b, s, hq, d),
+                          jnp.float32).astype(jnp.bfloat16), sh)
+    k = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv, d),
+                          jnp.float32).astype(jnp.bfloat16), sh)
+    v = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, d),
+                          jnp.float32).astype(jnp.bfloat16), sh)
+
+    def make_step(impl):
+        @jax.jit
+        def step(q):
+            out = sp_ag_attention(q, k, v, ctx, impl=impl)
+            return (out.astype(jnp.float32) * 0.5 + 0.5
+                    ).astype(jnp.bfloat16)
+        return step
+
+    t_fused = perf_func_chained(make_step("pallas"), q0, (8, 24))
+    t_xla = perf_func_chained(make_step("xla"), q0, (8, 24))
+    extras["sp_attn_fused_ms"] = round(t_fused, 4)
+    extras["sp_attn_xla_ms"] = round(t_xla, 4)
+    extras["sp_attn_vs_xla"] = round(t_xla / t_fused, 4)
+    return t_fused, t_xla / t_fused
+
+
 def _bench_ag_group_gemm(mesh, n, on_tpu, extras):
     """Fused-Pallas vs ppermute-ring AG+grouped-GEMM (VERDICT r2 next 7:
     measure both on the chip, keep whichever wins)."""
@@ -504,6 +549,8 @@ def main():
                 ("gemm_ar", lambda: _bench_gemm_ar(mesh, n, on_tpu, extras)),
                 ("flash_decode",
                  lambda: _bench_flash_decode(mesh, n, on_tpu, extras)),
+                ("sp_attn",
+                 lambda: _bench_sp_attention(mesh, n, on_tpu, extras)),
                 ("moe_ag_gg",
                  lambda: _bench_ag_group_gemm(mesh, n, on_tpu, extras)),
                 ("mega",
