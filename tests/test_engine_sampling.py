@@ -71,6 +71,58 @@ def test_engine_serve_shapes_and_prefix(model, key):
                                   np.asarray(ids))
 
 
+def test_engine_stop_tokens(model, key):
+    """Rows that emit a stop token keep emitting it; output stays a
+    (B, S+gen_len) rectangle; early-exit must not change the result."""
+    params = model.init(key)
+    ids = jnp.asarray([[1, 2, 3]], jnp.int32)
+    eng = Engine(model, batch=1, max_seq=64)
+    free = np.asarray(eng.serve(params, ids, 40))
+    # pick the first generated token as the stop token: generation must
+    # then be that token repeated for the whole gen window
+    stop_tok = int(free[0, 3])
+    eng2 = Engine(model, batch=1, max_seq=64)
+    out = np.asarray(eng2.serve(params, ids, 40, stop_tokens=(stop_tok,)))
+    assert out.shape == (1, 43)
+    np.testing.assert_array_equal(out[0, 3:], np.full(40, stop_tok))
+
+
+def test_engine_stop_token_rows_independent(model, key):
+    """One row stopping must not stop the other row's generation."""
+    params = model.init(key)
+    ids = jnp.asarray([[1, 2, 3], [7, 8, 9]], jnp.int32)
+    free = np.asarray(Engine(model, batch=2, max_seq=64)
+                      .serve(params, ids, 6))
+    stop_tok = int(free[0, 3])  # row 0's first token
+    if stop_tok in free[1, 3:]:
+        pytest.skip("stop token occurs in both rows for this seed")
+    out = np.asarray(Engine(model, batch=2, max_seq=64)
+                     .serve(params, ids, 6, stop_tokens=(stop_tok,)))
+    np.testing.assert_array_equal(out[0, 3:], np.full(6, stop_tok))
+    np.testing.assert_array_equal(out[1], free[1])
+
+
+def test_engine_eos_from_config(mesh8, key):
+    """With config.eos_token_id set, serve() stops on it by default."""
+    import dataclasses
+    cfg = dataclasses.replace(_cfg(), eos_token_id=5)
+    m = DenseLLM(cfg, mesh=mesh8, axis="tp", impl="xla")
+    params = m.init(key)
+    ids = jnp.asarray([[9, 8, 7]], jnp.int32)
+    free = np.asarray(Engine(m, batch=1, max_seq=64)
+                      .serve(params, ids, 12, stop_tokens=()))
+    out = np.asarray(Engine(m, batch=1, max_seq=64)
+                     .serve(params, ids, 12))
+    if 5 not in free[0, 3:]:
+        np.testing.assert_array_equal(out, free)
+    else:
+        first = 3 + int(np.argmax(free[0, 3:] == 5))
+        np.testing.assert_array_equal(out[0, :first + 1],
+                                      free[0, :first + 1])
+        np.testing.assert_array_equal(out[0, first:],
+                                      np.full(out.shape[1] - first, 5))
+
+
 def test_engine_decode_profile_hook(model, key, tmp_path):
     """The decode profile window (reference engine.py:153-179) traces the
     first N steps and leaves generation unchanged."""

@@ -11,6 +11,17 @@ import os
 import jax.numpy as jnp
 
 
+def _scalar_eos(v) -> int:
+    """HF configs store eos_token_id as an int or a list; keep the first
+    (generation stops on it; multi-eos callers pass stop_tokens to
+    ``Engine.serve``)."""
+    if v is None:  # "eos_token_id": null is valid HF JSON
+        return -1
+    if isinstance(v, (list, tuple)):
+        return int(v[0]) if v else -1
+    return int(v)
+
+
 @dataclasses.dataclass
 class ModelConfig:
     """Architecture hyperparameters for Qwen3-class decoders."""
@@ -37,6 +48,7 @@ class ModelConfig:
     # models (reference AutoLLM maps both to DenseLLM,
     # models/__init__.py:33-42) do not.
     qk_norm: bool = True
+    eos_token_id: int = -1  # -1 = no stop token
 
     @property
     def is_moe(self) -> bool:
@@ -75,4 +87,5 @@ class ModelConfig:
             norm_topk_prob=cfg.get("norm_topk_prob", True),
             model_type=cfg.get("model_type", "qwen3"),
             qk_norm=cfg.get("model_type", "qwen3").startswith("qwen3"),
+            eos_token_id=_scalar_eos(cfg.get("eos_token_id", -1)),
         )

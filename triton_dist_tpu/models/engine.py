@@ -75,13 +75,27 @@ class Engine:
             return nxt, caches
         return step
 
-    def serve(self, params, input_ids: jax.Array, gen_len: int) -> jax.Array:
-        """Prefill ``input_ids`` (B, S) then generate ``gen_len`` tokens.
-        Returns (B, S + gen_len) (reference ``Engine.serve``
-        engine.py:113-190)."""
+    def serve(self, params, input_ids: jax.Array, gen_len: int,
+              stop_tokens=None) -> jax.Array:
+        """Prefill ``input_ids`` (B, S) then generate up to ``gen_len``
+        tokens. Returns (B, S + gen_len) (reference ``Engine.serve``
+        engine.py:113-190).
+
+        ``stop_tokens``: iterable of token ids ending a row's generation
+        (default: the model config's ``eos_token_id`` if set). Rows that
+        have stopped keep emitting their stop token (the output stays a
+        rectangle — static shapes); the loop exits early once every row
+        has stopped.
+        """
         b, s = input_ids.shape
         if gen_len <= 0:
             return input_ids
+        if stop_tokens is None:
+            eos = getattr(self.model.config, "eos_token_id", -1)
+            stop_tokens = (eos,) if eos >= 0 else ()
+        stop_tokens = tuple(stop_tokens)
+        has_stop = bool(stop_tokens)
+        stop = jnp.asarray(list(stop_tokens) or [-1], jnp.int32)
         self.kv.reset()
         caches = self.kv.init()
 
@@ -93,16 +107,34 @@ class Engine:
 
         if self._decode_step is None:
             self._decode_step = self._build_decode_step()
+        # Stop bookkeeping only runs when stop tokens are in play — the
+        # plain decode loop stays one compiled program replayed per token
+        # with no extra host-dispatched ops or syncs.
+        done = jnp.isin(token, stop) if has_stop else None
+        stopped = has_stop and bool(done.all())  # prefill may already stop
         out = [input_ids, token[:, None]]
 
         def run_steps(n):
-            nonlocal token, caches
-            for _ in range(n):
+            nonlocal token, caches, done, stopped
+            for i in range(n):
+                if stopped:
+                    out.append(jnp.broadcast_to(
+                        token[:, None], (b, n - i)).astype(token.dtype))
+                    return
                 self.key, sub = jax.random.split(self.key)
-                token, caches = self._decode_step(
+                nxt, caches = self._decode_step(
                     params, caches, token, jnp.int32(self.kv.offset), sub)
+                if has_stop:
+                    # stopped rows keep emitting their stop token
+                    token = jnp.where(done, token, nxt)
+                    done = done | jnp.isin(token, stop)
+                else:
+                    token = nxt
                 self.kv.inc_offset(1)
                 out.append(token[:, None])
+                # the all-done check is a host sync; amortize it
+                if has_stop and i % 8 == 7 and bool(done.all()):
+                    stopped = True
 
         n_total = gen_len - 1
         if self.profile_dir and n_total > 1:

@@ -5,8 +5,10 @@ TPU-native analog of the reference's demo server
 socket server feeding the megakernel model, with chat.py as the client).
 Protocol: newline-delimited JSON over TCP —
 
-    → {"prompt_ids": [[...]], "gen_len": 16}
+    → {"prompt_ids": [[...]], "gen_len": 16, "stop_tokens": [151645]}
     ← {"tokens": [[...]], "latency_ms": 12.3}
+
+``stop_tokens`` is optional (default: the model config's eos).
 
 Text in/out (tokenizer round trip) is the client's job when a HF
 tokenizer is available; the server moves token ids only, like the
@@ -61,9 +63,11 @@ class ModelServer:
     def _serve_request(self, req: dict) -> dict:
         ids = np.asarray(req["prompt_ids"], np.int32)
         gen_len = max(0, min(int(req.get("gen_len", 16)), 4096))
+        stop = req.get("stop_tokens")  # None → engine default (eos)
         with self._lock:
             t0 = time.perf_counter()
-            out = self.engine.serve(self.params, jnp.asarray(ids), gen_len)
+            out = self.engine.serve(self.params, jnp.asarray(ids), gen_len,
+                                    stop_tokens=stop)
             out = np.asarray(out)
             ms = (time.perf_counter() - t0) * 1e3
         return {"tokens": out[:, ids.shape[1]:].tolist(),
