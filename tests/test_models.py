@@ -313,3 +313,60 @@ def test_kv_cache_incremental_decode_matches_full(dense, key):
     step_logits = jnp.concatenate(logits_steps, axis=1)
     np.testing.assert_allclose(np.asarray(step_logits),
                                np.asarray(full), rtol=2e-4, atol=2e-4)
+
+
+def test_paged_kv_cache_matches_contiguous(mesh8, key):
+    """PagedKVCacheManager writes + paged decode == contiguous-cache
+    decode, including slot reuse after free (vLLM-style paging over the
+    SP flash-decode kernel)."""
+    from triton_dist_tpu.models.kv_cache import PagedKVCacheManager
+    from triton_dist_tpu.ops.flash_decode import (
+        create_flash_decode_context, gqa_fwd_batch_decode,
+        gqa_fwd_batch_decode_paged)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    w, b, hq, hkv, d, page, npg = 8, 2, 8, 4, 16, 4, 2
+    mgr = PagedKVCacheManager(1, b, page, npg, hkv, d, mesh=mesh8,
+                              axis="tp", dtype=jnp.float32,
+                              slots_per_dev=3 * npg)
+    # churn the allocator so tables are non-trivial: alloc, free, realloc
+    mgr.alloc_seq(0)
+    mgr.alloc_seq(1)
+    mgr.free_seq(0)
+    mgr.alloc_seq(0)
+    t = mgr.max_seq
+    ks = jax.random.normal(key, (b, t, hkv, d), jnp.float32)
+    vs = jax.random.normal(jax.random.fold_in(key, 1), (b, t, hkv, d),
+                           jnp.float32)
+    pools = mgr.init()
+    table = mgr.block_table()
+    write = jax.jit(lambda p, k_, v_, pos, tb: mgr.write(
+        p, 0, k_, v_, pos, tb))
+    for pos in range(t):
+        pools = write(pools, ks[:, pos], vs[:, pos], jnp.int32(pos), table)
+        mgr.inc_offset(1)
+
+    q = jax.random.normal(jax.random.fold_in(key, 2), (b, hq, d),
+                          jnp.float32)
+    ctx = create_flash_decode_context(mesh8, "tp")
+    kv_len = jnp.int32(t - 3)
+    got = gqa_fwd_batch_decode_paged(q, pools[0][0], pools[0][1],
+                                     mgr.block_table(), kv_len, ctx)
+    sh = NamedSharding(mesh8, P(None, "tp"))
+    ref = gqa_fwd_batch_decode(
+        q, jax.device_put(ks, sh), jax.device_put(vs, sh), kv_len, ctx,
+        impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_paged_kv_pool_exhaustion(mesh8):
+    from triton_dist_tpu.models.kv_cache import PagedKVCacheManager
+    mgr = PagedKVCacheManager(1, 3, 4, 2, 2, 8, mesh=mesh8, axis="tp",
+                              slots_per_dev=4)  # room for 2 seqs only
+    mgr.alloc_seq(0)
+    mgr.alloc_seq(1)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        mgr.alloc_seq(2)
+    mgr.free_seq(1)
+    mgr.alloc_seq(2)  # freed slots are reusable

@@ -61,6 +61,7 @@ class Engine:
         self.profile_dir = profile_dir
         self.profile_steps = profile_steps
         self._decode_step = None
+        self._decode_step_stop = None
 
     # -- decode step (jit once = graph capture, engine.py:75-105) ----------
     def _build_decode_step(self):
@@ -73,6 +74,22 @@ class Engine:
             nxt = sample_token(logits[:, -1], key, self.temperature,
                                self.top_k)
             return nxt, caches
+        return step
+
+    def _build_decode_step_stop(self):
+        """Decode step with in-graph stop bookkeeping: still ONE compiled
+        program per token (jit caches per stop-set shape); stopped rows
+        keep emitting their stop token."""
+        model, mode = self.model, self.decode_mode
+
+        @jax.jit
+        def step(params, caches, token, offset, key, done, stop):
+            logits, caches = model.forward(params, token[:, None], caches,
+                                           offset, mode=mode)
+            nxt = sample_token(logits[:, -1], key, self.temperature,
+                               self.top_k)
+            nxt = jnp.where(done, token, nxt)
+            return nxt, caches, done | jnp.isin(nxt, stop)
         return step
 
     def serve(self, params, input_ids: jax.Array, gen_len: int,
@@ -107,9 +124,10 @@ class Engine:
 
         if self._decode_step is None:
             self._decode_step = self._build_decode_step()
-        # Stop bookkeeping only runs when stop tokens are in play — the
-        # plain decode loop stays one compiled program replayed per token
-        # with no extra host-dispatched ops or syncs.
+        if has_stop and self._decode_step_stop is None:
+            self._decode_step_stop = self._build_decode_step_stop()
+        # With stop tokens the bookkeeping lives INSIDE the jitted step —
+        # still one dispatch per token; without, the plain step runs.
         done = jnp.isin(token, stop) if has_stop else None
         stopped = has_stop and bool(done.all())  # prefill may already stop
         out = [input_ids, token[:, None]]
@@ -122,14 +140,13 @@ class Engine:
                         token[:, None], (b, n - i)).astype(token.dtype))
                     return
                 self.key, sub = jax.random.split(self.key)
-                nxt, caches = self._decode_step(
-                    params, caches, token, jnp.int32(self.kv.offset), sub)
+                off = jnp.int32(self.kv.offset)
                 if has_stop:
-                    # stopped rows keep emitting their stop token
-                    token = jnp.where(done, token, nxt)
-                    done = done | jnp.isin(token, stop)
+                    token, caches, done = self._decode_step_stop(
+                        params, caches, token, off, sub, done, stop)
                 else:
-                    token = nxt
+                    token, caches = self._decode_step(
+                        params, caches, token, off, sub)
                 self.kv.inc_offset(1)
                 out.append(token[:, None])
                 # the all-done check is a host sync; amortize it

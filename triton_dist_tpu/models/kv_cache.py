@@ -54,3 +54,127 @@ class KVCacheManager:
 
     def reset(self):
         self.offset = 0
+
+
+class PagedKVCacheManager:
+    """Paged KV pools + block tables for SP decode serving.
+
+    Integrates ``ops.flash_decode.gqa_fwd_batch_decode_paged`` (reference
+    paged split-KV kernels, flash_decode.py:130-393) with a host-side
+    slot allocator: each SP device owns a pool of ``slots_per_dev``
+    physical (page_size, Hkv, D) pages and backs global positions
+    [r*t_loc, (r+1)*t_loc) of every sequence. Sequences allocate their
+    logical pages from per-device free lists (``alloc_seq``/``free_seq``
+    — vLLM-style paging; the reference manages tables statically in its
+    megakernel attn task).
+
+    Layout contract (matches gqa_fwd_batch_decode_paged):
+      pool_k/pool_v: (w*slots_per_dev, page_size, Hkv, D), dim 0 sharded.
+      block_table:   (w, B, pages_per_seq_dev) int32, dim 0 sharded,
+                     entries are device-LOCAL slot ids.
+    """
+
+    def __init__(self, num_layers: int, batch: int, page_size: int,
+                 pages_per_seq_dev: int, num_kv_heads: int, head_dim: int,
+                 mesh: Mesh | None = None, axis: str = "tp",
+                 dtype=jnp.bfloat16, slots_per_dev: int | None = None):
+        if mesh is None:
+            from triton_dist_tpu.runtime.dist import get_mesh
+            mesh = get_mesh()
+        self.mesh, self.axis = mesh, axis
+        self.world = mesh.shape[axis]
+        self.num_layers = num_layers
+        self.batch = batch
+        self.page_size = page_size
+        self.pages_per_seq_dev = pages_per_seq_dev
+        self.t_loc = page_size * pages_per_seq_dev
+        self.max_seq = self.t_loc * self.world
+        self.num_kv_heads, self.head_dim = num_kv_heads, head_dim
+        self.dtype = dtype
+        self.slots_per_dev = (slots_per_dev if slots_per_dev is not None
+                              else batch * pages_per_seq_dev)
+        assert self.slots_per_dev >= pages_per_seq_dev, "pool too small"
+        self.offset = 0
+        # Host-side allocator state: per-device free lists + per-seq maps.
+        import numpy as np
+        self._free = [list(range(self.slots_per_dev))
+                      for _ in range(self.world)]
+        self._table = np.zeros((self.world, batch, pages_per_seq_dev),
+                               np.int32)
+        self._owned: dict[int, list] = {}
+        self._table_dev = None  # device copy, invalidated on alloc/free
+
+    # -- allocation (vLLM-style; host-side) --------------------------------
+    def alloc_seq(self, b: int) -> None:
+        """Reserve every logical page of row ``b`` on every device.
+        (Lazy page-at-a-time allocation would also fit this table; the
+        decode kernel only reads slots below kv_len.)"""
+        assert b not in self._owned
+        pages = []
+        for r in range(self.world):
+            if len(self._free[r]) < self.pages_per_seq_dev:
+                raise RuntimeError(f"device {r} pool exhausted")
+            for i in range(self.pages_per_seq_dev):
+                slot = self._free[r].pop()
+                self._table[r, b, i] = slot
+                pages.append((r, slot))
+        self._owned[b] = pages
+        self._table_dev = None
+
+    def free_seq(self, b: int) -> None:
+        for r, slot in self._owned.pop(b):
+            self._free[r].append(slot)
+        self._table_dev = None
+
+    def block_table(self) -> jax.Array:
+        """Device copy of the (w, B, n_pages) table — pass this into
+        jitted reads AND writes so table changes retrace instead of being
+        baked in as constants (cached until the next alloc/free)."""
+        if self._table_dev is None:
+            self._table_dev = jax.device_put(
+                jnp.asarray(self._table),
+                NamedSharding(self.mesh, P(self.axis)))
+        return self._table_dev
+
+    # -- device state -------------------------------------------------------
+    def init(self):
+        """[(pool_k, pool_v)] * L, all slots zeroed."""
+        shape = (self.world * self.slots_per_dev, self.page_size,
+                 self.num_kv_heads, self.head_dim)
+        sh = NamedSharding(self.mesh, P(self.axis))
+        z = jax.device_put(jnp.zeros(shape, self.dtype), sh)
+        # arrays are immutable — one zero transfer shared by all refs
+        return [(z, z) for _ in range(self.num_layers)]
+
+    def write(self, pools, layer: int, new_k: jax.Array, new_v: jax.Array,
+              offset, table: jax.Array) -> list:
+        """Scatter one decode step's (B, Hkv, D) K/V into the pools at
+        global position ``offset`` (jit-compatible: pure gather/scatter
+        on traced values).
+
+        ``table``: pass :meth:`block_table`'s result through the jit
+        boundary — closing over the host table would bake slot ids in as
+        compile-time constants and go stale after ``free_seq``/
+        ``alloc_seq`` (silent cross-sequence corruption).
+        """
+        pool_k, pool_v = pools[layer]
+        offset = jnp.asarray(offset, jnp.int32)
+        r = offset // self.t_loc
+        local = offset % self.t_loc
+        lp = local // self.page_size
+        inpage = local % self.page_size
+        slots = table[r, :, lp]                      # (B,) local slots
+        gslots = r * self.slots_per_dev + slots      # global pool rows
+        pool_k = pool_k.at[gslots, inpage].set(new_k.astype(pool_k.dtype))
+        pool_v = pool_v.at[gslots, inpage].set(new_v.astype(pool_v.dtype))
+        out = list(pools)
+        out[layer] = (pool_k, pool_v)
+        return out
+
+    def inc_offset(self, n: int) -> int:
+        self.offset += n
+        assert self.offset <= self.max_seq, "paged KV overflow"
+        return self.offset
+
+    def reset(self):
+        self.offset = 0
