@@ -296,8 +296,26 @@ def _bench_flash_decode(mesh, n, on_tpu, extras):
 
     t_pallas = perf_func_chained(make_step("pallas"), q0, (8, 24))
     t_xla = perf_func_chained(make_step("xla"), q0, (8, 24))
-    extras["flash_decode_pallas_ms"] = round(t_pallas, 4)
-    extras["flash_decode_xla_ms"] = round(t_xla, 4)
+    if on_tpu:
+        # t_blk sweep (failure-isolated like the GEMM sweeps): the split
+        # size trades VMEM residency against combine overhead.
+        best = (t_pallas, 512)
+        for t_blk in (256, 1024, 2048):
+            try:
+                ctx2 = create_flash_decode_context(
+                    mesh, "tp", interpret=False, variant="tiled",
+                    t_blk=t_blk)
+                ms = perf_func_chained(
+                    jax.jit(lambda q, c=ctx2: (gqa_fwd_batch_decode(
+                        q, kc, vc, kv_len, c, impl="pallas"
+                    ).astype(jnp.float32) * 0.5 + 0.5
+                    ).astype(jnp.bfloat16)), q0, (8, 24))
+                if ms < best[0]:
+                    best = (ms, t_blk)
+            except Exception as e:  # noqa: BLE001 — per-config isolation
+                extras[f"flash_decode_tblk{t_blk}_error"] = _err(e)
+        extras["flash_decode_best_tblk"] = best[1]
+        t_pallas = min(t_pallas, best[0])
     extras["flash_decode_vs_xla"] = round(t_xla / t_pallas, 4)
     return t_pallas, t_xla / t_pallas
 
