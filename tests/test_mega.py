@@ -15,6 +15,17 @@ from triton_dist_tpu.models.kv_cache import KVCacheManager
 
 # -- native scheduler --------------------------------------------------------
 
+def _random_dag(rng, n):
+    """Shared randomized-DAG builder for the native/python parity tests
+    (diamonds, chains, fan-in/out)."""
+    edges = []
+    for dst in range(1, n):
+        for src in rng.choice(dst, size=min(dst, 3), replace=False):
+            if rng.rand() < 0.6:
+                edges.append((int(src), dst))
+    return np.asarray(edges or [(0, 1)], np.int32)
+
+
 def test_native_lib_builds():
     assert native.have_native(), "C++ scheduler failed to build"
 
@@ -154,12 +165,7 @@ def test_native_python_parity_random_dags():
     rng = np.random.RandomState(0)
     for trial in range(10):
         n = int(rng.randint(3, 40))
-        edges = []
-        for dst in range(1, n):
-            for src in rng.choice(dst, size=min(dst, 3), replace=False):
-                if rng.rand() < 0.6:
-                    edges.append((int(src), dst))
-        edges = np.asarray(edges or [(0, 1)], np.int32)
+        edges = _random_dag(rng, n)
         np.testing.assert_array_equal(toposort(n, edges),
                                       _toposort_py(n, edges),
                                       err_msg=f"trial {trial}")
@@ -183,3 +189,57 @@ def test_least_loaded_schedule_balances():
     loads_rr = [int(costs[q_rr == i].sum()) for i in range(2)]
     assert max(loads) <= max(loads_rr)
     assert max(loads) - min(loads) <= 2  # near-perfect balance here
+
+
+def test_critical_path_schedule():
+    """HEFT critical-path scheduling: makespan invariants + native/python
+    parity on random DAGs."""
+    from triton_dist_tpu.mega.native import (
+        _schedule_critical_path_py, have_native, schedule_critical_path)
+    # chain: makespan = sum of costs regardless of queues
+    chain_edges = [(i, i + 1) for i in range(4)]
+    costs = [2, 3, 1, 4, 5]
+    _, span = schedule_critical_path(5, chain_edges, 4, costs=costs)
+    assert span == sum(costs)
+    # independent tasks: perfect balance
+    assign, span = schedule_critical_path(8, np.empty((0, 2), np.int32),
+                                          4, costs=[3] * 8)
+    assert span == 6 and len(set(assign.tolist())) == 4
+    # dependency-aware beats (or ties) cost-only least_loaded makespan
+    # on a fan-out/fan-in diamond with a heavy critical path
+    edges = [(0, 1), (0, 2), (0, 3), (1, 4), (2, 4), (3, 4)]
+    c = [1, 10, 1, 1, 1]
+    _, span_d = schedule_critical_path(5, edges, 2, costs=c)
+    assert span_d == 12  # 0 → 1(heavy) → 4, others overlap
+    # zero-cost tasks: rank ties must not schedule a child before its
+    # zero-cost parent (tie-break is topo position, not raw id): the free
+    # parent finishes at t=0 and both children overlap → span 4
+    _, s0 = schedule_critical_path(3, [(2, 0), (2, 1)], 2,
+                                   costs=[4, 4, 0])
+    assert s0 == 4
+    with pytest.raises(ValueError, match=">= 0"):
+        schedule_critical_path(2, [(0, 1)], 1, costs=[-1, 1])
+    if have_native():
+        rng = np.random.RandomState(7)
+        for trial in range(10):
+            n = int(rng.randint(3, 40))
+            edges = _random_dag(rng, n)
+            cst = rng.randint(1, 20, size=n).astype(np.int64)
+            a_n, s_n = schedule_critical_path(n, edges, 3, costs=cst)
+            a_p, s_p = _schedule_critical_path_py(n, edges, 3, costs=cst)
+            assert s_n == s_p, trial
+            np.testing.assert_array_equal(a_n, a_p,
+                                          err_msg=f"trial {trial}")
+
+
+def test_task_graph_critical_path_policy():
+    """TaskGraph exposes the dependency-aware policy + makespan model."""
+    from triton_dist_tpu.mega.task_graph import TaskGraph
+    g = TaskGraph()
+    g.add("a", lambda x: x, ["in"], ["t0"], cost=4)
+    g.add("b", lambda x: x, ["t0"], ["t1"], cost=2)
+    g.add("c", lambda x: x, ["in"], ["t2"], cost=3)
+    assign = g.queue_assignment(2, policy="critical_path")
+    assert assign.shape == (3,)
+    # chain a→b (6) dominates; c overlaps on the other queue
+    assert g.makespan(2) == 6

@@ -12,6 +12,7 @@
 // Build: gcc -shared -fPIC -O2 -o libtdtsched.so scheduler.cc
 
 #include <cstdint>
+#include <algorithm>
 #include <cstring>
 #include <queue>
 #include <vector>
@@ -46,6 +47,94 @@ void tdt_schedule_least_loaded(int32_t n_tasks, int32_t n_queues,
     out[i] = best;
     load[best] += costs ? costs[i] : 1;
   }
+}
+
+// HEFT-style critical-path list scheduling: tasks are prioritized by
+// upward rank (longest cost-weighted path to a sink) and placed on the
+// queue giving the earliest dependency-respecting start time. Returns the
+// resulting makespan (or -1 on a cycle); out[i] = queue of task i. The
+// makespan doubles as a speed-of-light estimate for the fused step given
+// n_queues-way parallel hardware.
+int64_t tdt_schedule_critical_path(int32_t n_tasks, int32_t n_edges,
+                                   const int32_t* edges, int32_t n_queues,
+                                   const int64_t* costs, int32_t* out) {
+  std::vector<std::vector<int32_t>> children(n_tasks), parents(n_tasks);
+  std::vector<int32_t> outdeg(n_tasks, 0);
+  for (int32_t e = 0; e < n_edges; ++e) {
+    int32_t src = edges[2 * e], dst = edges[2 * e + 1];
+    children[src].push_back(dst);
+    parents[dst].push_back(src);
+    outdeg[src]++;
+  }
+  auto cost = [&](int32_t i) -> int64_t { return costs ? costs[i] : 1; };
+  // upward ranks via reverse topological order (Kahn on the transpose)
+  std::vector<int64_t> rank(n_tasks, 0);
+  std::vector<int32_t> od = outdeg;
+  std::queue<int32_t> q;
+  int32_t seen = 0;
+  for (int32_t i = 0; i < n_tasks; ++i)
+    if (od[i] == 0) q.push(i);
+  while (!q.empty()) {
+    int32_t t = q.front();
+    q.pop();
+    seen++;
+    int64_t best = 0;
+    for (int32_t c : children[t])
+      if (rank[c] > best) best = rank[c];
+    rank[t] = cost(t) + best;
+    for (int32_t p : parents[t])
+      if (--od[p] == 0) q.push(p);
+  }
+  if (seen != n_tasks) return -1;
+  // priority order: descending rank, ties broken by topological
+  // position — raw-id ties could schedule a zero-cost parent's child
+  // first (rank equality), violating dependencies.
+  std::vector<int32_t> topo(n_tasks), pos(n_tasks);
+  {
+    std::vector<int32_t> indeg(n_tasks, 0);
+    for (int32_t i = 0; i < n_tasks; ++i)
+      for (int32_t c2 : children[i]) indeg[c2]++;
+    std::priority_queue<int32_t, std::vector<int32_t>,
+                        std::greater<int32_t>> rq;
+    for (int32_t i = 0; i < n_tasks; ++i)
+      if (indeg[i] == 0) rq.push(i);
+    int32_t n2 = 0;
+    while (!rq.empty()) {
+      int32_t t = rq.top();
+      rq.pop();
+      topo[n2] = t;
+      pos[t] = n2++;
+      for (int32_t c2 : children[t])
+        if (--indeg[c2] == 0) rq.push(c2);
+    }
+  }
+  std::vector<int32_t> order(n_tasks);
+  for (int32_t i = 0; i < n_tasks; ++i) order[i] = i;
+  std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+    if (rank[a] != rank[b]) return rank[a] > rank[b];
+    return pos[a] < pos[b];
+  });
+  std::vector<int64_t> queue_free(n_queues, 0), finish(n_tasks, 0);
+  int64_t makespan = 0;
+  for (int32_t t : order) {
+    int64_t ready = 0;
+    for (int32_t p : parents[t])
+      if (finish[p] > ready) ready = finish[p];
+    int32_t best_q = 0;
+    int64_t best_start = -1;
+    for (int32_t qi = 0; qi < n_queues; ++qi) {
+      int64_t start = queue_free[qi] > ready ? queue_free[qi] : ready;
+      if (best_start < 0 || start < best_start) {
+        best_start = start;
+        best_q = qi;
+      }
+    }
+    out[t] = best_q;
+    finish[t] = best_start + cost(t);
+    queue_free[best_q] = finish[t];
+    if (finish[t] > makespan) makespan = finish[t];
+  }
+  return makespan;
 }
 
 // Kahn topological sort with stable tie-break by task id (the dependency

@@ -37,8 +37,11 @@ def _load():
         lib = ctypes.CDLL(so)
         lib.tdt_toposort.restype = ctypes.c_int32
         lib.tdt_wavefronts.restype = ctypes.c_int32
+        lib.tdt_schedule_critical_path.restype = ctypes.c_int64
         _LIB = lib
-    except (OSError, subprocess.CalledProcessError):
+    except (OSError, subprocess.CalledProcessError, AttributeError):
+        # AttributeError: a stale prebuilt .so missing a newer symbol —
+        # fall back to Python rather than crash on first native call.
         _LIB = None
     return _LIB
 
@@ -92,6 +95,74 @@ def _schedule_py(n_tasks, n_queues, policy, costs=None) -> np.ndarray:
     else:
         raise ValueError(policy)
     return out
+
+
+def schedule_critical_path(n_tasks: int, edges, n_queues: int,
+                           costs=None) -> tuple[np.ndarray, int]:
+    """HEFT-style dependency-aware list scheduling: tasks prioritized by
+    upward rank (longest cost-weighted path to a sink), each placed on
+    the queue with the earliest dependency-respecting start.
+
+    Returns (queue_of_task, makespan). The makespan is a
+    speed-of-light estimate of the fused step on ``n_queues``-way
+    hardware — usable as a perf model for the mega graph. Raises on
+    cycles. Native C++ with a bit-identical Python fallback.
+
+    Costs must be >= 0 (zero is fine for free ops like reshapes; rank
+    ties are broken in topological order so dependencies hold).
+    """
+    if costs is not None and int(np.min(np.asarray(costs))) < 0:
+        raise ValueError("costs must be >= 0")
+    edges = _i32(np.asarray(edges).reshape(-1, 2))
+    lib = _load()
+    if lib is not None:
+        out = np.empty(n_tasks, np.int32)
+        c = (np.ascontiguousarray(costs, np.int64)
+             .ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+             if costs is not None else None)
+        span = lib.tdt_schedule_critical_path(
+            n_tasks, len(edges),
+            edges.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n_queues, c,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if span < 0:
+            raise ValueError("task graph has a cycle")
+        return out, int(span)
+    return _schedule_critical_path_py(n_tasks, edges, n_queues, costs)
+
+
+def _schedule_critical_path_py(n_tasks, edges, n_queues,
+                               costs=None) -> tuple[np.ndarray, int]:
+    c = (np.asarray(costs, np.int64) if costs is not None
+         else np.ones(n_tasks, np.int64))
+    children = [[] for _ in range(n_tasks)]
+    parents = [[] for _ in range(n_tasks)]
+    for s, d in edges:
+        children[s].append(int(d))
+        parents[d].append(int(s))
+    # upward ranks in reverse topological order
+    order = _toposort_py(n_tasks, edges)
+    pos = np.empty(n_tasks, np.int64)
+    pos[order] = np.arange(n_tasks)
+    rank = np.zeros(n_tasks, np.int64)
+    for t in reversed(order):
+        best = max((rank[ch] for ch in children[t]), default=0)
+        rank[t] = c[t] + best
+    # ties broken by topo position (zero-cost parents must precede)
+    prio = sorted(range(n_tasks), key=lambda i: (-rank[i], pos[i]))
+    queue_free = np.zeros(n_queues, np.int64)
+    finish = np.zeros(n_tasks, np.int64)
+    out = np.empty(n_tasks, np.int32)
+    makespan = 0
+    for t in prio:
+        ready = max((finish[p] for p in parents[t]), default=0)
+        starts = np.maximum(queue_free, ready)
+        q = int(np.argmin(starts))
+        out[t] = q
+        finish[t] = starts[q] + c[t]
+        queue_free[q] = finish[t]
+        makespan = max(makespan, int(finish[t]))
+    return out, makespan
 
 
 def toposort(n_tasks: int, edges) -> np.ndarray:

@@ -81,10 +81,27 @@ class TaskGraph:
         """Static queue assignment in execution order (reference
         ``enque_tasks`` core/scheduler.py:86). On TPU this is
         observability/parity metadata — execution order is the fused
-        program's schedule."""
+        program's schedule. ``policy="critical_path"`` is
+        dependency-aware (HEFT list scheduling over this graph's edges;
+        see :meth:`makespan`)."""
+        if policy == "critical_path":
+            return self.critical_path_schedule(n_queues)[0]
         costs = [t.meta.get("cost", 1) for t in self.tasks]
         return native.schedule(len(self.tasks), n_queues, policy,
                                costs=costs)
+
+    def critical_path_schedule(self, n_queues: int):
+        """(queue_of_task, makespan) from one HEFT run — use this when
+        both are wanted (each wrapper below re-runs the scheduler)."""
+        costs = [t.meta.get("cost", 1) for t in self.tasks]
+        return native.schedule_critical_path(
+            len(self.tasks), self.edges(), n_queues, costs=costs)
+
+    def makespan(self, n_queues: int) -> int:
+        """Critical-path makespan on ``n_queues``-way hardware — a
+        speed-of-light perf model of this graph (cost units = task
+        ``meta["cost"]``)."""
+        return self.critical_path_schedule(n_queues)[1]
 
     # -- execution ---------------------------------------------------------
     def make_executor(self, input_names: Sequence[str],
