@@ -1,0 +1,42 @@
+"""Checkpoint save/restore for model params (+ optional engine state).
+
+The reference has NO checkpointing (SURVEY.md §5 "Checkpoint/resume:
+none — models load HF safetensors at init; no saving"). On TPU this is
+table stakes for long-running serving/finetune jobs, and the ecosystem
+tool is Orbax: sharded params save/restore with the layout preserved, so
+a restore onto the same mesh needs no resharding.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def save_params(path: str, params) -> str:
+    """Write a params pytree (sharded jax.Arrays included) to ``path``.
+    Overwrites an existing checkpoint at the same path."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, params, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def load_params(path: str, like=None):
+    """Restore a params pytree. ``like`` (same-structure pytree of arrays
+    or ShapeDtypeStructs with shardings) restores directly onto its
+    shardings; without it, arrays arrive host-local and callers reshard
+    via ``model.shard_params``."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    if like is None:
+        return ckptr.restore(path)
+    target = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
+        if isinstance(a, jax.Array) else a, like)
+    return ckptr.restore(path, target)
