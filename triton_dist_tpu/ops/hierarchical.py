@@ -96,6 +96,40 @@ def all_reduce_2d(x: jax.Array, ctx: HierCollectiveContext) -> jax.Array:
 # links. ``axes`` is ordered fastest → slowest.
 
 
+def all_to_all_2d(x: jax.Array, ctx: HierCollectiveContext) -> jax.Array:
+    """Two-level all-to-all for EP dispatch across ICI + DCN (the
+    reference's inter-node EP domain — DeepEP-style: tutorial 04 /
+    low_latency_all_to_all.py run flat; multinode batching is the win).
+
+    ``x``: (w*rows, F) per device — w destination chunks in global rank
+    order (rank g = outer*w_inner + inner, the mesh's row-major order).
+    Equivalent permutation to a flat ``lax.all_to_all`` over both axes
+    (tests assert bit-equality), but the slow (DCN) hop moves ONE large
+    (w_inner*rows) block per outer peer instead of w_inner separate
+    chunks — fewer, larger inter-node messages, then the fine-grained
+    chunk exchange rides ICI.
+    """
+    w_in, w_out = ctx.inner_size, ctx.outer_size
+    spec = P((ctx.outer, ctx.inner))
+
+    def body(xs):
+        rows = xs.shape[0] // (w_in * w_out)
+        y = lax.all_to_all(xs, ctx.outer, split_axis=0, concat_axis=0,
+                           tiled=True)
+        t = y.reshape(w_out, w_in, rows, *xs.shape[1:])
+        z = t.transpose(1, 0, 2, *range(3, t.ndim)).reshape(
+            w_in * w_out * rows, *xs.shape[1:])
+        z = lax.all_to_all(z, ctx.inner, split_axis=0, concat_axis=0,
+                           tiled=True)
+        u = z.reshape(w_in, w_out, rows, *xs.shape[1:]).transpose(
+            1, 0, 2, *range(3, t.ndim))
+        return u.reshape(w_out * w_in * rows, *xs.shape[1:])
+
+    f = nestable_shard_map(body, mesh=ctx.mesh, in_specs=spec,
+                           out_specs=spec, check_vma=False)
+    return f(x)
+
+
 def all_gather_nd(x: jax.Array, mesh: Mesh,
                   axes: tuple[str, ...]) -> jax.Array:
     """Gather dim-0 shards across every axis in ``axes`` (fastest first):
