@@ -109,10 +109,11 @@ def test_attention_core_gqa_grouping():
     k = jnp.asarray(rng.randn(1, 2, 2, D), jnp.float32)
     v = jnp.asarray(rng.randn(1, 2, 2, D), jnp.float32)
     ck = jnp.zeros((1, 4, 2, D), jnp.float32)
-    out, _, _ = _attention_core(q, k, v, ck, ck, jnp.int32(0), groups=2)
+    z = jnp.zeros((1,), jnp.int32)
+    out, _, _ = _attention_core(q, k, v, ck, ck, jnp.int32(0), z, groups=2)
     # head 0,1 share kv head 0; heads 2,3 share kv head 1.
     out2, _, _ = _attention_core(
         q[:, :, [2, 3, 0, 1]], k[:, :, [1, 0]], v[:, :, [1, 0]],
-        ck, ck, jnp.int32(0), groups=2)
+        ck, ck, jnp.int32(0), z, groups=2)
     np.testing.assert_allclose(np.asarray(out)[:, :, [2, 3, 0, 1]],
                                np.asarray(out2), rtol=1e-5, atol=1e-5)

@@ -93,7 +93,8 @@ class TPAttn:
     def __call__(self, params: dict, x: jax.Array, position_ids: jax.Array,
                  rope_cache: tuple[jax.Array, jax.Array],
                  kv_cache: tuple[jax.Array, jax.Array],
-                 offset: jax.Array, mode: str | None = None):
+                 offset: jax.Array, mode: str | None = None,
+                 kv_start: jax.Array | None = None):
         """One attention block.
 
         Args:
@@ -133,7 +134,8 @@ class TPAttn:
         q = apply_rope(q, cos, sin, position_ids)
         k = apply_rope(k, cos, sin, position_ids)
 
-        attn, new_cache = self._attention(q, k, v, kv_cache, offset)
+        attn, new_cache = self._attention(q, k, v, kv_cache, offset,
+                                          kv_start)
         attn = attn.reshape(b * s, self.num_heads * d)
 
         if sharded:
@@ -142,7 +144,7 @@ class TPAttn:
             out = gemm_ar(attn, params["w_o"], self.rs_ctx, impl=impl)
         return out, new_cache
 
-    def _attention(self, q, k, v, kv_cache, offset):
+    def _attention(self, q, k, v, kv_cache, offset, kv_start=None):
         """Cached GQA attention, shard_mapped over the head axis.
 
         Equivalent role to the reference's flash-attn call on local heads
@@ -152,21 +154,28 @@ class TPAttn:
         groups = self.num_heads // self.num_kv_heads
         core = functools.partial(_attention_core, groups=groups)
         spec = P(None, None, axis, None)
+        if kv_start is None:
+            kv_start = jnp.zeros((q.shape[0],), jnp.int32)
         f = nestable_shard_map(
             core, mesh=self.mesh,
-            in_specs=(spec, spec, spec, spec, spec, P()),
+            in_specs=(spec, spec, spec, spec, spec, P(), P()),
             out_specs=(spec, spec, spec), check_vma=False)
         out, ck, cv = f(q, k, v, kv_cache[0], kv_cache[1],
-                        jnp.asarray(offset, jnp.int32))
+                        jnp.asarray(offset, jnp.int32),
+                        jnp.asarray(kv_start, jnp.int32))
         return out, (ck, cv)
 
 
-def _attention_core(q, k, v, cache_k, cache_v, offset, *, groups: int):
+def _attention_core(q, k, v, cache_k, cache_v, offset, kv_start, *,
+                    groups: int):
     """Single-device cached causal GQA (fp32 softmax).
 
     q: (B, S, hq, D); k/v: (B, S, hkv, D); cache: (B, T, hkv, D).
     Query i sits at absolute position offset+i and attends to cache
-    positions j <= offset+i."""
+    positions kv_start[b] <= j <= offset+i — ``kv_start`` is the
+    left-padding boundary for ragged batches (all-zeros = the plain
+    causal mask). Fully-masked (pad) query rows get finite garbage (not
+    NaN); their logits are never consumed."""
     b, s, hq, d = q.shape
     t = cache_k.shape[1]
     hkv = cache_k.shape[2]
@@ -177,8 +186,10 @@ def _attention_core(q, k, v, cache_k, cache_v, offset, *, groups: int):
     kf = cache_k.astype(jnp.float32)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg, kf) * (d ** -0.5)
     q_pos = offset + jnp.arange(s)[:, None]
-    mask = jnp.arange(t)[None, :] <= q_pos  # (S, T)
-    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    causal = jnp.arange(t)[None, :] <= q_pos  # (S, T)
+    live = jnp.arange(t)[None, :] >= kv_start[:, None]  # (B, T)
+    mask = causal[None] & live[:, None]  # (B, S, T)
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgst,btkd->bskgd", probs,
                      cache_v.astype(jnp.float32))

@@ -105,13 +105,17 @@ class DenseLLM:
 
     # -- forward -----------------------------------------------------------
     def forward(self, params: dict, input_ids: jax.Array, kv_caches,
-                offset, mode: str | None = None):
+                offset, mode: str | None = None, kv_start=None):
         """input_ids: (B, S) int32; kv_caches: [(k, v)] * L; offset: scalar
         write position. Returns (logits (B, S, V), new_caches).
 
         The reference's ``inference`` (dense.py:200-241). Activation
         layout: row-sharded (M=B*S over tp) for {xla, ag_rs} — requires
         B*S % world == 0; replicated for {xla_ar, gemm_ar} (decode).
+
+        ``kv_start``: optional (B,) left-pad boundaries for ragged
+        batches — rope positions count from each row's first real token
+        and attention never sees the pad prefix (Engine.serve_ragged).
         """
         c = self.config
         mode = mode or self.fwd_mode
@@ -119,13 +123,17 @@ class DenseLLM:
         offset = jnp.asarray(offset, jnp.int32)
         position_ids = offset + jnp.tile(
             jnp.arange(s, dtype=jnp.int32)[None], (b, 1))
+        if kv_start is not None:
+            position_ids = jnp.maximum(
+                position_ids - jnp.asarray(kv_start, jnp.int32)[:, None], 0)
 
         x = params["embed"][input_ids].reshape(b * s, c.hidden_size)
         new_caches = []
         for lp, cache in zip(params["layers"], kv_caches):
             h = rms_norm(x, lp["ln_attn"], c.rms_norm_eps)
             a, cache = self.attn(lp["attn"], h, position_ids,
-                                 self.rope_cache, cache, offset, mode=mode)
+                                 self.rope_cache, cache, offset, mode=mode,
+                                 kv_start=kv_start)
             x = x + a
             h = rms_norm(x, lp["ln_mlp"], c.rms_norm_eps)
             x = x + self.mlp(lp["mlp"], h, mode=mode)

@@ -19,6 +19,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from triton_dist_tpu.models.kv_cache import KVCacheManager
 
@@ -68,9 +69,10 @@ class Engine:
         model, mode = self.model, self.decode_mode
 
         @jax.jit
-        def step(params, caches, token, offset, key):
+        def step(params, caches, token, offset, key, kv_start):
             logits, caches = model.forward(params, token[:, None], caches,
-                                           offset, mode=mode)
+                                           offset, mode=mode,
+                                           kv_start=kv_start)
             nxt = sample_token(logits[:, -1], key, self.temperature,
                                self.top_k)
             return nxt, caches
@@ -83,9 +85,10 @@ class Engine:
         model, mode = self.model, self.decode_mode
 
         @jax.jit
-        def step(params, caches, token, offset, key, done, stop):
+        def step(params, caches, token, offset, key, done, stop, kv_start):
             logits, caches = model.forward(params, token[:, None], caches,
-                                           offset, mode=mode)
+                                           offset, mode=mode,
+                                           kv_start=kv_start)
             nxt = sample_token(logits[:, -1], key, self.temperature,
                                self.top_k)
             nxt = jnp.where(done, token, nxt)
@@ -93,7 +96,7 @@ class Engine:
         return step
 
     def serve(self, params, input_ids: jax.Array, gen_len: int,
-              stop_tokens=None) -> jax.Array:
+              stop_tokens=None, kv_start=None) -> jax.Array:
         """Prefill ``input_ids`` (B, S) then generate up to ``gen_len``
         tokens. Returns (B, S + gen_len) (reference ``Engine.serve``
         engine.py:113-190).
@@ -113,11 +116,14 @@ class Engine:
         stop_tokens = tuple(stop_tokens)
         has_stop = bool(stop_tokens)
         stop = jnp.asarray(list(stop_tokens) or [-1], jnp.int32)
+        kv_start = (jnp.zeros((b,), jnp.int32) if kv_start is None
+                    else jnp.asarray(kv_start, jnp.int32))
         self.kv.reset()
         caches = self.kv.init()
 
         logits, caches = self.model.forward(
-            params, input_ids, caches, 0, mode=self.prefill_mode)
+            params, input_ids, caches, 0, mode=self.prefill_mode,
+            kv_start=kv_start)
         self.kv.inc_offset(s)
         token = sample_token(logits[:, -1], self.key, self.temperature,
                              self.top_k)
@@ -143,10 +149,11 @@ class Engine:
                 off = jnp.int32(self.kv.offset)
                 if has_stop:
                     token, caches, done = self._decode_step_stop(
-                        params, caches, token, off, sub, done, stop)
+                        params, caches, token, off, sub, done, stop,
+                        kv_start)
                 else:
                     token, caches = self._decode_step(
-                        params, caches, token, off, sub)
+                        params, caches, token, off, sub, kv_start)
                 self.kv.inc_offset(1)
                 out.append(token[:, None])
                 # the all-done check is a host sync; amortize it
@@ -172,3 +179,28 @@ class Engine:
         else:
             run_steps(n_total)
         return jnp.concatenate(out, axis=1)
+
+
+    def serve_ragged(self, params, prompts, gen_len: int,
+                     stop_tokens=None, pad_token: int = 0) -> list:
+        """Serve prompts of DIFFERENT lengths in one batch.
+
+        Left-pads to a rectangle; the pad prefix is invisible to
+        attention (per-row ``kv_start`` mask) and rope positions count
+        from each row's first real token — under greedy decoding the
+        results match serving each prompt alone (stochastic sampling
+        draws differ by batch position). Returns a list of 1-D arrays
+        (prompt + generated, pads stripped).
+        """
+        b = len(prompts)
+        lens = [len(p) for p in prompts]
+        assert b and all(lens), "serve_ragged needs non-empty prompts"
+        s = max(lens)
+        ids = np.full((b, s), pad_token, np.int32)
+        for i, pr in enumerate(prompts):
+            ids[i, s - lens[i]:] = np.asarray(pr, np.int32)
+        kv_start = jnp.asarray([s - L for L in lens], jnp.int32)
+        out = np.asarray(self.serve(params, jnp.asarray(ids), gen_len,
+                                    stop_tokens=stop_tokens,
+                                    kv_start=kv_start))
+        return [out[i, s - lens[i]:] for i in range(b)]

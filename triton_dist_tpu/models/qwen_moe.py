@@ -114,7 +114,7 @@ class Qwen3MoE:
 
     # -- forward -----------------------------------------------------------
     def forward(self, params: dict, input_ids: jax.Array, kv_caches,
-                offset, mode: str | None = None):
+                offset, mode: str | None = None, kv_start=None):
         """Same contract as DenseLLM.forward; MoE FFN needs the
         row-sharded layout (modes xla / ag_rs)."""
         c = self.config
@@ -138,6 +138,9 @@ class Qwen3MoE:
         offset = jnp.asarray(offset, jnp.int32)
         position_ids = offset + jnp.tile(
             jnp.arange(s, dtype=jnp.int32)[None], (b, 1))
+        if kv_start is not None:
+            position_ids = jnp.maximum(
+                position_ids - jnp.asarray(kv_start, jnp.int32)[:, None], 0)
 
         x = params["embed"][input_ids].reshape(b * s, c.hidden_size)
         new_caches = []
@@ -145,7 +148,7 @@ class Qwen3MoE:
             h = rms_norm(x, lp["ln_attn"], c.rms_norm_eps)
             a, cache = self.attn(lp["attn"], h, position_ids,
                                  self.rope_cache, cache, offset,
-                                 mode=attn_mode)
+                                 mode=attn_mode, kv_start=kv_start)
             x = x + a
             h = rms_norm(x, lp["ln_mlp"], c.rms_norm_eps)
             x = x + self.moe(lp["moe"], h, mode=moe_mode)
