@@ -61,17 +61,25 @@ class ModelServer:
         self._thread: threading.Thread | None = None
 
     def _serve_request(self, req: dict) -> dict:
-        ids = np.asarray(req["prompt_ids"], np.int32)
+        prompts = req["prompt_ids"]
         gen_len = max(0, min(int(req.get("gen_len", 16)), 4096))
         stop = req.get("stop_tokens")  # None → engine default (eos)
+        lens = [len(p) for p in prompts]
+        ragged = len(set(lens)) > 1
         with self._lock:
             t0 = time.perf_counter()
-            out = self.engine.serve(self.params, jnp.asarray(ids), gen_len,
-                                    stop_tokens=stop)
-            out = np.asarray(out)
+            if ragged:
+                rows = self.engine.serve_ragged(self.params, prompts,
+                                                gen_len, stop_tokens=stop)
+                tokens = [r[ln:].tolist() for r, ln in zip(rows, lens)]
+            else:
+                ids = np.asarray(prompts, np.int32)
+                out = np.asarray(self.engine.serve(
+                    self.params, jnp.asarray(ids), gen_len,
+                    stop_tokens=stop))
+                tokens = out[:, ids.shape[1]:].tolist()
             ms = (time.perf_counter() - t0) * 1e3
-        return {"tokens": out[:, ids.shape[1]:].tolist(),
-                "latency_ms": round(ms, 3)}
+        return {"tokens": tokens, "latency_ms": round(ms, 3)}
 
     def start(self):
         self._thread = threading.Thread(target=self._srv.serve_forever,
