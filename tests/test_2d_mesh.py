@@ -245,3 +245,25 @@ def test_pp_shift_2d(mesh4x2, axis, impl, key):
     ref = np.roll(np.asarray(x).reshape(w, rows, f), 1, axis=0)
     np.testing.assert_array_equal(
         np.asarray(out).reshape(w, rows, f), ref)
+
+
+@pytest.mark.parametrize("axis", ["tp", "ep"])
+def test_sp_ulysses_2d(mesh4x2, axis, key):
+    """Ulysses a2a attention bound to one axis of a 2-D mesh."""
+    from triton_dist_tpu.ops.sp_attention import (
+        create_sp_attention_context, sp_ag_attention)
+    w = mesh4x2.shape[axis]
+    b, s, hq, hkv, d = 1, 8 * w, 4 * w, 2 * w, 16
+    q = jax.random.normal(key, (b, s, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv, d),
+                          jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, d),
+                          jnp.float32)
+    ctx = create_sp_attention_context(mesh4x2, axis, causal=True)
+    sh = NamedSharding(mesh4x2, P(None, axis))
+    got = sp_ag_attention(jax.device_put(q, sh), jax.device_put(k, sh),
+                          jax.device_put(v, sh), ctx, impl="ulysses")
+    ref = sp_ag_attention(jax.device_put(q, sh), jax.device_put(k, sh),
+                          jax.device_put(v, sh), ctx, impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
