@@ -1,0 +1,232 @@
+"""Per-op shape-sweep microbenchmarks.
+
+The reference ends every op test with a perf loop over shapes
+(test/nvidia/test_ag_gemm.py:72-197: correctness then `perf_func` +
+`group_profile` per (M, N, K)); this is that harness as a standalone
+tool. Each case checks correctness against the op's XLA golden first —
+a wrong kernel's throughput is meaningless — then times both paths with
+the tunnel-safe chained-slope method (docs/perf.md).
+
+Usage:
+    python -m triton_dist_tpu.tools.bench_ops [--op ag_gemm]
+        [--json out.jsonl]
+
+On CPU hosts the sweep runs interpret-mode (tiny shapes, correctness
+spot-check of the harness itself); real numbers need the TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _mesh():
+    import jax
+    from jax.sharding import Mesh
+    devices = jax.devices()
+    return Mesh(np.array(devices[:1]), ("tp",)), len(devices[:1])
+
+
+def _is_tpu():
+    from triton_dist_tpu.runtime.platform import is_tpu
+    return is_tpu()
+
+
+def _time(step, x0):
+    from triton_dist_tpu.runtime.utils import perf_func_chained
+    # CPU interpret-mode exists only to prove the harness runs; keep the
+    # chains short there (each step re-runs the Pallas interpreter).
+    iters = (8, 24) if _is_tpu() else (1, 3)
+    return perf_func_chained(step, x0, iters)
+
+
+def _report(rows, out):
+    for r in rows:
+        out.write(json.dumps(r) + "\n")
+    out.flush()
+
+
+def sweep_ag_gemm(mesh, shapes, out):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from triton_dist_tpu.ops.allgather_gemm import (
+        ag_gemm, create_ag_gemm_context)
+    from triton_dist_tpu.runtime.utils import assert_allclose
+
+    rows = []
+    for (m, k, n) in shapes:
+        ctx = create_ag_gemm_context(mesh, "tp")
+        a0 = jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(0), (m, k),
+                              jnp.float32).astype(jnp.bfloat16),
+            NamedSharding(mesh, P("tp")))
+        b = jax.device_put(
+            (jax.random.normal(jax.random.PRNGKey(1), (k, n),
+                               jnp.float32) / 8).astype(jnp.bfloat16),
+            NamedSharding(mesh, P(None, "tp")))
+        assert_allclose(ag_gemm(a0, b, ctx, impl="pallas"),
+                        ag_gemm(a0, b, ctx, impl="xla"),
+                        rtol=3e-2, atol=3e-2)
+
+        def mk(impl):
+            @jax.jit
+            def step(a):
+                c = ag_gemm(a, b, ctx, impl=impl)
+                return (c @ jnp.ones((n, k), jnp.bfloat16) * 2 ** -8
+                        ).astype(a.dtype)[:m]
+            return step
+
+        ms_p, ms_x = _time(mk("pallas"), a0), _time(mk("xla"), a0)
+        flops = 2 * m * k * n
+        rows.append({"op": "ag_gemm", "m": m, "k": k, "n": n,
+                     "pallas_ms": round(ms_p, 4),
+                     "xla_ms": round(ms_x, 4),
+                     "tflops": round(flops / (ms_p * 1e-3) / 1e12, 2),
+                     "vs_xla": round(ms_x / ms_p, 4)})
+    _report(rows, out)
+    return rows
+
+
+def sweep_gemm_rs(mesh, shapes, out):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from triton_dist_tpu.ops.gemm_reduce_scatter import (
+        create_gemm_rs_context, gemm_rs)
+    from triton_dist_tpu.runtime.utils import assert_allclose
+
+    rows = []
+    for (m, k, n) in shapes:
+        ctx = create_gemm_rs_context(mesh, "tp")
+        a0 = jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(0), (m, k),
+                              jnp.float32).astype(jnp.bfloat16),
+            NamedSharding(mesh, P(None, "tp")))
+        b = jax.device_put(
+            (jax.random.normal(jax.random.PRNGKey(1), (k, n),
+                               jnp.float32) / 8).astype(jnp.bfloat16),
+            NamedSharding(mesh, P("tp")))
+        assert_allclose(gemm_rs(a0, b, ctx, impl="pallas"),
+                        gemm_rs(a0, b, ctx, impl="xla"),
+                        rtol=3e-2, atol=3e-2)
+
+        def mk(impl):
+            @jax.jit
+            def step(a):
+                c = gemm_rs(a, b, ctx, impl=impl)
+                return (c @ jnp.ones((n, k), jnp.bfloat16) * 2 ** -8
+                        ).astype(a.dtype)[:m]
+            return step
+
+        ms_p, ms_x = _time(mk("pallas"), a0), _time(mk("xla"), a0)
+        flops = 2 * m * k * n
+        rows.append({"op": "gemm_rs", "m": m, "k": k, "n": n,
+                     "pallas_ms": round(ms_p, 4),
+                     "xla_ms": round(ms_x, 4),
+                     "tflops": round(flops / (ms_p * 1e-3) / 1e12, 2),
+                     "vs_xla": round(ms_x / ms_p, 4)})
+    _report(rows, out)
+    return rows
+
+
+def sweep_flash_decode(mesh, shapes, out):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from triton_dist_tpu.ops.flash_decode import (
+        create_flash_decode_context, gqa_fwd_batch_decode)
+    from triton_dist_tpu.runtime.utils import assert_allclose
+
+    rows = []
+    for (b, hq, hkv, d, t) in shapes:
+        ctx = create_flash_decode_context(mesh, "tp", variant="tiled",
+                                          t_blk=min(512, t))
+        q0 = jax.random.normal(jax.random.PRNGKey(0), (b, hq, d),
+                               jnp.float32).astype(jnp.bfloat16)
+        sh = NamedSharding(mesh, P(None, "tp"))
+        kc = jax.device_put(jax.random.normal(
+            jax.random.PRNGKey(1), (b, t, hkv, d), jnp.float32
+        ).astype(jnp.bfloat16), sh)
+        vc = jax.device_put(jax.random.normal(
+            jax.random.PRNGKey(2), (b, t, hkv, d), jnp.float32
+        ).astype(jnp.bfloat16), sh)
+        n = jnp.int32(t - 1)
+        assert_allclose(
+            gqa_fwd_batch_decode(q0, kc, vc, n, ctx, impl="pallas"),
+            gqa_fwd_batch_decode(q0, kc, vc, n, ctx, impl="xla"),
+            rtol=3e-2, atol=3e-2)
+
+        def mk(impl):
+            @jax.jit
+            def step(q):
+                o = gqa_fwd_batch_decode(q, kc, vc, n, ctx, impl=impl)
+                return (o.astype(jnp.float32) * 0.5 + 0.25
+                        ).astype(q.dtype)
+            return step
+
+        ms_p, ms_x = _time(mk("pallas"), q0), _time(mk("xla"), q0)
+        rows.append({"op": "flash_decode", "b": b, "hq": hq, "hkv": hkv,
+                     "d": d, "t": t, "pallas_ms": round(ms_p, 4),
+                     "xla_ms": round(ms_x, 4),
+                     "vs_xla": round(ms_x / ms_p, 4)})
+    _report(rows, out)
+    return rows
+
+
+SWEEPS = {
+    "ag_gemm": (sweep_ag_gemm,
+                [(2048, 4096, 4096), (4096, 4096, 4096),
+                 (1024, 8192, 4096)],
+                [(64, 64, 64)]),
+    "gemm_rs": (sweep_gemm_rs,
+                [(2048, 4096, 4096), (4096, 4096, 4096)],
+                [(64, 64, 64)]),
+    "flash_decode": (sweep_flash_decode,
+                     [(8, 32, 8, 128, 8192), (1, 32, 8, 128, 32768),
+                      (32, 32, 8, 128, 2048)],
+                     [(2, 8, 2, 32, 64)]),
+}
+
+
+def main(argv=None):
+    # 1-core CPU hosts deadlock interpret-mode semaphore waits unless the
+    # affinity shim re-execs us first (runtime/cpu_shim.py; same call
+    # every user-style script makes).
+    from triton_dist_tpu.runtime.cpu_shim import maybe_reexec_with_shim
+    maybe_reexec_with_shim()
+    # The axon sitecustomize pins platforms to "axon,cpu" regardless of
+    # the JAX_PLATFORMS env var; honoring a cpu request needs the config
+    # set BEFORE backend init (otherwise a wedged tunnel hangs us here).
+    import os
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--op", choices=sorted(SWEEPS) + ["all"],
+                    default="all")
+    ap.add_argument("--json", default=None,
+                    help="append JSON lines here (default stdout)")
+    args = ap.parse_args(argv)
+
+    mesh, _ = _mesh()
+    on_tpu = _is_tpu()
+    out = open(args.json, "a") if args.json else sys.stdout
+    try:
+        for name, (fn, tpu_shapes, cpu_shapes) in sorted(SWEEPS.items()):
+            if args.op not in ("all", name):
+                continue
+            fn(mesh, tpu_shapes if on_tpu else cpu_shapes, out)
+    finally:
+        if args.json:
+            out.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
