@@ -5,7 +5,10 @@ The reference ends every op test with a perf loop over shapes
 `group_profile` per (M, N, K)); this is that harness as a standalone
 tool. Each case checks correctness against the op's XLA golden first —
 a wrong kernel's throughput is meaningless — then times both paths with
-the tunnel-safe chained-slope method (docs/perf.md).
+the tunnel-safe chained-slope method (docs/perf.md). Every shape is
+failure-isolated (a VMEM/compile failure emits an error row and the
+sweep continues) and rows are written as they finish, so a crash late
+in an expensive TPU session cannot discard earlier results.
 
 Usage:
     python -m triton_dist_tpu.tools.bench_ops [--op ag_gemm]
@@ -24,11 +27,22 @@ import sys
 import numpy as np
 
 
-def _mesh():
+def _init_mesh(timeout_s: float = 240.0):
+    """Backend init with the wedged-tunnel guard (subprocess probe +
+    deadline, like bench.py's `_init_backend`)."""
     import jax
     from jax.sharding import Mesh
+    import os
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        import subprocess
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout_s)
+        if probe.returncode != 0:
+            raise RuntimeError(
+                f"backend probe failed: {probe.stderr.decode()[-200:]}")
     devices = jax.devices()
-    return Mesh(np.array(devices[:1]), ("tp",)), len(devices[:1])
+    return Mesh(np.array(devices), ("tp",)), len(devices)
 
 
 def _is_tpu():
@@ -44,97 +58,81 @@ def _time(step, x0):
     return perf_func_chained(step, x0, iters)
 
 
-def _report(rows, out):
-    for r in rows:
-        out.write(json.dumps(r) + "\n")
+def _emit(row, out):
+    out.write(json.dumps(row) + "\n")
     out.flush()
 
 
-def sweep_ag_gemm(mesh, shapes, out):
+def _sweep_gemm_family(op_name, mesh, world, shapes, out):
+    """Shared sweep for the collective-matmul ops: ag_gemm (row-sharded
+    A, column-sharded B) and gemm_rs (col-sharded A, row-sharded B).
+    The chain fold is CHEAP (scaled slice tiled back to the input
+    shape) so the timed step is dominated by the op under test, and the
+    (M/w, N) gemm_rs output is tiled back up so `x = step(x)` chains at
+    any world size."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from triton_dist_tpu.ops.allgather_gemm import (
-        ag_gemm, create_ag_gemm_context)
     from triton_dist_tpu.runtime.utils import assert_allclose
 
-    rows = []
+    if op_name == "ag_gemm":
+        from triton_dist_tpu.ops.allgather_gemm import (
+            ag_gemm as op, create_ag_gemm_context as mk_ctx)
+        a_spec, b_spec = P("tp"), P(None, "tp")
+    else:
+        from triton_dist_tpu.ops.gemm_reduce_scatter import (
+            create_gemm_rs_context as mk_ctx, gemm_rs as op)
+        a_spec, b_spec = P(None, "tp"), P("tp")
+
     for (m, k, n) in shapes:
-        ctx = create_ag_gemm_context(mesh, "tp")
-        a0 = jax.device_put(
-            jax.random.normal(jax.random.PRNGKey(0), (m, k),
-                              jnp.float32).astype(jnp.bfloat16),
-            NamedSharding(mesh, P("tp")))
-        b = jax.device_put(
-            (jax.random.normal(jax.random.PRNGKey(1), (k, n),
-                               jnp.float32) / 8).astype(jnp.bfloat16),
-            NamedSharding(mesh, P(None, "tp")))
-        assert_allclose(ag_gemm(a0, b, ctx, impl="pallas"),
-                        ag_gemm(a0, b, ctx, impl="xla"),
-                        rtol=3e-2, atol=3e-2)
+        row = {"op": op_name, "m": m, "k": k, "n": n}
+        try:
+            ctx = mk_ctx(mesh, "tp")
+            a0 = jax.device_put(
+                jax.random.normal(jax.random.PRNGKey(0), (m, k),
+                                  jnp.float32).astype(jnp.bfloat16),
+                NamedSharding(mesh, a_spec))
+            b = jax.device_put(
+                (jax.random.normal(jax.random.PRNGKey(1), (k, n),
+                                   jnp.float32) / 8).astype(jnp.bfloat16),
+                NamedSharding(mesh, b_spec))
+            assert_allclose(op(a0, b, ctx, impl="pallas"),
+                            op(a0, b, ctx, impl="xla"),
+                            rtol=3e-2, atol=3e-2)
 
-        def mk(impl):
-            @jax.jit
-            def step(a):
-                c = ag_gemm(a, b, ctx, impl=impl)
-                return (c @ jnp.ones((n, k), jnp.bfloat16) * 2 ** -8
-                        ).astype(a.dtype)[:m]
-            return step
+            def mk(impl):
+                @jax.jit
+                def step(a):
+                    c = op(a, b, ctx, impl=impl)
+                    # cheap fold back to (m, k): scaled slice, tiled up
+                    sl = (c[:, :k] if c.shape[1] >= k else
+                          jnp.tile(c, (1, -(-k // c.shape[1])))[:, :k])
+                    reps = -(-m // sl.shape[0])
+                    return (jnp.tile(sl, (reps, 1))[:m]
+                            * jnp.asarray(2 ** -4, jnp.bfloat16))
+                return step
 
-        ms_p, ms_x = _time(mk("pallas"), a0), _time(mk("xla"), a0)
-        flops = 2 * m * k * n
-        rows.append({"op": "ag_gemm", "m": m, "k": k, "n": n,
-                     "pallas_ms": round(ms_p, 4),
-                     "xla_ms": round(ms_x, 4),
-                     "tflops": round(flops / (ms_p * 1e-3) / 1e12, 2),
-                     "vs_xla": round(ms_x / ms_p, 4)})
-    _report(rows, out)
-    return rows
-
-
-def sweep_gemm_rs(mesh, shapes, out):
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from triton_dist_tpu.ops.gemm_reduce_scatter import (
-        create_gemm_rs_context, gemm_rs)
-    from triton_dist_tpu.runtime.utils import assert_allclose
-
-    rows = []
-    for (m, k, n) in shapes:
-        ctx = create_gemm_rs_context(mesh, "tp")
-        a0 = jax.device_put(
-            jax.random.normal(jax.random.PRNGKey(0), (m, k),
-                              jnp.float32).astype(jnp.bfloat16),
-            NamedSharding(mesh, P(None, "tp")))
-        b = jax.device_put(
-            (jax.random.normal(jax.random.PRNGKey(1), (k, n),
-                               jnp.float32) / 8).astype(jnp.bfloat16),
-            NamedSharding(mesh, P("tp")))
-        assert_allclose(gemm_rs(a0, b, ctx, impl="pallas"),
-                        gemm_rs(a0, b, ctx, impl="xla"),
-                        rtol=3e-2, atol=3e-2)
-
-        def mk(impl):
-            @jax.jit
-            def step(a):
-                c = gemm_rs(a, b, ctx, impl=impl)
-                return (c @ jnp.ones((n, k), jnp.bfloat16) * 2 ** -8
-                        ).astype(a.dtype)[:m]
-            return step
-
-        ms_p, ms_x = _time(mk("pallas"), a0), _time(mk("xla"), a0)
-        flops = 2 * m * k * n
-        rows.append({"op": "gemm_rs", "m": m, "k": k, "n": n,
-                     "pallas_ms": round(ms_p, 4),
-                     "xla_ms": round(ms_x, 4),
-                     "tflops": round(flops / (ms_p * 1e-3) / 1e12, 2),
-                     "vs_xla": round(ms_x / ms_p, 4)})
-    _report(rows, out)
-    return rows
+            ms_p, ms_x = _time(mk("pallas"), a0), _time(mk("xla"), a0)
+            flops = 2 * m * k * n
+            row.update({
+                "pallas_ms": round(ms_p, 4), "xla_ms": round(ms_x, 4),
+                "tflops_per_chip": round(
+                    flops / world / (ms_p * 1e-3) / 1e12, 2),
+                "vs_xla": round(ms_x / ms_p, 4)})
+        except Exception as e:  # noqa: BLE001 — per-shape isolation
+            row["error"] = repr(e)[:200]
+        _emit(row, out)
 
 
-def sweep_flash_decode(mesh, shapes, out):
+def sweep_ag_gemm(mesh, world, shapes, out):
+    _sweep_gemm_family("ag_gemm", mesh, world, shapes, out)
+
+
+def sweep_gemm_rs(mesh, world, shapes, out):
+    _sweep_gemm_family("gemm_rs", mesh, world, shapes, out)
+
+
+def sweep_flash_decode(mesh, world, shapes, out):
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -142,40 +140,42 @@ def sweep_flash_decode(mesh, shapes, out):
         create_flash_decode_context, gqa_fwd_batch_decode)
     from triton_dist_tpu.runtime.utils import assert_allclose
 
-    rows = []
     for (b, hq, hkv, d, t) in shapes:
-        ctx = create_flash_decode_context(mesh, "tp", variant="tiled",
-                                          t_blk=min(512, t))
-        q0 = jax.random.normal(jax.random.PRNGKey(0), (b, hq, d),
-                               jnp.float32).astype(jnp.bfloat16)
-        sh = NamedSharding(mesh, P(None, "tp"))
-        kc = jax.device_put(jax.random.normal(
-            jax.random.PRNGKey(1), (b, t, hkv, d), jnp.float32
-        ).astype(jnp.bfloat16), sh)
-        vc = jax.device_put(jax.random.normal(
-            jax.random.PRNGKey(2), (b, t, hkv, d), jnp.float32
-        ).astype(jnp.bfloat16), sh)
-        n = jnp.int32(t - 1)
-        assert_allclose(
-            gqa_fwd_batch_decode(q0, kc, vc, n, ctx, impl="pallas"),
-            gqa_fwd_batch_decode(q0, kc, vc, n, ctx, impl="xla"),
-            rtol=3e-2, atol=3e-2)
+        row = {"op": "flash_decode", "b": b, "hq": hq, "hkv": hkv,
+               "d": d, "t": t}
+        try:
+            ctx = create_flash_decode_context(mesh, "tp", variant="tiled",
+                                              t_blk=min(512, t // world))
+            q0 = jax.random.normal(jax.random.PRNGKey(0), (b, hq, d),
+                                   jnp.float32).astype(jnp.bfloat16)
+            sh = NamedSharding(mesh, P(None, "tp"))
+            kc = jax.device_put(jax.random.normal(
+                jax.random.PRNGKey(1), (b, t, hkv, d), jnp.float32
+            ).astype(jnp.bfloat16), sh)
+            vc = jax.device_put(jax.random.normal(
+                jax.random.PRNGKey(2), (b, t, hkv, d), jnp.float32
+            ).astype(jnp.bfloat16), sh)
+            n = jnp.int32(t - 1)
+            assert_allclose(
+                gqa_fwd_batch_decode(q0, kc, vc, n, ctx, impl="pallas"),
+                gqa_fwd_batch_decode(q0, kc, vc, n, ctx, impl="xla"),
+                rtol=3e-2, atol=3e-2)
 
-        def mk(impl):
-            @jax.jit
-            def step(q):
-                o = gqa_fwd_batch_decode(q, kc, vc, n, ctx, impl=impl)
-                return (o.astype(jnp.float32) * 0.5 + 0.25
-                        ).astype(q.dtype)
-            return step
+            def mk(impl):
+                @jax.jit
+                def step(q):
+                    o = gqa_fwd_batch_decode(q, kc, vc, n, ctx, impl=impl)
+                    return (o.astype(jnp.float32) * 0.5 + 0.25
+                            ).astype(q.dtype)
+                return step
 
-        ms_p, ms_x = _time(mk("pallas"), q0), _time(mk("xla"), q0)
-        rows.append({"op": "flash_decode", "b": b, "hq": hq, "hkv": hkv,
-                     "d": d, "t": t, "pallas_ms": round(ms_p, 4),
-                     "xla_ms": round(ms_x, 4),
-                     "vs_xla": round(ms_x / ms_p, 4)})
-    _report(rows, out)
-    return rows
+            ms_p, ms_x = _time(mk("pallas"), q0), _time(mk("xla"), q0)
+            row.update({"pallas_ms": round(ms_p, 4),
+                        "xla_ms": round(ms_x, 4),
+                        "vs_xla": round(ms_x / ms_p, 4)})
+        except Exception as e:  # noqa: BLE001 — per-shape isolation
+            row["error"] = repr(e)[:200]
+        _emit(row, out)
 
 
 SWEEPS = {
@@ -214,14 +214,14 @@ def main(argv=None):
                     help="append JSON lines here (default stdout)")
     args = ap.parse_args(argv)
 
-    mesh, _ = _mesh()
+    mesh, world = _init_mesh()
     on_tpu = _is_tpu()
     out = open(args.json, "a") if args.json else sys.stdout
     try:
         for name, (fn, tpu_shapes, cpu_shapes) in sorted(SWEEPS.items()):
             if args.op not in ("all", name):
                 continue
-            fn(mesh, tpu_shapes if on_tpu else cpu_shapes, out)
+            fn(mesh, world, tpu_shapes if on_tpu else cpu_shapes, out)
     finally:
         if args.json:
             out.close()
