@@ -1,0 +1,84 @@
+"""Render bench / sweep JSON into markdown tables.
+
+Consumes ``bench.py``'s one-line JSON (``--bench``) and/or
+``tools/bench_ops.py`` JSONL (``--sweep``); the reference publishes its
+numbers as rendered tables (README.md:96-205) — this is the generator
+for ours.
+
+Usage:
+    python -m triton_dist_tpu.tools.report --bench BENCH_r03.json
+    python -m triton_dist_tpu.tools.report --sweep sweep.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def render_bench(d: dict) -> str:
+    ex = d.get("extras", {})
+    lines = [f"**{d.get('metric')}** = {d.get('value')} "
+             f"{d.get('unit', '')} (vs_baseline {d.get('vs_baseline')})",
+             ""]
+    groups: dict[str, dict] = {}
+    for k, v in ex.items():
+        op = k.split("_")[0] if "_" in k else k
+        groups.setdefault(op, {})[k] = v
+    lines.append("| key | value |")
+    lines.append("|---|---|")
+    for op in sorted(groups):
+        for k in sorted(groups[op]):
+            lines.append(f"| {k} | {groups[op][k]} |")
+    return "\n".join(lines)
+
+
+def render_sweep(rows: list[dict]) -> str:
+    if not rows:
+        return "(no rows)"
+    out = []
+    by_op: dict[str, list] = {}
+    for r in rows:
+        by_op.setdefault(r.get("op", "?"), []).append(r)
+    for op, rs in sorted(by_op.items()):
+        cols = [c for c in rs[0] if c != "op"]
+        out.append(f"### {op}")
+        out.append("| " + " | ".join(cols) + " |")
+        out.append("|" + "---|" * len(cols))
+        for r in rs:
+            out.append("| " + " | ".join(str(r.get(c, "")) for c in cols)
+                       + " |")
+        out.append("")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bench", default=None)
+    ap.add_argument("--sweep", default=None)
+    args = ap.parse_args(argv)
+    if not (args.bench or args.sweep):
+        ap.error("need --bench and/or --sweep")
+    if args.bench:
+        with open(args.bench) as f:
+            d = json.load(f)
+        if "metric" not in d and "tail" in d:
+            # driver BENCH_r{N}.json wraps the emitted line in `tail`
+            line = [ln for ln in d["tail"].splitlines()
+                    if ln.startswith("{")]
+            d = json.loads(line[-1]) if line else d
+        print(render_bench(d))
+    if args.sweep:
+        rows = []
+        with open(args.sweep) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+        print(render_sweep(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
