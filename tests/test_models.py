@@ -460,3 +460,46 @@ def test_hf_transformers_parity_qwen3_gqa(devices):
         rms_norm_eps=1e-6, tie_word_embeddings=True,
         attention_bias=False, attention_dropout=0.0)
     _hf_parity_case(mesh4, Qwen3ForCausalLM, hf_cfg, "qwen3")
+
+
+def test_hf_transformers_parity_qwen3_moe(devices):
+    """MoE parity vs HF Qwen3Moe eager: router softmax/top-k norm,
+    expert stacking, shared attention — external golden for the MoE
+    stack."""
+    import dataclasses
+    import torch
+    from jax.sharding import Mesh
+    from transformers import Qwen3MoeConfig, Qwen3MoeForCausalLM
+
+    mesh4 = Mesh(np.array(devices[:4]), ("tp",))
+    hf_cfg = Qwen3MoeConfig(
+        hidden_size=64, intermediate_size=128, moe_intermediate_size=32,
+        num_hidden_layers=2, num_attention_heads=8,
+        num_key_value_heads=4, head_dim=8, vocab_size=128,
+        max_position_embeddings=64, rope_theta=1e6, rms_norm_eps=1e-6,
+        tie_word_embeddings=False, attention_bias=False,
+        attention_dropout=0.0, num_experts=4, num_experts_per_tok=2,
+        norm_topk_prob=True, decoder_sparse_step=1,
+        mlp_only_layers=[], router_aux_loss_coef=0.0,
+        output_router_logits=False)
+    torch.manual_seed(0)
+    hf = Qwen3MoeForCausalLM(hf_cfg).eval()
+    state = {k: v.detach().cpu().numpy().astype(np.float32)
+             for k, v in hf.state_dict().items()}
+    if "lm_head.weight" not in state:
+        state["lm_head.weight"] = state["model.embed_tokens.weight"]
+
+    cfg = ModelConfig.from_hf_config(
+        {**hf_cfg.to_dict(), "model_type": "qwen3_moe"})
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    model = Qwen3MoE(cfg, mesh=mesh4, axis="tp")
+    params = model.load_hf_state_dict(state)
+
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, cfg.vocab_size, size=(2, 8)).astype(np.int32)
+    ours, _ = model.forward(params, jnp.asarray(ids),
+                            _caches(model, 2, 16), 0, mode="xla")
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=3e-3,
+                               atol=3e-3)
