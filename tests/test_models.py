@@ -503,3 +503,40 @@ def test_hf_transformers_parity_qwen3_moe(devices):
         theirs = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
     np.testing.assert_allclose(np.asarray(ours), theirs, rtol=3e-3,
                                atol=3e-3)
+
+
+def test_hf_transformers_generation_parity(devices):
+    """Greedy generation parity vs hf.generate — anchors the decode
+    loop + KV cache + rope offsets externally, not just one forward."""
+    import dataclasses
+    import torch
+    from jax.sharding import Mesh
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    mesh4 = Mesh(np.array(devices[:4]), ("tp",))
+    hf_cfg = Qwen3Config(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=8, num_key_value_heads=4, head_dim=8,
+        vocab_size=128, max_position_embeddings=64, rope_theta=1e6,
+        rms_norm_eps=1e-6, tie_word_embeddings=False,
+        attention_bias=False, attention_dropout=0.0)
+    torch.manual_seed(3)
+    hf = Qwen3ForCausalLM(hf_cfg).eval()
+    state = {k: v.detach().cpu().numpy().astype(np.float32)
+             for k, v in hf.state_dict().items()}
+
+    cfg = dataclasses.replace(
+        ModelConfig.from_hf_config({**hf_cfg.to_dict(),
+                                    "model_type": "qwen3"}),
+        dtype=jnp.float32)
+    model = DenseLLM(cfg, mesh=mesh4, axis="tp", impl="xla")
+    params = model.load_hf_state_dict(state)
+
+    ids = np.asarray([[7, 3, 11, 29]], np.int32)
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(ids.astype(np.int64)),
+                          max_new_tokens=5, do_sample=False,
+                          eos_token_id=None).numpy()
+    ours = np.asarray(Engine(model, batch=1, max_seq=32).serve(
+        params, jnp.asarray(ids), 5, stop_tokens=()))
+    np.testing.assert_array_equal(ours, ref)
