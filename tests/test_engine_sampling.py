@@ -186,3 +186,23 @@ def test_engine_batch_row_independence(model, key):
     out_a = np.asarray(eng.serve(params, a, 4))
     out_b = np.asarray(eng.serve(params, b, 4))
     np.testing.assert_array_equal(out_a[0], out_b[0])
+
+
+def test_engine_ragged_stop_profile_combo(model, key, tmp_path):
+    """All three serve features together keep the output contract."""
+    params = model.init(key)
+    prompts = [[5, 9, 2], [3]]
+    eng = Engine(model, batch=2, max_seq=32,
+                 profile_dir=str(tmp_path), profile_steps=2)
+    free = eng.serve_ragged(params, prompts, gen_len=6)
+    stop_tok = int(free[0][3])
+    eng2 = Engine(model, batch=2, max_seq=32,
+                  profile_dir=str(tmp_path), profile_steps=2)
+    outs = eng2.serve_ragged(params, prompts, gen_len=6,
+                             stop_tokens=(stop_tok,))
+    assert len(outs) == 2
+    assert len(outs[0]) == 3 + 6 and len(outs[1]) == 1 + 6
+    # row 0 froze on its stop token
+    gen0 = np.asarray(outs[0][3:])
+    first = int(np.argmax(gen0 == stop_tok))
+    assert (gen0[first:] == stop_tok).all()
