@@ -51,6 +51,27 @@ def _err(e: BaseException) -> str:
     return repr(e)[:300]
 
 
+def _checkpoint_extras(extras: dict, last_done: str) -> None:
+    """Stream partial results to ``TDT_BENCH_PROGRESS`` after every
+    sub-benchmark.
+
+    A 40-minute bench run through the tunnel was killed by an outer
+    timeout with ALL measurements lost because the JSON line only
+    prints at the end (r3); with the checkpoint file, an interrupted
+    run still leaves every completed metric on disk."""
+    path = os.environ.get("TDT_BENCH_PROGRESS")
+    if not path:
+        return
+    try:
+        tmp = path + ".tmp"  # atomic: a mid-write kill must not truncate
+        with open(tmp, "w") as f:  # the very file this exists to protect
+            json.dump({"last_done": last_done, "extras": extras}, f,
+                      indent=1, default=str)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def _probe_backend_subprocess(timeout_s: float) -> bool:
     """Probe backend init in a THROWAWAY subprocess with a hard deadline.
 
@@ -540,6 +561,9 @@ def _bench_tp_mlp(mesh, n, on_tpu, extras):
 
 def main():
     extras: dict = {}
+    # Clear any stale checkpoint so a run that dies before its first
+    # sub-benchmark can't pass off the previous run's metrics as its own.
+    _checkpoint_extras(extras, "init")
     result = {"metric": "ag_gemm_tflops", "value": None, "unit": "TFLOPS",
               "vs_baseline": None, "extras": extras}
     try:
@@ -579,6 +603,7 @@ def main():
                 fn()
             except Exception as e:  # noqa: BLE001 — partial over rc!=0
                 extras[name + "_error"] = _err(e)
+            _checkpoint_extras(extras, name)
 
         if "ag_gemm_tflops" in extras:
             result["value"] = extras["ag_gemm_tflops"]
@@ -595,6 +620,7 @@ def main():
                       "extras": extras}
     except Exception as e:  # noqa: BLE001 — emit partial JSON, never rc!=0
         extras["fatal"] = _err(e)
+        _checkpoint_extras(extras, "fatal")
 
     print(json.dumps(result))
 
