@@ -15,9 +15,11 @@ from triton_dist_tpu.models.dense import DenseLLM
 from triton_dist_tpu.models.qwen_moe import Qwen3MoE
 from triton_dist_tpu.models.kv_cache import KVCacheManager
 from triton_dist_tpu.models.engine import Engine, sample_token
+from triton_dist_tpu.models.train import make_train_step, cross_entropy_loss
 
 __all__ = ["ModelConfig", "DenseLLM", "Qwen3MoE", "KVCacheManager",
-           "Engine", "sample_token", "AutoLLM"]
+           "Engine", "sample_token", "AutoLLM", "make_train_step",
+           "cross_entropy_loss"]
 
 
 def _load_safetensors_state(model_dir: str) -> dict:

@@ -105,7 +105,8 @@ class DenseLLM:
 
     # -- forward -----------------------------------------------------------
     def forward(self, params: dict, input_ids: jax.Array, kv_caches,
-                offset, mode: str | None = None, kv_start=None):
+                offset, mode: str | None = None, kv_start=None,
+                remat: bool = False):
         """input_ids: (B, S) int32; kv_caches: [(k, v)] * L; offset: scalar
         write position. Returns (logits (B, S, V), new_caches).
 
@@ -116,6 +117,10 @@ class DenseLLM:
         ``kv_start``: optional (B,) left-pad boundaries for ragged
         batches — rope positions count from each row's first real token
         and attention never sees the pad prefix (Engine.serve_ragged).
+
+        ``remat``: checkpoint each decoder layer — activations are
+        recomputed in the backward pass instead of stored, trading
+        FLOPs for HBM so long-sequence training fits (models/train.py).
         """
         c = self.config
         mode = mode or self.fwd_mode
@@ -127,9 +132,7 @@ class DenseLLM:
             position_ids = jnp.maximum(
                 position_ids - jnp.asarray(kv_start, jnp.int32)[:, None], 0)
 
-        x = params["embed"][input_ids].reshape(b * s, c.hidden_size)
-        new_caches = []
-        for lp, cache in zip(params["layers"], kv_caches):
+        def layer_body(x, lp, cache):
             h = rms_norm(x, lp["ln_attn"], c.rms_norm_eps)
             a, cache = self.attn(lp["attn"], h, position_ids,
                                  self.rope_cache, cache, offset, mode=mode,
@@ -137,6 +140,13 @@ class DenseLLM:
             x = x + a
             h = rms_norm(x, lp["ln_mlp"], c.rms_norm_eps)
             x = x + self.mlp(lp["mlp"], h, mode=mode)
+            return x, cache
+
+        body = jax.checkpoint(layer_body) if remat else layer_body
+        x = params["embed"][input_ids].reshape(b * s, c.hidden_size)
+        new_caches = []
+        for lp, cache in zip(params["layers"], kv_caches):
+            x, cache = body(x, lp, cache)
             new_caches.append(cache)
 
         x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
