@@ -24,10 +24,10 @@ from triton_dist_tpu.ops.common import nestable_shard_map
 
 from triton_dist_tpu.layers.common import (
     apply_rope, col_parallel_matmul, rms_norm, shard_param)
-from triton_dist_tpu.ops.allgather_gemm import (
-    create_ag_gemm_context, ag_gemm_multi)
-from triton_dist_tpu.ops.gemm_reduce_scatter import (
-    create_gemm_rs_context, gemm_rs, gemm_ar)
+from triton_dist_tpu.ops.allgather_gemm import create_ag_gemm_context
+from triton_dist_tpu.ops.gemm_reduce_scatter import create_gemm_rs_context
+# Differentiable wrappers (forward-identical; ops/autodiff.py).
+from triton_dist_tpu.ops.autodiff import ag_gemm_multi, gemm_rs, gemm_ar
 
 
 class TPAttn:

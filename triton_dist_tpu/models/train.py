@@ -77,8 +77,10 @@ def make_train_step(model, optimizer=None, *, mode: str = "xla",
         caches, offset, mode=...)`` returning (B, S, V) logits).
       optimizer: an optax GradientTransformation; default
         ``optax.adamw(3e-4)``.
-      mode: forward collective mode — must be a differentiable one
-        ("xla" or "xla_ar"); the Pallas DMA kernels have no VJP.
+      mode: forward collective mode. "xla"/"xla_ar" differentiate
+        through XLA collectives; "ag_rs"/"gemm_ar" train through the
+        fused Pallas kernels — their custom VJPs run the transpose
+        fused kernel in the backward (ops/autodiff.py).
       remat: checkpoint each decoder layer (DenseLLM only).
       donate: donate params/opt_state buffers to the update.
 
@@ -96,10 +98,11 @@ def make_train_step(model, optimizer=None, *, mode: str = "xla",
         ) from e
     if optimizer is None:
         optimizer = optax.adamw(3e-4, mu_dtype=jnp.float32)
-    if mode not in ("xla", "xla_ar"):
+    if mode not in ("xla", "xla_ar", "ag_rs", "gemm_ar"):
         raise ValueError(
             f"training needs a differentiable mode, got {mode!r} "
-            "(the Pallas remote-DMA kernels define no VJP)")
+            "(xla/xla_ar via XLA collectives; ag_rs/gemm_ar via the "
+            "fused-kernel VJPs in ops/autodiff.py)")
 
     fwd_kwargs = {}
     import inspect

@@ -1,0 +1,154 @@
+"""Custom VJPs for the fused TP ops — training through the Pallas path.
+
+The reference never needs this (it is inference-only; SURVEY §2.9), and
+its unfused torch autograd could not see it anyway. On TPU the fused
+pair is self-transposed:
+
+  AG-GEMM forward   C = allgather(A) @ B      (row-sharded → col-sharded)
+  its dA            = reduce_scatter(dC @ Bᵀ)  — exactly GEMM-RS
+  GEMM-RS forward   C = reduce_scatter(A @ B)  (col-sharded → row-sharded)
+  its dA            = allgather(dC) @ Bᵀ       — exactly AG-GEMM
+
+so the backward of each fused kernel IS the other fused kernel, and a
+training step in ``mode="ag_rs"`` runs compute-communication overlap in
+both directions. The weight grads (dB = Aᵀ @ dC) contract over the
+gathered dim; they are plain local/sharded dots that XLA schedules (a
+sharding constraint pins the layout, XLA inserts the gather where one
+is needed).
+
+``gemm_ar`` (decode TP, C replicated) backs both grads with purely
+local dots — no collective at all in its backward.
+
+Usage: the wrappers are forward-identical to the entries in
+``allgather_gemm`` / ``gemm_reduce_scatter`` (they call them), so they
+can be substituted anywhere; differentiation only changes what
+``jax.grad`` does. ``models.train.make_train_step(mode="ag_rs")``
+routes through them.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.ops import allgather_gemm as _ag
+from triton_dist_tpu.ops import gemm_reduce_scatter as _rs
+
+
+def _constrain(x, mesh, spec):
+    return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+# -- AG-GEMM (multi-B: the QKV / gate-up shared-gather form) --------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def ag_gemm_multi(a, bs, ctx, impl="pallas"):
+    """Differentiable ``allgather_gemm.ag_gemm_multi`` (no
+    ``return_gathered`` support — grads need the plain output list)."""
+    assert not ctx.return_gathered, "autodiff needs return_gathered=False"
+    return tuple(_ag.ag_gemm_multi(a, list(bs), ctx, impl))
+
+
+def _ag_fwd(a, bs, ctx, impl):
+    # Keep bs in its original container: the bwd cotangents must come
+    # back in the same pytree structure the caller passed (list/tuple).
+    return ag_gemm_multi(a, bs, ctx, impl), (a, bs)
+
+
+def _ag_bwd(ctx, impl, res, dcs):
+    a, bs = res
+    rs_ctx = _rs.create_gemm_rs_context(ctx.mesh, ctx.axis,
+                                        acc_dtype=ctx.acc_dtype,
+                                        interpret=ctx.interpret)
+    # dA = Σ_i RS(dC_i @ B_iᵀ): each term is one fused GEMM-RS kernel
+    # (the transpose of this op), accumulated in the input's sharding.
+    da = None
+    for b, dc in zip(bs, dcs):
+        term = _rs.gemm_rs(dc, b.T, rs_ctx, impl=impl)
+        da = term if da is None else da + term
+    da = _constrain(da.astype(a.dtype), ctx.mesh, P(ctx.axis, None))
+    # dB_i = Aᵀ @ dC_i: contraction over the gathered M — a sharded dot
+    # (dC_i col-sharded ⇒ dB_i col-sharded; XLA inserts the A gather).
+    dbs = [
+        _constrain(jnp.dot(a.T, dc,
+                           preferred_element_type=ctx.acc_dtype
+                           ).astype(b.dtype),
+                   ctx.mesh, P(None, ctx.axis))
+        for b, dc in zip(bs, dcs)]
+    return da, type(bs)(dbs)
+
+
+ag_gemm_multi.defvjp(_ag_fwd, _ag_bwd)
+
+
+def ag_gemm(a, b, ctx, impl="pallas"):
+    """Differentiable ``allgather_gemm.ag_gemm``."""
+    return ag_gemm_multi(a, (b,), ctx, impl)[0]
+
+
+# -- GEMM-RS --------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def gemm_rs(a, b, ctx, impl="pallas"):
+    """Differentiable ``gemm_reduce_scatter.gemm_rs``."""
+    return _rs.gemm_rs(a, b, ctx, impl=impl)
+
+
+def _rs_fwd(a, b, ctx, impl):
+    return gemm_rs(a, b, ctx, impl), (a, b)
+
+
+def _rs_bwd(ctx, impl, res, dc):
+    a, b = res
+    ag_ctx = _ag.create_ag_gemm_context(ctx.mesh, ctx.axis,
+                                        acc_dtype=ctx.acc_dtype,
+                                        interpret=ctx.interpret)
+    # dA = AG(dC) @ Bᵀ — one fused AG-GEMM kernel (the transpose of
+    # this op); Bᵀ is column-sharded exactly as AG-GEMM wants.
+    da = _ag.ag_gemm(dc, b.T, ag_ctx, impl=impl)
+    da = _constrain(da.astype(a.dtype), ctx.mesh, P(None, ctx.axis))
+    # dB = Aᵀ @ AG(dC): row-sharded like B, local contraction over M
+    # once XLA materializes the dC gather it already scheduled for dA.
+    dc_rep = _constrain(dc, ctx.mesh, P(None, None))
+    db = _constrain(jnp.dot(a.T, dc_rep,
+                            preferred_element_type=ctx.acc_dtype
+                            ).astype(b.dtype),
+                    ctx.mesh, P(ctx.axis, None))
+    return da, db
+
+
+gemm_rs.defvjp(_rs_fwd, _rs_bwd)
+
+
+# -- GEMM-AR (decode TP: C replicated) ------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def gemm_ar(a, b, ctx, impl="pallas"):
+    """Differentiable ``gemm_reduce_scatter.gemm_ar``."""
+    return _rs.gemm_ar(a, b, ctx, impl=impl)
+
+
+def _ar_fwd(a, b, ctx, impl):
+    return gemm_ar(a, b, ctx, impl), (a, b)
+
+
+def _ar_bwd(ctx, impl, res, dc):
+    a, b = res
+    # dC is replicated, so both grads are comm-free local dots:
+    # dA[:, k_loc] = dC @ Bᵀ[:, k_loc];  dB[k_loc, :] = Aᵀ[k_loc, :] @ dC.
+    da = _constrain(jnp.dot(dc, b.T,
+                            preferred_element_type=ctx.acc_dtype
+                            ).astype(a.dtype),
+                    ctx.mesh, P(None, ctx.axis))
+    db = _constrain(jnp.dot(a.T, dc,
+                            preferred_element_type=ctx.acc_dtype
+                            ).astype(b.dtype),
+                    ctx.mesh, P(ctx.axis, None))
+    return da, db
+
+
+gemm_ar.defvjp(_ar_fwd, _ar_bwd)
