@@ -585,6 +585,18 @@ def main():
             except Exception as e:  # noqa: BLE001
                 extras["timing_selfcheck_error"] = _err(e)
 
+        # TDT_BENCH_ONLY: comma-separated sub-benchmark names — lets an
+        # operator (or a babysitting script) run each part in its own
+        # short-lived process on the flaky tunnel, so one hung Mosaic
+        # compile can't take the other metrics down with it.
+        only = [s for s in os.environ.get("TDT_BENCH_ONLY", "").split(",")
+                if s]
+        known = ("ag_gemm", "gemm_rs", "gemm_ar", "flash_decode",
+                 "sp_attn", "moe_ag_gg", "mega", "tp_mlp")
+        bad = [s for s in only if s not in known]
+        if bad:  # a typo must not turn into a silently empty bench
+            raise ValueError(
+                f"unknown TDT_BENCH_ONLY entries {bad}; known: {known}")
         for name, fn in (
                 ("ag_gemm", lambda: _bench_ag_gemm(mesh, n, on_tpu, extras)),
                 ("gemm_rs", lambda: _bench_gemm_rs(mesh, n, on_tpu, extras)),
@@ -599,6 +611,8 @@ def main():
                  lambda: _bench_mega_vs_engine(mesh, n, on_tpu, extras)),
                 ("tp_mlp", lambda: _bench_tp_mlp(mesh, n, on_tpu, extras)),
         ):
+            if only and name not in only:
+                continue
             try:
                 fn()
             except Exception as e:  # noqa: BLE001 — partial over rc!=0

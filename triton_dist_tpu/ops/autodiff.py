@@ -28,6 +28,7 @@ routes through them.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
@@ -37,10 +38,29 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.ops import allgather_gemm as _ag
 from triton_dist_tpu.ops import gemm_reduce_scatter as _rs
+from triton_dist_tpu.ops.common import nestable_shard_map
 
 
 def _constrain(x, mesh, spec):
     return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def _paired_ctx(src, create_fn, **over):
+    """Build the transpose op's context from the forward context.
+
+    Shape-independent knobs carry over (autotune, vmem_budget, debug
+    injection where the target has them); the block hints do NOT — the
+    backward contracts over different dims, so forward tile sizes would
+    be wrong there (each entry re-resolves/clamps per shape anyway).
+    """
+    dst = create_fn(src.mesh, src.axis, acc_dtype=src.acc_dtype,
+                    interpret=src.interpret)
+    shared = {"autotune", "vmem_budget", "straggler_option",
+              "for_correctness"}
+    for f in dataclasses.fields(dst):
+        if f.name in shared and hasattr(src, f.name):
+            over.setdefault(f.name, getattr(src, f.name))
+    return dataclasses.replace(dst, **over)
 
 
 # -- AG-GEMM (multi-B: the QKV / gate-up shared-gather form) --------------
@@ -49,7 +69,8 @@ def _constrain(x, mesh, spec):
 def ag_gemm_multi(a, bs, ctx, impl="pallas"):
     """Differentiable ``allgather_gemm.ag_gemm_multi`` (no
     ``return_gathered`` support — grads need the plain output list)."""
-    assert not ctx.return_gathered, "autodiff needs return_gathered=False"
+    if ctx.return_gathered:  # not assert: wrong grads if stripped by -O
+        raise ValueError("autodiff needs return_gathered=False")
     return tuple(_ag.ag_gemm_multi(a, list(bs), ctx, impl))
 
 
@@ -61,9 +82,7 @@ def _ag_fwd(a, bs, ctx, impl):
 
 def _ag_bwd(ctx, impl, res, dcs):
     a, bs = res
-    rs_ctx = _rs.create_gemm_rs_context(ctx.mesh, ctx.axis,
-                                        acc_dtype=ctx.acc_dtype,
-                                        interpret=ctx.interpret)
+    rs_ctx = _paired_ctx(ctx, _rs.create_gemm_rs_context)
     # dA = Σ_i RS(dC_i @ B_iᵀ): each term is one fused GEMM-RS kernel
     # (the transpose of this op), accumulated in the input's sharding.
     da = None
@@ -71,8 +90,9 @@ def _ag_bwd(ctx, impl, res, dcs):
         term = _rs.gemm_rs(dc, b.T, rs_ctx, impl=impl)
         da = term if da is None else da + term
     da = _constrain(da.astype(a.dtype), ctx.mesh, P(ctx.axis, None))
-    # dB_i = Aᵀ @ dC_i: contraction over the gathered M — a sharded dot
-    # (dC_i col-sharded ⇒ dB_i col-sharded; XLA inserts the A gather).
+    # dB_i = Aᵀ @ dC_i: A's rows (the contraction dim) are sharded, so
+    # XLA contracts locally and psums the (K, N_loc) partials — no
+    # re-gather of A is required for a col-sharded result.
     dbs = [
         _constrain(jnp.dot(a.T, dc,
                            preferred_element_type=ctx.acc_dtype
@@ -104,20 +124,25 @@ def _rs_fwd(a, b, ctx, impl):
 
 def _rs_bwd(ctx, impl, res, dc):
     a, b = res
-    ag_ctx = _ag.create_ag_gemm_context(ctx.mesh, ctx.axis,
-                                        acc_dtype=ctx.acc_dtype,
-                                        interpret=ctx.interpret)
+    ag_ctx = _paired_ctx(ctx, _ag.create_ag_gemm_context,
+                         return_gathered=True)
     # dA = AG(dC) @ Bᵀ — one fused AG-GEMM kernel (the transpose of
-    # this op); Bᵀ is column-sharded exactly as AG-GEMM wants.
-    da = _ag.ag_gemm(dc, b.T, ag_ctx, impl=impl)
+    # this op); Bᵀ is column-sharded exactly as AG-GEMM wants. The
+    # kernel's internal gather is opaque to XLA, so ask it to RETURN
+    # the gathered dC (the field exists for exactly this reuse,
+    # reference tp_attn workspace sharing) instead of gathering twice.
+    da, dc_gathered = _ag.ag_gemm(dc, b.T, ag_ctx, impl=impl)
     da = _constrain(da.astype(a.dtype), ctx.mesh, P(None, ctx.axis))
-    # dB = Aᵀ @ AG(dC): row-sharded like B, local contraction over M
-    # once XLA materializes the dC gather it already scheduled for dA.
-    dc_rep = _constrain(dc, ctx.mesh, P(None, None))
-    db = _constrain(jnp.dot(a.T, dc_rep,
-                            preferred_element_type=ctx.acc_dtype
-                            ).astype(b.dtype),
-                    ctx.mesh, P(ctx.axis, None))
+    # dB = Aᵀ @ AG(dC): every device holds a full dC block in
+    # ``dc_gathered`` ((w·M, N), P(axis)) and its own K-columns of A,
+    # so the weight grad is one comm-free local dot per device.
+    db = nestable_shard_map(
+        lambda a_l, g_l: jnp.dot(
+            a_l.T, g_l, preferred_element_type=ctx.acc_dtype
+        ).astype(b.dtype),
+        mesh=ctx.mesh,
+        in_specs=(P(None, ctx.axis), P(ctx.axis, None)),
+        out_specs=P(ctx.axis, None), check_vma=False)(a, dc_gathered)
     return da, db
 
 
