@@ -589,28 +589,29 @@ def main():
         # operator (or a babysitting script) run each part in its own
         # short-lived process on the flaky tunnel, so one hung Mosaic
         # compile can't take the other metrics down with it.
+        benches = (
+            ("ag_gemm", lambda: _bench_ag_gemm(mesh, n, on_tpu, extras)),
+            ("gemm_rs", lambda: _bench_gemm_rs(mesh, n, on_tpu, extras)),
+            ("gemm_ar", lambda: _bench_gemm_ar(mesh, n, on_tpu, extras)),
+            ("flash_decode",
+             lambda: _bench_flash_decode(mesh, n, on_tpu, extras)),
+            ("sp_attn",
+             lambda: _bench_sp_attention(mesh, n, on_tpu, extras)),
+            ("moe_ag_gg",
+             lambda: _bench_ag_group_gemm(mesh, n, on_tpu, extras)),
+            ("mega",
+             lambda: _bench_mega_vs_engine(mesh, n, on_tpu, extras)),
+            ("tp_mlp", lambda: _bench_tp_mlp(mesh, n, on_tpu, extras)),
+        )
         only = [s for s in os.environ.get("TDT_BENCH_ONLY", "").split(",")
                 if s]
-        known = ("ag_gemm", "gemm_rs", "gemm_ar", "flash_decode",
-                 "sp_attn", "moe_ag_gg", "mega", "tp_mlp")
-        bad = [s for s in only if s not in known]
-        if bad:  # a typo must not turn into a silently empty bench
-            raise ValueError(
-                f"unknown TDT_BENCH_ONLY entries {bad}; known: {known}")
-        for name, fn in (
-                ("ag_gemm", lambda: _bench_ag_gemm(mesh, n, on_tpu, extras)),
-                ("gemm_rs", lambda: _bench_gemm_rs(mesh, n, on_tpu, extras)),
-                ("gemm_ar", lambda: _bench_gemm_ar(mesh, n, on_tpu, extras)),
-                ("flash_decode",
-                 lambda: _bench_flash_decode(mesh, n, on_tpu, extras)),
-                ("sp_attn",
-                 lambda: _bench_sp_attention(mesh, n, on_tpu, extras)),
-                ("moe_ag_gg",
-                 lambda: _bench_ag_group_gemm(mesh, n, on_tpu, extras)),
-                ("mega",
-                 lambda: _bench_mega_vs_engine(mesh, n, on_tpu, extras)),
-                ("tp_mlp", lambda: _bench_tp_mlp(mesh, n, on_tpu, extras)),
-        ):
+        bad = [s for s in only if s not in {b[0] for b in benches}]
+        if bad:  # a typo must not turn into a silently empty bench;
+            # SystemExit bypasses the blanket except below → rc != 0.
+            raise SystemExit(
+                f"unknown TDT_BENCH_ONLY entries {bad}; "
+                f"known: {[b[0] for b in benches]}")
+        for name, fn in benches:
             if only and name not in only:
                 continue
             try:
