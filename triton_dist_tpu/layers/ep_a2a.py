@@ -25,9 +25,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 from triton_dist_tpu.ops.common import nestable_shard_map
 
 from triton_dist_tpu.ops.all_to_all import (
-    AllToAllContext, create_all_to_all_context, fast_all_to_all)
+    AllToAllContext, create_all_to_all_context)
+# Differentiable wrapper (forward-identical): the a2a's adjoint is the
+# reverse exchange, so EP dispatch/combine train (ops/autodiff.py).
+from triton_dist_tpu.ops.autodiff import fast_all_to_all
 from triton_dist_tpu.ops.moe_utils import (
-    dispatch_layout, scatter_to_slabs, topk_reduce)
+    dispatch_layout, live_slot_mask, scatter_to_slabs, topk_reduce)
 
 
 @dataclasses.dataclass
@@ -122,9 +125,13 @@ class EPAll2AllLayer:
 
         def local_unpack(rb, re, rc):
             # Mask slots past each slab's live count; sentinel expert id.
-            slot = lax.broadcasted_iota(jnp.int32, (world, cap), 1)
-            live = slot < rc[:, None]
+            live = live_slot_mask(rc, world, cap)
             exp = jnp.where(live, re, self.experts_per_rank)
+            # Zero the stale payload rows too: the Pallas a2a leaves
+            # them undefined, and any NaN there would poison the expert
+            # FFN's *backward* (0-cotangent × NaN-primal = NaN) even
+            # though combine masks them out of the forward.
+            rb = jnp.where(live[..., None], rb, 0)
             return rb.reshape(world * cap, -1), exp.reshape(-1)
 
         unpack = nestable_shard_map(

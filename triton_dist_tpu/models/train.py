@@ -80,7 +80,9 @@ def make_train_step(model, optimizer=None, *, mode: str = "xla",
       mode: forward collective mode. "xla"/"xla_ar" differentiate
         through XLA collectives; "ag_rs"/"gemm_ar" train through the
         fused Pallas kernels — their custom VJPs run the transpose
-        fused kernel in the backward (ops/autodiff.py).
+        fused kernel in the backward — and "ep" (Qwen3MoE with
+        moe_parallel="ep") through the Pallas a2a dispatch/combine,
+        whose adjoint is the reverse exchange (ops/autodiff.py).
       remat: checkpoint each decoder layer (DenseLLM only).
       donate: donate params/opt_state buffers to the update.
 
@@ -98,10 +100,10 @@ def make_train_step(model, optimizer=None, *, mode: str = "xla",
         ) from e
     if optimizer is None:
         optimizer = optax.adamw(3e-4, mu_dtype=jnp.float32)
-    if mode not in ("xla", "xla_ar", "ag_rs", "gemm_ar"):
+    if mode not in ("xla", "xla_ar", "ag_rs", "gemm_ar", "ep"):
         raise ValueError(
             f"training needs a differentiable mode, got {mode!r} "
-            "(xla/xla_ar via XLA collectives; ag_rs/gemm_ar via the "
+            "(xla/xla_ar via XLA collectives; ag_rs/gemm_ar/ep via the "
             "fused-kernel VJPs in ops/autodiff.py)")
 
     fwd_kwargs = {}

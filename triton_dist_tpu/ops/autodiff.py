@@ -33,9 +33,11 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from triton_dist_tpu.ops import all_to_all as _a2a
 from triton_dist_tpu.ops import allgather_gemm as _ag
 from triton_dist_tpu.ops import gemm_reduce_scatter as _rs
 from triton_dist_tpu.ops.common import nestable_shard_map
@@ -147,6 +149,53 @@ def _rs_bwd(ctx, impl, res, dc):
 
 
 gemm_rs.defvjp(_rs_fwd, _rs_bwd)
+
+
+# -- EP AllToAll ----------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fast_all_to_all(send_buf, send_counts, ctx, impl="pallas"):
+    """Differentiable ``all_to_all.fast_all_to_all``.
+
+    The exchange transposes the (rank, slab) matrix — recv slab j on
+    rank i is send slab i of rank j — so its adjoint is the SAME
+    exchange run on the cotangents, with the forward's ``recv_counts``
+    as the send counts (send back exactly what was received). With
+    this one rule the whole EP dispatch → experts → combine pipeline
+    differentiates (layers/ep_a2a.py: everything else is jnp).
+    """
+    return _a2a.fast_all_to_all(send_buf, send_counts, ctx, impl)
+
+
+def _a2a_fwd(send_buf, send_counts, ctx, impl):
+    recv_buf, recv_counts = fast_all_to_all(send_buf, send_counts, ctx,
+                                            impl)
+    return (recv_buf, recv_counts), (recv_counts, send_counts.shape)
+
+
+def _a2a_bwd(ctx, impl, res, cot):
+    recv_counts, counts_shape = res
+    d_recv, _ = cot  # counts are int32 → their cotangent is float0
+    d_send, back_counts = _a2a.fast_all_to_all(d_recv, recv_counts, ctx,
+                                               impl)
+    # The Pallas exchange leaves slots past each slab's live count
+    # STALE; a cotangent is mathematically zero there, and any NaN
+    # would poison upstream weight-grad accumulations (0-primal ×
+    # NaN-cotangent), so mask here — in the rule, not in callers.
+    from triton_dist_tpu.ops.moe_utils import live_slot_mask
+
+    def mask(buf, counts):
+        live = live_slot_mask(counts, buf.shape[0], buf.shape[1])
+        return jnp.where(live[..., None], buf, 0)
+
+    d_send = nestable_shard_map(
+        mask, mesh=ctx.mesh, in_specs=(P(ctx.axis), P(ctx.axis)),
+        out_specs=P(ctx.axis), check_vma=False)(d_send, back_counts)
+    d_counts = np.zeros(counts_shape, jax.dtypes.float0)
+    return d_send, d_counts
+
+
+fast_all_to_all.defvjp(_a2a_fwd, _a2a_bwd)
 
 
 # -- GEMM-AR (decode TP: C replicated) ------------------------------------

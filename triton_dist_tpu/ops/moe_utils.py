@@ -45,6 +45,20 @@ def topk_routing(router_logits: jax.Array, topk: int,
     return weights, indices.astype(jnp.int32)
 
 
+def live_slot_mask(counts: jax.Array, world: int,
+                   capacity: int) -> jax.Array:
+    """(world, capacity) bool: slot s of slab p is live iff
+    ``s < counts[p]``.
+
+    One definition of "live" for the a2a slab layout, shared by the
+    dispatch unpack (layers/ep_a2a.py) and the a2a VJP's cotangent
+    masking (ops/autodiff.py) — the Pallas exchange leaves dead slots
+    stale, and both sides must zero the same set of rows.
+    """
+    slot = lax.broadcasted_iota(jnp.int32, (world, capacity), 1)
+    return slot < counts[:, None]
+
+
 def bincount(indices: jax.Array, length: int) -> jax.Array:
     """Static-length bincount (reference device ``bincount`` ep_a2a.py:310,
     used for per-expert splits)."""
