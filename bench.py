@@ -559,6 +559,60 @@ def _bench_tp_mlp(mesh, n, on_tpu, extras):
     return t_fused, t_base / t_fused
 
 
+def _bench_train(mesh, n, on_tpu, extras):
+    """Training-step throughput (beyond-reference: the reference is
+    inference-only, SURVEY §2.9). Times the fused ag_rs train step —
+    whose backward rides the transpose fused kernels (ops/autodiff.py)
+    — against the xla-collective baseline; reports tokens/s."""
+    import jax
+    import jax.numpy as jnp
+    from triton_dist_tpu.models import DenseLLM, ModelConfig
+    from triton_dist_tpu.models.train import make_train_step
+    from triton_dist_tpu.runtime.utils import perf_func_chained
+
+    if on_tpu:
+        cfg = ModelConfig(hidden_size=2048, intermediate_size=8192,
+                          num_hidden_layers=4, num_attention_heads=16,
+                          num_key_value_heads=8, head_dim=128,
+                          vocab_size=32768, max_position_embeddings=1024,
+                          dtype=jnp.bfloat16)
+        b, s, iters = 4, 512, (4, 12)
+    else:
+        cfg = ModelConfig(hidden_size=128, intermediate_size=256,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, head_dim=64,
+                          vocab_size=256, max_position_embeddings=64,
+                          dtype=jnp.float32)
+        b, s, iters = 2, 8, (2, 4)
+    batch = {"input_ids": jax.random.randint(
+        jax.random.PRNGKey(7), (b, s), 0, cfg.vocab_size, jnp.int32)}
+
+    times = {}
+    for key, mode, impl in (("fused", "ag_rs", "pallas"),
+                            ("xla", "xla", "xla")):
+        model = DenseLLM(cfg, mesh=mesh, axis="tp", impl=impl,
+                         fwd_mode=mode)
+        params = model.init(jax.random.PRNGKey(0))
+        # donate=False: the perf chain re-perturbs the same initial
+        # buffers across runs, which donation would invalidate.
+        run_step, init_opt = make_train_step(model, mode=mode,
+                                             donate=False)
+        opt0 = init_opt(params)
+
+        def step(carry):
+            p, o = carry
+            p, o, _ = run_step(p, o, batch)
+            return (p, o)
+
+        times[key] = perf_func_chained(step, (params, opt0), iters)
+
+    extras["train_fused_ms"] = round(times["fused"], 4)
+    extras["train_xla_ms"] = round(times["xla"], 4)
+    extras["train_vs_xla"] = round(times["xla"] / times["fused"], 4)
+    extras["train_tokens_per_s"] = round(b * s / times["fused"] * 1e3, 1)
+    return times["fused"], times["xla"] / times["fused"]
+
+
 def main():
     extras: dict = {}
     # Clear any stale checkpoint so a run that dies before its first
@@ -602,6 +656,7 @@ def main():
             ("mega",
              lambda: _bench_mega_vs_engine(mesh, n, on_tpu, extras)),
             ("tp_mlp", lambda: _bench_tp_mlp(mesh, n, on_tpu, extras)),
+            ("train", lambda: _bench_train(mesh, n, on_tpu, extras)),
         )
         only = [s for s in os.environ.get("TDT_BENCH_ONLY", "").split(",")
                 if s]
