@@ -21,7 +21,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_dist_tpu.layers.common import (
-    precompute_rope_cache, rms_norm, shard_param)
+    apply_rope, precompute_rope_cache, rms_norm, shard_param)
 from triton_dist_tpu.layers.tp_attn import TPAttn
 from triton_dist_tpu.layers.tp_mlp import TPMLP
 from triton_dist_tpu.models.config import ModelConfig
@@ -32,13 +32,31 @@ class DenseLLM:
 
     def __init__(self, config: ModelConfig, mesh: Mesh | None = None,
                  axis: str = "tp", fwd_mode: str = "ag_rs",
-                 impl: str = "pallas"):
+                 impl: str = "pallas", sp_axis: str | None = None):
         if mesh is None:
             from triton_dist_tpu.runtime.dist import get_mesh
             mesh = get_mesh()
         self.config = config
         self.mesh, self.axis = mesh, axis
         self.fwd_mode = fwd_mode
+        self.sp_axis = sp_axis
+        if sp_axis is not None:
+            # Sequence-parallel contexts (mode="sp"): ring attention for
+            # prefill/training, distributed split-KV flash decode over
+            # the sequence-sharded cache. Pure SP — the tp axis must be
+            # size 1 (weights replicated); compose dp outside.
+            assert mesh.shape[axis] == 1, (
+                "mode='sp' is pure sequence parallelism: build the mesh "
+                f"as (1, w) over ('{axis}', '{sp_axis}')")
+            from triton_dist_tpu.ops.flash_decode import (
+                create_flash_decode_context)
+            from triton_dist_tpu.ops.sp_attention import (
+                create_sp_attention_context)
+            self.sp_ctx = create_sp_attention_context(mesh, sp_axis,
+                                                      causal=True)
+            self.fd_ctx = create_flash_decode_context(mesh, sp_axis)
+            self.sp_impl = "ring" if impl == "pallas" else "xla"
+            self.fd_impl = impl
         c = config
         # One module per role, reused across layers (all layers share
         # shapes; params differ per layer).
@@ -124,6 +142,10 @@ class DenseLLM:
         """
         c = self.config
         mode = mode or self.fwd_mode
+        if mode == "sp":
+            assert kv_start is None, "mode='sp' has no ragged support yet"
+            return self.forward_sp(params, input_ids, kv_caches, offset,
+                                   remat=remat)
         b, s = input_ids.shape
         offset = jnp.asarray(offset, jnp.int32)
         position_ids = offset + jnp.tile(
@@ -153,6 +175,108 @@ class DenseLLM:
         logits = jnp.dot(x.astype(jnp.float32),
                          params["lm_head"].T.astype(jnp.float32))
         return logits.reshape(b, s, c.vocab_size), new_caches
+
+    # -- sequence-parallel forward (long-context path) ---------------------
+    def forward_sp(self, params: dict, input_ids: jax.Array, kv_caches,
+                   offset, remat: bool = False):
+        """Sequence-parallel forward: the long-context path the reference
+        serves with ``SpFlashDecodeLayer`` + AG-attention
+        (sp_ag_attention_inter_node.py:504, sp_flash_decode_layer.py),
+        lifted to the whole model.
+
+        Activations stay (B, S, H) with S sharded over ``sp_axis`` —
+        each device holds S/w positions, so max context scales with the
+        mesh. Weights are replicated (pure SP; the tp axis is size 1 —
+        compose dp outside). Prefill/training (S > 1, offset must be 0)
+        runs ring SP attention on the freshly-projected K/V; decode
+        (S == 1) runs the distributed split-KV flash decode over the
+        sequence-sharded cache. The cache must be allocated with
+        ``KVCacheManager(seq_shard=True, axis=sp_axis)``.
+
+        Differentiable end-to-end in the prefill shape (ring attention
+        carries native transpose rules), so ``make_train_step(
+        mode="sp")`` trains long sequences with S/w activation memory
+        per device on top of the remat option.
+        """
+        from jax.sharding import NamedSharding
+        from triton_dist_tpu.ops.flash_decode import gqa_fwd_batch_decode
+        from triton_dist_tpu.ops.sp_attention import sp_ag_attention
+
+        assert self.sp_axis is not None, (
+            "build DenseLLM(sp_axis=...) to use mode='sp'")
+        c = self.config
+        b, s = input_ids.shape
+        sp = self.sp_axis
+        decode = s == 1
+        if (s > 1 and not isinstance(offset, jax.core.Tracer)
+                and int(offset) != 0):
+            # Silent-corruption guard: the S>1 branch attends only over
+            # the just-projected chunk, so a chunked prefill (offset>0)
+            # would never see the cached prefix.
+            raise NotImplementedError(
+                "sp prefill is single-shot (offset must be 0); chunked "
+                "prefill needs cache-aware ring steps")
+        offset = jnp.asarray(offset, jnp.int32)
+        pos = offset + jnp.tile(jnp.arange(s, dtype=jnp.int32)[None],
+                                (b, 1))
+        xsh = P() if decode else P(None, sp, None)
+
+        def constrain(t, spec):
+            return jax.lax.with_sharding_constraint(
+                t, NamedSharding(self.mesh, spec))
+
+        ap = self.attn  # head geometry + qk-norm config live there
+        hq, hkv, d = ap.num_heads, ap.num_kv_heads, ap.head_dim
+        cos, sin = self.rope_cache
+        eps = c.rms_norm_eps
+
+        def layer_body(x, lp, cache):
+            a = lp["attn"]
+            h = rms_norm(x, lp["ln_attn"], eps)
+            q = (h @ a["w_q"]).reshape(b, s, hq, d)
+            k = (h @ a["w_k"]).reshape(b, s, hkv, d)
+            v = (h @ a["w_v"]).reshape(b, s, hkv, d)
+            if ap.qk_norm:
+                q = rms_norm(q, a["q_norm"], eps)
+                k = rms_norm(k, a["k_norm"], eps)
+            q = apply_rope(q, cos, sin, pos)
+            k = apply_rope(k, cos, sin, pos)
+            ck, cv = cache
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                              (0, offset, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                              (0, offset, 0, 0))
+            if decode:
+                att = gqa_fwd_batch_decode(q[:, 0], ck, cv, offset + 1,
+                                           self.fd_ctx, impl=self.fd_impl)
+                att = att[:, None]
+            else:
+                # Ring attention over the JUST-projected K/V: the SP
+                # prefill starts at offset 0 (the Engine's contract);
+                # chunked prefill would need cache-aware ring steps.
+                att = sp_ag_attention(q, k, v, self.sp_ctx,
+                                      impl=self.sp_impl)
+            att = att.reshape(b, s, hq * d)
+            x = x + constrain((att @ a["w_o"]).astype(x.dtype), xsh)
+            m = lp["mlp"]
+            h = rms_norm(x, lp["ln_mlp"], eps)
+            gate = h @ m["w_gate"]
+            up = h @ m["w_up"]
+            act = jax.nn.silu(gate.astype(jnp.float32)).astype(h.dtype) * up
+            x = x + constrain((act @ m["w_down"]).astype(x.dtype), xsh)
+            return x, (ck, cv)
+
+        body = jax.checkpoint(layer_body) if remat else layer_body
+        x = constrain(params["embed"][input_ids], xsh)
+        new_caches = []
+        for lp, cache in zip(params["layers"], kv_caches):
+            x, cache = body(x, lp, cache)
+            new_caches.append(cache)
+
+        x = rms_norm(x, params["final_norm"], eps)
+        logits = jnp.einsum("bsh,vh->bsv", x.astype(jnp.float32),
+                            params["lm_head"].astype(jnp.float32))
+        return logits, new_caches
 
     # -- HF weights --------------------------------------------------------
     def load_hf_state_dict(self, state: dict) -> dict:

@@ -47,9 +47,21 @@ class Engine:
                  profile_dir: str | None = None, profile_steps: int = 64):
         self.model = model
         c = model.config
-        self.kv = KVCacheManager(
-            c.num_hidden_layers, batch, max_seq, c.num_key_value_heads,
-            c.head_dim, mesh=model.mesh, axis=model.axis, dtype=c.dtype)
+        if "sp" in (prefill_mode, decode_mode):
+            # Sequence-parallel serving (long context): both phases must
+            # share the sequence-sharded cache layout.
+            assert prefill_mode == decode_mode == "sp", (
+                "mode='sp' applies to prefill and decode together")
+            assert getattr(model, "sp_axis", None), (
+                "build the model with sp_axis=... for sp serving")
+            self.kv = KVCacheManager(
+                c.num_hidden_layers, batch, max_seq, c.num_key_value_heads,
+                c.head_dim, mesh=model.mesh, axis=model.sp_axis,
+                dtype=c.dtype, seq_shard=True)
+        else:
+            self.kv = KVCacheManager(
+                c.num_hidden_layers, batch, max_seq, c.num_key_value_heads,
+                c.head_dim, mesh=model.mesh, axis=model.axis, dtype=c.dtype)
         self.prefill_mode = prefill_mode
         self.decode_mode = decode_mode
         self.temperature = temperature
@@ -70,9 +82,9 @@ class Engine:
 
         @jax.jit
         def step(params, caches, token, offset, key, kv_start):
-            logits, caches = model.forward(params, token[:, None], caches,
-                                           offset, mode=mode,
-                                           kv_start=kv_start)
+            logits, caches = model.forward(
+                params, token[:, None], caches, offset, mode=mode,
+                kv_start=None if mode == "sp" else kv_start)
             nxt = sample_token(logits[:, -1], key, self.temperature,
                                self.top_k)
             return nxt, caches
@@ -86,9 +98,9 @@ class Engine:
 
         @jax.jit
         def step(params, caches, token, offset, key, done, stop, kv_start):
-            logits, caches = model.forward(params, token[:, None], caches,
-                                           offset, mode=mode,
-                                           kv_start=kv_start)
+            logits, caches = model.forward(
+                params, token[:, None], caches, offset, mode=mode,
+                kv_start=None if mode == "sp" else kv_start)
             nxt = sample_token(logits[:, -1], key, self.temperature,
                                self.top_k)
             nxt = jnp.where(done, token, nxt)
@@ -121,9 +133,12 @@ class Engine:
         self.kv.reset()
         caches = self.kv.init()
 
+        if self.prefill_mode == "sp":
+            # SP serving has no ragged support (forward_sp's contract).
+            assert not bool(kv_start.any()), "sp serving is non-ragged"
         logits, caches = self.model.forward(
             params, input_ids, caches, 0, mode=self.prefill_mode,
-            kv_start=kv_start)
+            kv_start=None if self.prefill_mode == "sp" else kv_start)
         self.kv.inc_offset(s)
         token = sample_token(logits[:, -1], self.key, self.temperature,
                              self.top_k)

@@ -53,18 +53,23 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
     return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
 
-def _fresh_caches(model, batch: int, seq: int):
+def _fresh_caches(model, batch: int, seq: int, mode: str = "xla"):
     """Zero KV caches sized exactly (B, S) for one training forward.
 
     Training threads the same cache pytree the inference path uses
     (attention writes k/v at offset 0 then attends causally over them);
-    the grads flow through the ``dynamic_update_slice`` write.
+    the grads flow through the ``dynamic_update_slice`` write. In
+    ``mode="sp"`` the cache is sequence-sharded over the model's
+    ``sp_axis`` (matching ``DenseLLM.forward_sp``'s contract).
     """
     from triton_dist_tpu.models.kv_cache import KVCacheManager
     c = model.config
+    sp = mode == "sp"
     kv = KVCacheManager(c.num_hidden_layers, batch, seq,
                         c.num_key_value_heads, c.head_dim,
-                        mesh=model.mesh, axis=model.axis, dtype=c.dtype)
+                        mesh=model.mesh,
+                        axis=model.sp_axis if sp else model.axis,
+                        dtype=c.dtype, seq_shard=sp)
     return kv.init()
 
 
@@ -100,11 +105,12 @@ def make_train_step(model, optimizer=None, *, mode: str = "xla",
         ) from e
     if optimizer is None:
         optimizer = optax.adamw(3e-4, mu_dtype=jnp.float32)
-    if mode not in ("xla", "xla_ar", "ag_rs", "gemm_ar", "ep"):
+    if mode not in ("xla", "xla_ar", "ag_rs", "gemm_ar", "ep", "sp"):
         raise ValueError(
             f"training needs a differentiable mode, got {mode!r} "
             "(xla/xla_ar via XLA collectives; ag_rs/gemm_ar/ep via the "
-            "fused-kernel VJPs in ops/autodiff.py)")
+            "fused-kernel VJPs in ops/autodiff.py; sp via ring "
+            "attention's native transpose rules)")
 
     fwd_kwargs = {}
     import inspect
@@ -148,7 +154,8 @@ def make_train_step(model, optimizer=None, *, mode: str = "xla",
         # inputs (the step discards new_caches), so one allocation per
         # (B, S) shape is reused across the whole training run.
         if ids.shape not in cache_by_shape:
-            cache_by_shape[ids.shape] = _fresh_caches(model, *ids.shape)
+            cache_by_shape[ids.shape] = _fresh_caches(model, *ids.shape,
+                                                      mode=mode)
         batch["_caches"] = cache_by_shape[ids.shape]
         return jit_step(params, opt_state, batch)
 
