@@ -43,17 +43,19 @@ class DenseLLM:
         if sp_axis is not None:
             # Sequence-parallel contexts (mode="sp"): ring attention for
             # prefill/training, distributed split-KV flash decode over
-            # the sequence-sharded cache. Pure SP — the tp axis must be
-            # size 1 (weights replicated); compose dp outside.
-            assert mesh.shape[axis] == 1, (
-                "mode='sp' is pure sequence parallelism: build the mesh "
-                f"as (1, w) over ('{axis}', '{sp_axis}')")
+            # the sequence-sharded cache. With tp > 1 this is a 2-D
+            # tp×sp model: heads shard over tp inside the ring
+            # (head_axis), weight collectives come from XLA shardings;
+            # decode keeps the cache head-replicated (flash decode runs
+            # per sp-rank on full heads).
+            tp_world = mesh.shape[axis]
             from triton_dist_tpu.ops.flash_decode import (
                 create_flash_decode_context)
             from triton_dist_tpu.ops.sp_attention import (
                 create_sp_attention_context)
-            self.sp_ctx = create_sp_attention_context(mesh, sp_axis,
-                                                      causal=True)
+            self.sp_ctx = create_sp_attention_context(
+                mesh, sp_axis, causal=True,
+                head_axis=axis if tp_world > 1 else None)
             self.fd_ctx = create_flash_decode_context(mesh, sp_axis)
             self.sp_impl = "ring" if impl == "pallas" else "xla"
             self.fd_impl = impl
@@ -186,11 +188,14 @@ class DenseLLM:
 
         Activations stay (B, S, H) with S sharded over ``sp_axis`` —
         each device holds S/w positions, so max context scales with the
-        mesh. Weights are replicated (pure SP; the tp axis is size 1 —
-        compose dp outside). Prefill/training (S > 1, offset must be 0)
-        runs ring SP attention on the freshly-projected K/V; decode
-        (S == 1) runs the distributed split-KV flash decode over the
-        sequence-sharded cache. The cache must be allocated with
+        mesh. With tp > 1 this is a 2-D tp×sp model: projections keep
+        their column/row TP shardings (XLA inserts the psums) and the
+        ring attention runs on the head-local slice
+        (``SpAttentionContext.head_axis``). Prefill/training (S > 1,
+        offset must be 0) runs ring SP attention on the
+        freshly-projected K/V; decode (S == 1) runs the distributed
+        split-KV flash decode over the sequence-sharded,
+        head-replicated cache. The cache must be allocated with
         ``KVCacheManager(seq_shard=True, axis=sp_axis)``.
 
         Differentiable end-to-end in the prefill shape (ring attention
@@ -208,18 +213,21 @@ class DenseLLM:
         b, s = input_ids.shape
         sp = self.sp_axis
         decode = s == 1
-        if (s > 1 and not isinstance(offset, jax.core.Tracer)
-                and int(offset) != 0):
+        if s > 1:
             # Silent-corruption guard: the S>1 branch attends only over
             # the just-projected chunk, so a chunked prefill (offset>0)
-            # would never see the cached prefix.
-            raise NotImplementedError(
-                "sp prefill is single-shot (offset must be 0); chunked "
-                "prefill needs cache-aware ring steps")
+            # would never see the cached prefix. A traced offset could
+            # smuggle a nonzero through, so prefill requires a STATIC 0.
+            if isinstance(offset, jax.core.Tracer) or int(offset) != 0:
+                raise NotImplementedError(
+                    "sp prefill is single-shot: pass offset as a static "
+                    "0 (chunked prefill needs cache-aware ring steps)")
         offset = jnp.asarray(offset, jnp.int32)
         pos = offset + jnp.tile(jnp.arange(s, dtype=jnp.int32)[None],
                                 (b, 1))
+        tp = self.sp_ctx.head_axis  # single source of truth (ctor)
         xsh = P() if decode else P(None, sp, None)
+        hsh = P() if decode else P(None, sp, tp, None)  # heads over tp
 
         def constrain(t, spec):
             return jax.lax.with_sharding_constraint(
@@ -233,19 +241,26 @@ class DenseLLM:
         def layer_body(x, lp, cache):
             a = lp["attn"]
             h = rms_norm(x, lp["ln_attn"], eps)
-            q = (h @ a["w_q"]).reshape(b, s, hq, d)
-            k = (h @ a["w_k"]).reshape(b, s, hkv, d)
-            v = (h @ a["w_v"]).reshape(b, s, hkv, d)
+            q = constrain((h @ a["w_q"]).reshape(b, s, hq, d), hsh)
+            k = constrain((h @ a["w_k"]).reshape(b, s, hkv, d), hsh)
+            v = constrain((h @ a["w_v"]).reshape(b, s, hkv, d), hsh)
             if ap.qk_norm:
                 q = rms_norm(q, a["q_norm"], eps)
                 k = rms_norm(k, a["k_norm"], eps)
             q = apply_rope(q, cos, sin, pos)
             k = apply_rope(k, cos, sin, pos)
             ck, cv = cache
-            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                              (0, offset, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                              (0, offset, 0, 0))
+            # Align to the cache layout (seq-sharded, head-replicated)
+            # BEFORE the write: updating with head-sharded operands
+            # forces SPMD into an involuntary full rematerialization.
+            # (Training discards new_caches, so XLA dead-code-eliminates
+            # this whole write chain — prefill attention reads the
+            # just-projected k/v, not the cache.)
+            csh = P() if decode else P(None, sp, None, None)
+            ck = jax.lax.dynamic_update_slice(
+                ck, constrain(k, csh).astype(ck.dtype), (0, offset, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cv, constrain(v, csh).astype(cv.dtype), (0, offset, 0, 0))
             if decode:
                 att = gqa_fwd_batch_decode(q[:, 0], ck, cv, offset + 1,
                                            self.fd_ctx, impl=self.fd_impl)

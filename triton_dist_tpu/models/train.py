@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as PSpec
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
@@ -123,7 +124,9 @@ def make_train_step(model, optimizer=None, *, mode: str = "xla",
         ids = batch["input_ids"]
         b, s = ids.shape
         caches = batch["_caches"]
-        logits, _ = model.forward(params, ids, caches, jnp.int32(0),
+        # STATIC python 0: under the jit trace jnp.int32(0) is a tracer,
+        # which forward_sp's single-shot-prefill guard must reject.
+        logits, _ = model.forward(params, ids, caches, 0,
                                   mode=mode, **fwd_kwargs)
         # Predict token i+1 from position i; the last column has no
         # target so it is always dropped.
@@ -160,6 +163,18 @@ def make_train_step(model, optimizer=None, *, mode: str = "xla",
         return jit_step(params, opt_state, batch)
 
     def init_opt_state(params):
-        return optimizer.init(params)
+        state = optimizer.init(params)
+        # Moments inherit the params' mesh shardings via zeros_like, but
+        # optimizer SCALARS (e.g. adam's count) land on the default
+        # device as single-device arrays. Pin them to a replicated mesh
+        # sharding so (a) one jit sees a consistent device set and (b) a
+        # checkpoint restore using this state as ``like`` round-trips
+        # onto the mesh instead of committing to device 0.
+        rep = NamedSharding(model.mesh, PSpec())
+        return jax.tree.map(
+            lambda a: (jax.device_put(a, rep)
+                       if isinstance(a, jax.Array)
+                       and not isinstance(a.sharding, NamedSharding)
+                       else a), state)
 
     return run_step, init_opt_state
