@@ -92,6 +92,55 @@ def test_sp_engine_rejects_mixed_modes(sp_setup):
                decode_mode="gemm_ar")
 
 
+def test_sp_2d_tp_x_sp(devices):
+    """2-D tp×sp: heads shard over tp inside the sequence ring
+    (SpAttentionContext.head_axis); prefill logits, greedy serving,
+    and training all agree with the 1-axis paths."""
+    mesh = Mesh(np.array(devices).reshape(2, 4), ("tp", "sp"))
+    cfg = _cfg()
+    model = DenseLLM(cfg, mesh=mesh, axis="tp", sp_axis="sp",
+                     impl="pallas", fwd_mode="sp")
+    params = model.init(jax.random.PRNGKey(0))
+    b, s = 2, 16
+    ids = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0,
+                             cfg.vocab_size, jnp.int32)
+
+    kv_sp = KVCacheManager(cfg.num_hidden_layers, b, 64,
+                           cfg.num_key_value_heads, cfg.head_dim,
+                           mesh=mesh, axis="sp", seq_shard=True,
+                           dtype=cfg.dtype)
+    kv_tp = KVCacheManager(cfg.num_hidden_layers, b, 64,
+                           cfg.num_key_value_heads, cfg.head_dim,
+                           mesh=mesh, axis="tp", dtype=cfg.dtype)
+    lo_sp, _ = jax.jit(
+        lambda p, i, c: model.forward(p, i, c, 0, mode="sp"))(
+        params, ids, kv_sp.init())
+    lo_x, _ = jax.jit(
+        lambda p, i, c: model.forward(p, i, c, 0, mode="xla"))(
+        params, ids, kv_tp.init())
+    np.testing.assert_allclose(np.asarray(lo_sp), np.asarray(lo_x),
+                               rtol=2e-4, atol=2e-4)
+
+    eng_sp = Engine(model, batch=b, max_seq=64, prefill_mode="sp",
+                    decode_mode="sp")
+    eng_tp = Engine(model, batch=b, max_seq=64, prefill_mode="xla",
+                    decode_mode="xla_ar")
+    np.testing.assert_array_equal(
+        np.asarray(eng_sp.serve(params, ids, 5)),
+        np.asarray(eng_tp.serve(params, ids, 5)))
+
+    losses = {}
+    for mode in ("xla", "sp"):
+        step, init_opt = make_train_step(model, mode=mode, donate=False)
+        p, o = params, init_opt(params)
+        seq = []
+        for _ in range(2):
+            p, o, m = step(p, o, {"input_ids": ids})
+            seq.append(float(m["loss"]))
+        losses[mode] = seq
+    np.testing.assert_allclose(losses["sp"], losses["xla"], rtol=2e-4)
+
+
 def test_sp_training(sp_setup):
     """mode="sp" trains (ring attention differentiates natively) with
     the same losses as the xla-mode step, including under remat."""

@@ -66,11 +66,18 @@ _NEG = -1e30
 @dataclasses.dataclass
 class SpAttentionContext:
     """Analog of ``create_sp_ag_attention_context``
-    (sp_ag_attention_inter_node.py): axis + AG workspace config."""
+    (sp_ag_attention_inter_node.py): axis + AG workspace config.
+
+    ``head_axis``: optional second mesh axis sharding the HEAD dim (2-D
+    tp×sp attention — heads tensor-parallel, sequence ring-parallel).
+    Supported by the xla/ring impls, whose per-head math is independent;
+    the ulysses and fused-Pallas impls require ``head_axis=None``.
+    """
     mesh: Mesh
     axis: str = "sp"
     causal: bool = True
     interpret: bool | None = None
+    head_axis: str | None = None
 
     @property
     def world_size(self) -> int:
@@ -79,13 +86,14 @@ class SpAttentionContext:
 
 def create_sp_attention_context(mesh: Mesh | None = None, axis: str = "sp",
                                 causal: bool = True,
-                                interpret: bool | None = None
+                                interpret: bool | None = None,
+                                head_axis: str | None = None
                                 ) -> SpAttentionContext:
     if mesh is None:
         from triton_dist_tpu.runtime.dist import get_mesh
         mesh = get_mesh()
     return SpAttentionContext(mesh=mesh, axis=axis, causal=causal,
-                              interpret=interpret)
+                              interpret=interpret, head_axis=head_axis)
 
 
 def _chunk_scores(q, k, q_first, k_first, causal: bool):
@@ -395,20 +403,22 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     def finish(state, qs_dtype):
         m, l, acc = state
         out = acc / jnp.maximum(l, 1e-20)[..., None]
-        # (B, K, G, S, D) → (B, S, Hq, D)
+        # (B, K, G, S, D) → (B, S, hq_l, D) — hq_l is the LOCAL head
+        # count (= Hq/|head_axis| under 2-D tp×sp sharding).
+        kl, gl = out.shape[1], out.shape[2]
         return out.transpose(0, 3, 1, 2, 4).reshape(
-            b, s_loc, hq, d).astype(qs_dtype)
+            b, s_loc, kl * gl, d).astype(qs_dtype)
 
-    def local_q(qs):
-        # (B, S_loc, Hq, D) → (B, K, G, S_loc, D) fp32
-        return qs.reshape(b, s_loc, hkv, groups, d
+    def local_q(qs, hkv_l):
+        # (B, S_loc, hq_l, D) → (B, K, G, S_loc, D) fp32
+        return qs.reshape(b, s_loc, hkv_l, qs.shape[2] // hkv_l, d
                           ).transpose(0, 2, 3, 1, 4).astype(jnp.float32)
 
     def ag_body(qs, ks, vs):
         me = lax.axis_index(axis)
         kg = lax.all_gather(ks, axis, axis=1, tiled=True)
         vg = lax.all_gather(vs, axis, axis=1, tiled=True)
-        qf = local_q(qs)
+        qf = local_q(qs, ks.shape[2])
         scores = _chunk_scores(qf, kg, me * s_loc, 0, causal)
         m = jnp.max(scores, axis=-1)
         p = jnp.exp(scores - m[..., None])
@@ -418,11 +428,12 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     def ring_body(qs, ks, vs):
         me = lax.axis_index(axis)
-        qf = local_q(qs)
+        hkv_l, gl = ks.shape[2], qs.shape[2] // ks.shape[2]
+        qf = local_q(qs, hkv_l)
         perm = [(i, (i + 1) % world) for i in range(world)]
-        state = (jnp.full((b, hkv, groups, s_loc), _NEG, jnp.float32),
-                 jnp.zeros((b, hkv, groups, s_loc), jnp.float32),
-                 jnp.zeros((b, hkv, groups, s_loc, d), jnp.float32))
+        state = (jnp.full((b, hkv_l, gl, s_loc), _NEG, jnp.float32),
+                 jnp.zeros((b, hkv_l, gl, s_loc), jnp.float32),
+                 jnp.zeros((b, hkv_l, gl, s_loc, d), jnp.float32))
 
         def step(i, carry):
             state, kc, vc = carry
@@ -442,11 +453,16 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     if impl in ("xla", "ring"):
         body = ag_body if (impl == "xla" or world == 1) else ring_body
+        # Optional 2-D sharding: heads split over ctx.head_axis on top
+        # of the sequence split — the per-(kv-head, group) math never
+        # mixes heads, so the same bodies run on the head-local slice.
+        spec = P(None, axis, ctx.head_axis, None)
         f = nestable_shard_map(
-            body, mesh=mesh,
-            in_specs=(P(None, axis), P(None, axis), P(None, axis)),
-            out_specs=P(None, axis), check_vma=False)
+            body, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False)
         return f(q, k, v)
+    assert ctx.head_axis is None, (
+        f"impl={impl!r} does not support head_axis (use 'ring' or 'xla')")
 
     if impl == "ulysses":
         # All-to-all head parallelism (DeepSpeed-Ulysses style; absent in
@@ -511,7 +527,7 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
         def body(qs, kgs, vgs):
             me = lax.axis_index(axis)
-            qf = local_q(qs)
+            qf = local_q(qs, hkv)
             scores = _chunk_scores(qf, kgs, me * s_loc, 0, causal)
             m = jnp.max(scores, axis=-1)
             p = jnp.exp(scores - m[..., None])
