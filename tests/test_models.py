@@ -366,10 +366,75 @@ def test_paged_kv_pool_exhaustion(mesh8):
                               slots_per_dev=4)  # room for 2 seqs only
     mgr.alloc_seq(0)
     mgr.alloc_seq(1)
+    tops = mgr._top.copy()
     with pytest.raises(RuntimeError, match="exhausted"):
         mgr.alloc_seq(2)
+    # All-or-nothing: the failed alloc must not leak pages (the first
+    # Python implementation lost the already-popped devices' slots).
+    np.testing.assert_array_equal(mgr._top, tops)
     mgr.free_seq(1)
     mgr.alloc_seq(2)  # freed slots are reusable
+
+
+def test_paged_kv_native_python_parity(mesh8):
+    """The C allocator (csrc/kvpool) and the Python fallback replay a
+    randomized alloc/free trace bit-identically (stacks, tops, tables,
+    owned flags)."""
+    from triton_dist_tpu.models import kv_native
+    from triton_dist_tpu.models.kv_cache import PagedKVCacheManager
+
+    if not kv_native.have_native():
+        pytest.skip("no native toolchain")
+
+    def build():
+        return PagedKVCacheManager(1, 8, 4, 2, 2, 8, mesh=mesh8,
+                                   axis="tp", slots_per_dev=20)
+
+    nat, py = build(), build()
+    assert nat._lib is not None
+    py._lib = None  # force the Python fallback on identical init state
+
+    rng = np.random.RandomState(0)
+    live = set()
+    for _ in range(200):
+        b = int(rng.randint(0, 8))
+        for m in (nat, py):
+            try:
+                if b in live:
+                    m.free_seq(b)
+                else:
+                    m.alloc_seq(b)
+                ok = True
+            except RuntimeError:
+                ok = False
+        live.symmetric_difference_update({b} if ok else set())
+        np.testing.assert_array_equal(nat._stack, py._stack)
+        np.testing.assert_array_equal(nat._top, py._top)
+        np.testing.assert_array_equal(nat._table, py._table)
+        np.testing.assert_array_equal(nat._owned, py._owned)
+
+
+def test_paged_kv_alloc_many_rollback(mesh8):
+    """Admission control is transactional: a request that cannot fully
+    fit rolls back every row it touched."""
+    from triton_dist_tpu.models.kv_cache import PagedKVCacheManager
+    for force_py in (False, True):
+        mgr = PagedKVCacheManager(1, 4, 4, 2, 2, 8, mesh=mesh8,
+                                  axis="tp", slots_per_dev=6)  # 3 seqs
+        if force_py:
+            mgr._lib = None
+        state = (mgr._stack.copy(), mgr._top.copy(), mgr._owned.copy())
+        with pytest.raises(RuntimeError):
+            mgr.alloc_many([0, 1, 2, 3])  # needs 8 pages, pool has 6
+        # Transactional = same tops/ownership and same free SET per
+        # device (rollback may reorder the stack, which is harmless).
+        np.testing.assert_array_equal(mgr._top, state[1])
+        np.testing.assert_array_equal(mgr._owned, state[2])
+        for r in range(mgr.world):
+            assert (set(mgr._stack[r, :mgr._top[r]])
+                    == set(state[0][r, :state[1][r]]))
+        mgr.alloc_many([0, 1, 2])  # exactly fits
+        assert mgr._owned[:3].all() and not mgr._owned[3]
 
 
 def test_checkpoint_roundtrip(mesh8, key, tmp_path):

@@ -1,0 +1,87 @@
+// Paged-KV slot allocator: per-device free stacks + block tables.
+//
+// TPU-native serving keeps the KV pool as sharded device arrays
+// (models/kv_cache.py PagedKVCacheManager); the ALLOCATOR is pure host
+// bookkeeping on the serving hot path (admit/evict per request), which
+// the reference keeps native alongside its runtime (csrc/, SURVEY §2.1)
+// — so it is native here too, ctypes-bound with a bit-identical Python
+// fallback (tests assert parity on randomized alloc/free traces).
+//
+// State (caller-owned numpy buffers, int32 unless noted):
+//   stack[world][slots]  per-device free stacks; valid entries [0, top)
+//   top[world]           stack depths
+//   table[world][batch][pages]  block tables (device-local slot ids)
+//   owned[batch] (uint8) rows currently holding an allocation
+//
+// All-or-nothing semantics: a request that cannot be satisfied on EVERY
+// device changes nothing (the first Python implementation leaked the
+// already-popped devices' pages on mid-loop exhaustion).
+//
+// Build: g++ -shared -fPIC -O2 -o libtdtkv.so kvpool.cc
+
+#include <cstdint>
+
+extern "C" {
+
+// Fill the stacks: slot ids ascending so pops hand out slots-1 first
+// (matches the Python list.pop() order for replay parity).
+int32_t tdt_kv_init(int32_t world, int32_t slots, int32_t* stack,
+                    int32_t* top) {
+  if (world <= 0 || slots <= 0) return -1;
+  for (int32_t r = 0; r < world; ++r) {
+    top[r] = slots;
+    for (int32_t i = 0; i < slots; ++i) stack[r * slots + i] = i;
+  }
+  return 0;
+}
+
+// Reserve `pages` slots on every device for row b.
+// Returns 0, -1 (bad row / already owned), -2 (some device exhausted;
+// nothing popped).
+int32_t tdt_kv_alloc_seq(int32_t world, int32_t batch, int32_t pages,
+                         int32_t slots, int32_t* stack, int32_t* top,
+                         int32_t* table, uint8_t* owned, int32_t b) {
+  if (b < 0 || b >= batch || owned[b]) return -1;
+  for (int32_t r = 0; r < world; ++r)
+    if (top[r] < pages) return -2;
+  for (int32_t r = 0; r < world; ++r)
+    for (int32_t i = 0; i < pages; ++i)
+      table[(r * batch + b) * pages + i] = stack[r * slots + --top[r]];
+  owned[b] = 1;
+  return 0;
+}
+
+// Release row b's slots (pushed back in table order, matching the
+// Python fallback so later pops replay identically).
+int32_t tdt_kv_free_seq(int32_t world, int32_t batch, int32_t pages,
+                        int32_t slots, int32_t* stack, int32_t* top,
+                        int32_t* table, uint8_t* owned, int32_t b) {
+  if (b < 0 || b >= batch || !owned[b]) return -1;
+  for (int32_t r = 0; r < world; ++r)
+    for (int32_t i = 0; i < pages; ++i)
+      stack[r * slots + top[r]++] = table[(r * batch + b) * pages + i];
+  owned[b] = 0;
+  return 0;
+}
+
+// Admission control: all-or-nothing over a REQUEST of n rows — if any
+// row fails, every row allocated by this call is rolled back.
+// Returns 0 or the failing row's error (-1/-2).
+int32_t tdt_kv_alloc_many(int32_t world, int32_t batch, int32_t pages,
+                          int32_t slots, int32_t* stack, int32_t* top,
+                          int32_t* table, uint8_t* owned,
+                          const int32_t* rows, int32_t n) {
+  for (int32_t j = 0; j < n; ++j) {
+    int32_t rc = tdt_kv_alloc_seq(world, batch, pages, slots, stack, top,
+                                  table, owned, rows[j]);
+    if (rc != 0) {
+      for (int32_t k = 0; k < j; ++k)
+        tdt_kv_free_seq(world, batch, pages, slots, stack, top, table,
+                        owned, rows[k]);
+      return rc;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 
 import numpy as np
 
@@ -22,27 +21,18 @@ _LIB = None
 _TRIED = False
 
 
+def _configure(lib):
+    lib.tdt_toposort.restype = ctypes.c_int32
+    lib.tdt_wavefronts.restype = ctypes.c_int32
+    lib.tdt_schedule_critical_path.restype = ctypes.c_int64
+
+
 def _load():
     global _LIB, _TRIED
-    if _TRIED:
-        return _LIB
-    _TRIED = True
-    src, so = os.path.abspath(_SRC), os.path.abspath(_SO)
-    try:
-        if (not os.path.exists(so)
-                or os.path.getmtime(so) < os.path.getmtime(src)):
-            subprocess.run(
-                ["g++", "-shared", "-fPIC", "-O2", "-o", so, src],
-                check=True, capture_output=True)
-        lib = ctypes.CDLL(so)
-        lib.tdt_toposort.restype = ctypes.c_int32
-        lib.tdt_wavefronts.restype = ctypes.c_int32
-        lib.tdt_schedule_critical_path.restype = ctypes.c_int64
-        _LIB = lib
-    except (OSError, subprocess.CalledProcessError, AttributeError):
-        # AttributeError: a stale prebuilt .so missing a newer symbol —
-        # fall back to Python rather than crash on first native call.
-        _LIB = None
+    if not _TRIED:
+        _TRIED = True
+        from triton_dist_tpu.runtime.native_lib import load_native
+        _LIB = load_native(_SRC, _SO, _configure)
     return _LIB
 
 

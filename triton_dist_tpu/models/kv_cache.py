@@ -95,35 +95,106 @@ class PagedKVCacheManager:
                               else batch * pages_per_seq_dev)
         assert self.slots_per_dev >= pages_per_seq_dev, "pool too small"
         self.offset = 0
-        # Host-side allocator state: per-device free lists + per-seq maps.
+        # Host-side allocator state (numpy buffers shared verbatim with
+        # the native allocator, csrc/kvpool/kvpool.cc): per-device free
+        # STACKS + block tables + per-row owned flags. The serving hot
+        # path (admit/evict) runs these through the C library when a
+        # toolchain exists; the Python fallback below is bit-identical
+        # (tests replay randomized traces through both).
         import numpy as np
-        self._free = [list(range(self.slots_per_dev))
-                      for _ in range(self.world)]
-        self._table = np.zeros((self.world, batch, pages_per_seq_dev),
-                               np.int32)
-        self._owned: dict[int, list] = {}
+        w, slots = self.world, self.slots_per_dev
+        self._stack = np.empty((w, slots), np.int32)
+        self._top = np.empty((w,), np.int32)
+        self._table = np.zeros((w, batch, pages_per_seq_dev), np.int32)
+        self._owned = np.zeros((batch,), np.uint8)
+        from triton_dist_tpu.models import kv_native
+        self._lib = kv_native._load()
+        ok = (self._lib is not None
+              and self._lib.tdt_kv_init(w, slots, self._stack,
+                                        self._top) == 0)
+        if not ok:  # no toolchain OR degenerate dims the C init rejects
+            self._lib = None
+            self._top[:] = slots
+            self._stack[:] = np.arange(slots, dtype=np.int32)
         self._table_dev = None  # device copy, invalidated on alloc/free
+
+    def _args(self):
+        return (self.world, self.batch, self.pages_per_seq_dev,
+                self.slots_per_dev, self._stack, self._top, self._table,
+                self._owned)
+
+    @staticmethod
+    def _raise(rc: int, what: str):
+        if rc == -1:
+            raise RuntimeError(f"row {what}: not allocatable/freeable "
+                               "(bad index or ownership state)")
+        if rc == -2:
+            raise RuntimeError(f"row {what}: device pool exhausted")
 
     # -- allocation (vLLM-style; host-side) --------------------------------
     def alloc_seq(self, b: int) -> None:
-        """Reserve every logical page of row ``b`` on every device.
-        (Lazy page-at-a-time allocation would also fit this table; the
-        decode kernel only reads slots below kv_len.)"""
-        assert b not in self._owned
-        pages = []
-        for r in range(self.world):
-            if len(self._free[r]) < self.pages_per_seq_dev:
-                raise RuntimeError(f"device {r} pool exhausted")
-            for i in range(self.pages_per_seq_dev):
-                slot = self._free[r].pop()
-                self._table[r, b, i] = slot
-                pages.append((r, slot))
-        self._owned[b] = pages
+        """Reserve every logical page of row ``b`` on every device —
+        all-or-nothing (exhaustion changes no state). (Lazy
+        page-at-a-time allocation would also fit this table; the decode
+        kernel only reads slots below kv_len.)"""
+        if self._lib is not None:
+            rc = self._lib.tdt_kv_alloc_seq(*self._args(), b)
+        else:
+            rc = self._py_alloc_seq(b)
+        self._raise(rc, str(b))
         self._table_dev = None
 
+    def _py_alloc_seq(self, b: int) -> int:
+        if not (0 <= b < self.batch) or self._owned[b]:
+            return -1
+        pages = self.pages_per_seq_dev
+        if any(self._top[r] < pages for r in range(self.world)):
+            return -2  # check EVERY device first: no partial pops
+        for r in range(self.world):
+            for i in range(pages):
+                self._top[r] -= 1
+                self._table[r, b, i] = self._stack[r, self._top[r]]
+        self._owned[b] = 1
+        return 0
+
     def free_seq(self, b: int) -> None:
-        for r, slot in self._owned.pop(b):
-            self._free[r].append(slot)
+        if self._lib is not None:
+            rc = self._lib.tdt_kv_free_seq(*self._args(), b)
+        else:
+            rc = self._py_free_seq(b)
+        self._raise(rc, str(b))
+        self._table_dev = None
+
+    def _py_free_seq(self, b: int) -> int:
+        if not (0 <= b < self.batch) or not self._owned[b]:
+            return -1
+        for r in range(self.world):
+            for i in range(self.pages_per_seq_dev):
+                self._stack[r, self._top[r]] = self._table[r, b, i]
+                self._top[r] += 1
+        self._owned[b] = 0
+        return 0
+
+    def alloc_many(self, rows) -> None:
+        """Admission control: allocate a whole REQUEST of rows
+        all-or-nothing — on any failure every row of this call is
+        rolled back before raising."""
+        import numpy as np
+        rows = np.asarray(list(rows), np.int32)
+        if self._lib is not None:
+            rc = self._lib.tdt_kv_alloc_many(*self._args(), rows,
+                                             len(rows))
+        else:
+            rc = 0
+            done = []
+            for b in rows:
+                rc = self._py_alloc_seq(int(b))
+                if rc != 0:
+                    for k in done:
+                        self._py_free_seq(k)
+                    break
+                done.append(int(b))
+        self._raise(rc, str(list(map(int, rows))))
         self._table_dev = None
 
     def block_table(self) -> jax.Array:

@@ -1,0 +1,49 @@
+"""ctypes bindings for the native paged-KV allocator (csrc/kvpool).
+
+Same pattern as ``mega.native`` (shared loader:
+``runtime.native_lib.load_native``): compile-on-first-use with g++,
+fall back to bit-identical Python when no toolchain is available
+(tests/test_models.py asserts parity on randomized alloc/free traces).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from triton_dist_tpu.runtime.native_lib import load_native
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "csrc", "kvpool",
+                    "kvpool.cc")
+_SO = os.path.join(os.path.dirname(_SRC), "libtdtkv.so")
+_LIB = None
+_TRIED = False
+
+_I32P = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_U8P = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+
+def _configure(lib):
+    state = [_I32P, _I32P, _I32P, _U8P]
+    lib.tdt_kv_init.restype = ctypes.c_int32
+    lib.tdt_kv_init.argtypes = [ctypes.c_int32] * 2 + [_I32P, _I32P]
+    for fn in (lib.tdt_kv_alloc_seq, lib.tdt_kv_free_seq):
+        fn.restype = ctypes.c_int32
+        fn.argtypes = [ctypes.c_int32] * 4 + state + [ctypes.c_int32]
+    lib.tdt_kv_alloc_many.restype = ctypes.c_int32
+    lib.tdt_kv_alloc_many.argtypes = (
+        [ctypes.c_int32] * 4 + state + [_I32P, ctypes.c_int32])
+
+
+def _load():
+    global _LIB, _TRIED
+    if not _TRIED:
+        _TRIED = True
+        _LIB = load_native(_SRC, _SO, _configure)
+    return _LIB
+
+
+def have_native() -> bool:
+    return _load() is not None

@@ -1,0 +1,33 @@
+"""Shared compile-on-first-use loader for the csrc/ C++ components.
+
+One definition of the build/load dance (mtime-checked g++ -shared
+rebuild, ctypes load, graceful fallback to None) so fixes to it reach
+every native module — mega/native.py and models/kv_native.py both had
+a copy before.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+
+def load_native(src: str, so: str, configure) -> ctypes.CDLL | None:
+    """Build ``so`` from ``src`` if stale, load it, apply ``configure``
+    (sets restype/argtypes; an AttributeError there means a stale
+    prebuilt .so missing a newer symbol). Returns None when any step
+    fails — callers fall back to their Python implementations.
+    """
+    src, so = os.path.abspath(src), os.path.abspath(so)
+    try:
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            subprocess.run(
+                ["g++", "-shared", "-fPIC", "-O2", "-o", so, src],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(so)
+        configure(lib)
+        return lib
+    except (OSError, subprocess.CalledProcessError, AttributeError):
+        return None
