@@ -373,6 +373,58 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         return f(ad, bd)
     case("dp_compose/nested", dp_nested)
 
+    def sp_model_step():
+        # Model-level SP (round 3): forward_sp prefill + one flash-
+        # decode step over the seq-sharded cache. world=1 on the bench
+        # chip; the pallas flash-decode path still compiles.
+        from triton_dist_tpu.models import DenseLLM, ModelConfig
+        from triton_dist_tpu.models.kv_cache import KVCacheManager
+        mesh2 = Mesh(np.array(devices[:1]).reshape(1, 1), ("tp", "sp"))
+        cfg = ModelConfig(hidden_size=512, intermediate_size=1024,
+                          num_hidden_layers=2, num_attention_heads=8,
+                          num_key_value_heads=4, head_dim=64,
+                          vocab_size=2048, max_position_embeddings=512,
+                          dtype=bf16)
+        model = DenseLLM(cfg, mesh=mesh2, axis="tp", sp_axis="sp",
+                         impl="pallas", fwd_mode="sp")
+        params = model.init(jax.random.PRNGKey(30))
+        kv = KVCacheManager(cfg.num_hidden_layers, 2,
+                            cfg.max_position_embeddings,
+                            cfg.num_key_value_heads, cfg.head_dim,
+                            mesh=mesh2, axis="sp", seq_shard=True,
+                            dtype=bf16)
+        ids = jax.random.randint(jax.random.PRNGKey(31), (2, 256), 0,
+                                 2048, jnp.int32)
+        lo, caches = jax.jit(
+            lambda p, i, c: model.forward(p, i, c, 0, mode="sp"))(
+            params, ids, kv.init())
+        dec, _ = jax.jit(
+            lambda p, i, c: model.forward(p, i, c, 256, mode="sp"))(
+            params, ids[:, :1], caches)
+        return lo, dec
+    case("sp_model/prefill_decode", sp_model_step)
+
+    def train_step():
+        # Fused-mode training step (round 3): compiles the TRANSPOSE
+        # fused kernels in the backward (ops/autodiff.py) on the chip —
+        # forward AG-GEMM/GEMM-RS plus their GEMM-RS/AG-GEMM adjoints.
+        from triton_dist_tpu.models import (DenseLLM, ModelConfig,
+                                            make_train_step)
+        cfg = ModelConfig(hidden_size=512, intermediate_size=1024,
+                          num_hidden_layers=2, num_attention_heads=8,
+                          num_key_value_heads=4, head_dim=64,
+                          vocab_size=2048, max_position_embeddings=256,
+                          dtype=bf16)
+        model = DenseLLM(cfg, mesh=mesh, axis="tp", impl="pallas",
+                         fwd_mode="ag_rs")
+        params = model.init(jax.random.PRNGKey(32))
+        step, init_opt = make_train_step(model, mode="ag_rs")
+        batch = {"input_ids": jax.random.randint(
+            jax.random.PRNGKey(33), (2, 128), 0, 2048, jnp.int32)}
+        _, _, metrics = step(params, init_opt(params), batch)
+        return metrics
+    case("train/fused_step", train_step)
+
     # --- report -----------------------------------------------------------
     if list_only:
         return 0
