@@ -55,3 +55,18 @@ def test_finetune_cli_end_to_end(hf_dir, tmp_path, capsys):
                   "--mode", "xla", "--impl", "xla",
                   "--resume", str(out), "--log-every", "1"])
     assert np.isfinite(last2) and last2 < first
+
+
+def test_finetune_cli_bin_shard(hf_dir, tmp_path):
+    """--data *.bin routes through the memory-mapped TokenDataset
+    (native shuffled-epoch batching) end-to-end."""
+    from triton_dist_tpu.tools.data import pack_tokens
+    from triton_dist_tpu.tools.finetune import main
+
+    ids = (np.arange(4096) % 128).astype(np.int32)
+    shard = pack_tokens(ids, str(tmp_path / "corpus.bin"))
+    last = main(["--model", hf_dir, "--data", shard,
+                 "--out", str(tmp_path / "ckpt"), "--steps", "3",
+                 "--batch", "2", "--seq", "32", "--lr", "1e-3",
+                 "--mode", "xla", "--impl", "xla", "--log-every", "1"])
+    assert np.isfinite(last)

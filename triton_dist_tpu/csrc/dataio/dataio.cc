@@ -1,0 +1,56 @@
+// Token-shard data loader: deterministic shuffled epochs + batch gather.
+//
+// Training IO for the finetune path (tools/data.py): the corpus is a
+// flat int32 token file (memory-mapped on the Python side); an epoch is
+// a seeded Fisher-Yates permutation of its fixed-size chunks, and a
+// batch is a strided gather of chunk rows. Native like the reference's
+// csrc host utilities, with a bit-identical Python fallback (parity
+// asserted in tests/test_data.py).
+//
+// Build: g++ -shared -fPIC -O2 -o libtdtdata.so dataio.cc
+
+#include <cstdint>
+
+extern "C" {
+
+// splitmix64 — tiny, seedable, reproducible across platforms (and
+// trivially re-implementable in the Python fallback).
+static inline uint64_t mix(uint64_t* s) {
+  uint64_t z = (*s += 0x9e3779b97f4a7c15ull);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+  return z ^ (z >> 31);
+}
+
+// Seeded Fisher-Yates permutation of [0, n) into out.
+int32_t tdt_data_epoch_perm(int64_t n, uint64_t seed, int32_t* out) {
+  if (n <= 0 || n > INT32_MAX) return -1;
+  for (int64_t i = 0; i < n; ++i) out[i] = (int32_t)i;
+  uint64_t s = seed;
+  for (int64_t i = n - 1; i > 0; --i) {
+    int64_t j = (int64_t)(mix(&s) % (uint64_t)(i + 1));
+    int32_t t = out[i];
+    out[i] = out[j];
+    out[j] = t;
+  }
+  return 0;
+}
+
+// Gather `count` chunks of `chunk_len` tokens into out[count][chunk_len].
+// Chunk c covers data[c*chunk_len : (c+1)*chunk_len).
+int32_t tdt_data_gather(const int32_t* data, int64_t n_tokens,
+                        int64_t chunk_len, const int32_t* chunk_ids,
+                        int64_t count, int32_t* out) {
+  if (chunk_len <= 0) return -1;
+  const int64_t n_chunks = n_tokens / chunk_len;
+  for (int64_t i = 0; i < count; ++i) {
+    const int64_t c = chunk_ids[i];
+    if (c < 0 || c >= n_chunks) return -2;
+    const int32_t* src = data + c * chunk_len;
+    int32_t* dst = out + i * chunk_len;
+    for (int64_t t = 0; t < chunk_len; ++t) dst[t] = src[t];
+  }
+  return 0;
+}
+
+}  // extern "C"

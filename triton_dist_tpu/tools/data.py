@@ -1,0 +1,145 @@
+"""Token-shard dataset: pack → memory-map → shuffled epoch batches.
+
+The training-side IO pipeline (beyond-reference: the reference has no
+training, hence no loader). The corpus lives as a flat int32 ``.bin``
+token shard; reading memory-maps it (no copy of the corpus into RAM),
+and batching runs through the native loader (csrc/dataio: seeded
+Fisher-Yates epoch permutation + chunk gather) with a bit-identical
+Python fallback. Epochs are deterministic in (seed, epoch) — a resumed
+finetune run re-derives the exact batch order.
+
+    pack_tokens(ids, "corpus.bin")
+    ds = TokenDataset("corpus.bin", batch=4, seq=512)
+    for step, batch in zip(range(100), ds.batches(seed=0)):
+        ...  # batch: (4, 512) int32 numpy
+
+``tdt-finetune --data corpus.bin`` uses this path automatically.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from triton_dist_tpu.runtime.native_lib import load_native
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "csrc", "dataio",
+                    "dataio.cc")
+_SO = os.path.join(os.path.dirname(_SRC), "libtdtdata.so")
+_LIB = None
+_TRIED = False
+
+_I32P = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+
+
+def _configure(lib):
+    lib.tdt_data_epoch_perm.restype = ctypes.c_int32
+    lib.tdt_data_epoch_perm.argtypes = [ctypes.c_int64, ctypes.c_uint64,
+                                        _I32P]
+    lib.tdt_data_gather.restype = ctypes.c_int32
+    lib.tdt_data_gather.argtypes = [_I32P, ctypes.c_int64, ctypes.c_int64,
+                                    _I32P, ctypes.c_int64, _I32P]
+
+
+def _load():
+    global _LIB, _TRIED
+    if not _TRIED:
+        _TRIED = True
+        _LIB = load_native(_SRC, _SO, _configure)
+    return _LIB
+
+
+def have_native() -> bool:
+    return _load() is not None
+
+
+def _mix(state: int) -> tuple[int, int]:
+    """splitmix64 step — mirrors csrc/dataio exactly (parity-tested)."""
+    m = (1 << 64) - 1
+    state = (state + 0x9E3779B97F4A7C15) & m
+    z = state
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & m
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & m
+    return state, z ^ (z >> 31)
+
+
+def _py_epoch_perm(n: int, seed: int) -> np.ndarray:
+    out = np.arange(n, dtype=np.int32)
+    s = seed & ((1 << 64) - 1)
+    for i in range(n - 1, 0, -1):
+        s, r = _mix(s)
+        j = r % (i + 1)
+        out[i], out[j] = out[j], out[i]
+    return out
+
+
+def pack_tokens(ids, path: str) -> str:
+    """Write a flat int32 token shard."""
+    np.asarray(ids, np.int32).tofile(path)
+    return path
+
+
+class TokenDataset:
+    """Memory-mapped int32 token shard, chunked into (seq)-token rows."""
+
+    def __init__(self, path: str, batch: int, seq: int):
+        self.data = np.memmap(path, np.int32, mode="r")
+        self.batch, self.seq = batch, seq
+        self.n_chunks = len(self.data) // seq
+        if self.n_chunks < 1:
+            raise ValueError(
+                f"{path}: {len(self.data)} tokens < one {seq}-token chunk")
+        self._lib = _load()
+
+    def epoch_perm(self, seed: int, epoch: int) -> np.ndarray:
+        """Deterministic chunk order for (seed, epoch)."""
+        mixed = (seed * 0x100000001B3 + epoch) & ((1 << 64) - 1)
+        if self._lib is not None:
+            out = np.empty(self.n_chunks, np.int32)
+            rc = self._lib.tdt_data_epoch_perm(self.n_chunks, mixed, out)
+            assert rc == 0
+            return out
+        return _py_epoch_perm(self.n_chunks, mixed)
+
+    def gather(self, chunk_ids: np.ndarray) -> np.ndarray:
+        """(len(chunk_ids), seq) int32 rows."""
+        chunk_ids = np.ascontiguousarray(chunk_ids, np.int32)
+        if self._lib is not None:
+            out = np.empty((len(chunk_ids), self.seq), np.int32)
+            # the memmap is already a C-contiguous ndarray — passing it
+            # straight through keeps the corpus on disk (no copy)
+            rc = self._lib.tdt_data_gather(
+                self.data, len(self.data), self.seq,
+                chunk_ids, len(chunk_ids), out)
+            if rc != 0:
+                raise IndexError(f"chunk id out of range (rc={rc})")
+            return out
+        n = self.n_chunks
+        if (chunk_ids < 0).any() or (chunk_ids >= n).any():
+            raise IndexError("chunk id out of range (rc=-2)")
+        usable = self.data[:n * self.seq].reshape(n, self.seq)
+        return np.asarray(usable[chunk_ids])
+
+    def batches(self, seed: int = 0, start_batch: int = 0):
+        """Infinite deterministic batch stream: shuffled epochs of
+        (batch, seq) rows; a partial final batch rolls into the next
+        epoch's order.
+
+        ``start_batch`` fast-forwards the stream (permutation-index
+        math only, no gathers) so a resumed run continues with exactly
+        the batches the interrupted run never saw.
+        """
+        epoch, queue = 0, np.empty(0, np.int32)
+        skip = start_batch
+        while True:
+            while len(queue) < self.batch:
+                queue = np.concatenate(
+                    [queue, self.epoch_perm(seed, epoch)])
+                epoch += 1
+            if skip > 0:
+                skip -= 1
+            else:
+                yield self.gather(queue[:self.batch])
+            queue = queue[self.batch:]

@@ -35,8 +35,9 @@ def _tokenize(model_dir: str, text: str, vocab_size: int):
     return np.asarray(ids, np.int32) % vocab_size, source
 
 
-def _batches(ids, batch: int, seq: int):
-    """Cycle (B, S) next-token batches over the token stream."""
+def _batches(ids, batch: int, seq: int, start: int = 0):
+    """Cycle (B, S) next-token batches over the token stream;
+    ``start`` fast-forwards the cycle for deterministic resume."""
     import numpy as np
     n = batch * seq
     if len(ids) < n:
@@ -44,7 +45,7 @@ def _batches(ids, batch: int, seq: int):
         ids = np.tile(ids, reps)
     usable = len(ids) - len(ids) % n
     chunks = ids[:usable].reshape(-1, batch, seq)
-    i = 0
+    i = start
     while True:
         yield chunks[i % len(chunks)]
         i += 1
@@ -55,7 +56,10 @@ def main(argv=None):
         prog="tdt-finetune",
         description="finetune an HF checkpoint with the fused TP stack")
     ap.add_argument("--model", required=True, help="HF checkpoint dir")
-    ap.add_argument("--data", required=True, help="UTF-8 text file")
+    ap.add_argument("--data", required=True,
+                    help="UTF-8 text file, or a pre-packed int32 token "
+                         "shard (*.bin — memory-mapped, native shuffled "
+                         "epochs; see tools.data.pack_tokens)")
     ap.add_argument("--out", required=True, help="orbax checkpoint dir")
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--batch", type=int, default=4)
@@ -78,43 +82,71 @@ def main(argv=None):
     from triton_dist_tpu.models.checkpoint import load_params, save_params
     from triton_dist_tpu.runtime.dist import initialize_distributed
 
+    import numpy as np
+
     initialize_distributed({"tp": len(jax.devices())})
     model, params = AutoLLM.from_pretrained(args.model, fwd_mode=args.mode,
                                             impl=args.impl)
-    with open(args.data, encoding="utf-8") as f:
-        text = f.read()
-    ids, source = _tokenize(args.model, text, model.config.vocab_size)
-    if len(ids) == 0:
-        raise SystemExit(f"--data {args.data} produced no tokens")
-    print(f"[finetune] {len(ids)} tokens ({source}), "
-          f"{args.batch}x{args.seq} batches, mode={args.mode}")
+    vocab = model.config.vocab_size
 
     step, init_opt = make_train_step(
         model, optax.adamw(args.lr, mu_dtype=jax.numpy.float32),
         mode=args.mode, remat=args.remat)
     opt_state = init_opt(params)
+    step0 = 0
     if args.resume:
-        restored = load_params(args.resume, like={"params": params,
-                                                  "opt_state": opt_state})
+        like = {"params": params, "opt_state": opt_state,
+                "step": np.zeros((), np.int32)}  # 0-d array: orbax
+        # rejects bare numpy scalars
+        restored = load_params(args.resume, like=like)
         params, opt_state = restored["params"], restored["opt_state"]
-        print(f"[finetune] resumed from {args.resume}")
+        step0 = int(restored["step"])
+        print(f"[finetune] resumed from {args.resume} at step {step0}")
+
+    if args.data.endswith(".bin"):
+        # Pre-packed int32 token shard: memory-mapped, batched by the
+        # native loader (tools/data.py; seeded shuffled epochs). The
+        # resumed step count fast-forwards the deterministic stream so
+        # the run continues with batches the saved run never saw.
+        from triton_dist_tpu.tools.data import TokenDataset
+        ds = TokenDataset(args.data, args.batch, args.seq)
+        batch_iter = ds.batches(seed=0, start_batch=step0)
+        n_tokens, source = len(ds.data), "bin"
+    else:
+        with open(args.data, encoding="utf-8") as f:
+            text = f.read()
+        ids, source = _tokenize(args.model, text, vocab)
+        if len(ids) == 0:
+            raise SystemExit(f"--data {args.data} produced no tokens")
+        batch_iter = _batches(ids, args.batch, args.seq, start=step0)
+        n_tokens = len(ids)
+    print(f"[finetune] {n_tokens} tokens ({source}), "
+          f"{args.batch}x{args.seq} batches, mode={args.mode}")
 
     t0 = time.perf_counter()
     last = None
-    for i, chunk in zip(range(args.steps), _batches(ids, args.batch,
-                                                    args.seq)):
+    for i, chunk in zip(range(args.steps), batch_iter):
+        chunk = np.asarray(chunk)
+        if chunk.min() < 0 or chunk.max() >= vocab:
+            # XLA clamps out-of-range gather ids silently — training on
+            # a mis-packed shard must fail loudly instead.
+            raise SystemExit(
+                f"--data token ids outside [0, {vocab}) at step {i} "
+                f"(min {chunk.min()}, max {chunk.max()}): shard packed "
+                "with an incompatible tokenizer?")
         params, opt_state, m = step(params, opt_state,
                                     {"input_ids": jax.numpy.asarray(chunk)})
         last = float(m["loss"])
         if i % args.log_every == 0 or i == args.steps - 1:
             dt = time.perf_counter() - t0
             tps = (i + 1) * args.batch * args.seq / max(dt, 1e-9)
-            print(f"[finetune] step {i:>5} loss {last:.4f} "
+            print(f"[finetune] step {step0 + i:>5} loss {last:.4f} "
                   f"grad_norm {float(m['grad_norm']):.3f} "
                   f"({tps:,.0f} tok/s)", flush=True)
 
     save_params(os.path.abspath(args.out),
-                {"params": params, "opt_state": opt_state})
+                {"params": params, "opt_state": opt_state,
+                 "step": np.asarray(step0 + args.steps, np.int32)})
     print(f"[finetune] saved {args.out} (final loss {last:.4f})")
     return last
 
