@@ -126,7 +126,7 @@ class DenseLLM:
     # -- forward -----------------------------------------------------------
     def forward(self, params: dict, input_ids: jax.Array, kv_caches,
                 offset, mode: str | None = None, kv_start=None,
-                remat: bool = False):
+                remat: bool = False, block_table=None):
         """input_ids: (B, S) int32; kv_caches: [(k, v)] * L; offset: scalar
         write position. Returns (logits (B, S, V), new_caches).
 
@@ -147,7 +147,8 @@ class DenseLLM:
         if mode == "sp":
             assert kv_start is None, "mode='sp' has no ragged support yet"
             return self.forward_sp(params, input_ids, kv_caches, offset,
-                                   remat=remat)
+                                   remat=remat, block_table=block_table)
+        assert block_table is None, "paged caches need mode='sp'"
         b, s = input_ids.shape
         offset = jnp.asarray(offset, jnp.int32)
         position_ids = offset + jnp.tile(
@@ -180,7 +181,7 @@ class DenseLLM:
 
     # -- sequence-parallel forward (long-context path) ---------------------
     def forward_sp(self, params: dict, input_ids: jax.Array, kv_caches,
-                   offset, remat: bool = False):
+                   offset, remat: bool = False, block_table=None):
         """Sequence-parallel forward: the long-context path the reference
         serves with ``SpFlashDecodeLayer`` + AG-attention
         (sp_ag_attention_inter_node.py:504, sp_flash_decode_layer.py),
@@ -202,10 +203,20 @@ class DenseLLM:
         carries native transpose rules), so ``make_train_step(
         mode="sp")`` trains long sequences with S/w activation memory
         per device on top of the remat option.
+
+        ``block_table``: switches the caches to PAGED pools
+        (``PagedKVCacheManager`` layout: per-layer (pool_k, pool_v) of
+        (w·slots, page, Hkv, D) dim-0-sharded physical pages plus this
+        (w, B, n_pages) table) — prefill scatters the projected K/V
+        into the allocated pages, decode writes one position and runs
+        the paged distributed flash decode. vLLM-style slot reuse at
+        the whole-model level (Engine(paged=True)).
         """
         from jax.sharding import NamedSharding
-        from triton_dist_tpu.ops.flash_decode import gqa_fwd_batch_decode
+        from triton_dist_tpu.ops.flash_decode import (
+            gqa_fwd_batch_decode, gqa_fwd_batch_decode_paged)
         from triton_dist_tpu.ops.sp_attention import sp_ag_attention
+        from triton_dist_tpu.ops.common import nestable_shard_map
 
         assert self.sp_axis is not None, (
             "build DenseLLM(sp_axis=...) to use mode='sp'")
@@ -257,13 +268,37 @@ class DenseLLM:
             # this whole write chain — prefill attention reads the
             # just-projected k/v, not the cache.)
             csh = P() if decode else P(None, sp, None, None)
-            ck = jax.lax.dynamic_update_slice(
-                ck, constrain(k, csh).astype(ck.dtype), (0, offset, 0, 0))
-            cv = jax.lax.dynamic_update_slice(
-                cv, constrain(v, csh).astype(cv.dtype), (0, offset, 0, 0))
+            kc = constrain(k, csh).astype(ck.dtype)
+            vc = constrain(v, csh).astype(cv.dtype)
+            if block_table is None:
+                ck = jax.lax.dynamic_update_slice(ck, kc,
+                                                  (0, offset, 0, 0))
+                cv = jax.lax.dynamic_update_slice(cv, vc,
+                                                  (0, offset, 0, 0))
+            elif decode:
+                # Single-position paged write — the address math lives
+                # in ONE place (PagedKVCacheManager.position_to_slot).
+                from triton_dist_tpu.models.kv_cache import (
+                    PagedKVCacheManager)
+                g, ip = PagedKVCacheManager.position_to_slot(
+                    block_table, offset, ck.shape[1],
+                    ck.shape[0] // self.mesh.shape[sp])
+                ck = ck.at[g, ip].set(kc[:, 0])
+                cv = cv.at[g, ip].set(vc[:, 0])
+            else:
+                ck = self._paged_scatter(ck, kc, block_table,
+                                         nestable_shard_map)
+                cv = self._paged_scatter(cv, vc, block_table,
+                                         nestable_shard_map)
             if decode:
-                att = gqa_fwd_batch_decode(q[:, 0], ck, cv, offset + 1,
-                                           self.fd_ctx, impl=self.fd_impl)
+                if block_table is None:
+                    att = gqa_fwd_batch_decode(q[:, 0], ck, cv,
+                                               offset + 1, self.fd_ctx,
+                                               impl=self.fd_impl)
+                else:
+                    att = gqa_fwd_batch_decode_paged(
+                        q[:, 0], ck, cv, block_table, offset + 1,
+                        self.fd_ctx)
                 att = att[:, None]
             else:
                 # Ring attention over the JUST-projected K/V: the SP
@@ -292,6 +327,44 @@ class DenseLLM:
         logits = jnp.einsum("bsh,vh->bsv", x.astype(jnp.float32),
                             params["lm_head"].astype(jnp.float32))
         return logits, new_caches
+
+    def _paged_scatter(self, pool, kv, table, shard_map_fn):
+        """Scatter a (B, S, Hkv, D) seq-sharded prefill K/V into the
+        paged pool: stage into the cache's position space (zeros past
+        S), then each device moves its t_loc positions into its
+        allocated page slots — a purely local scatter (the allocator
+        guarantees distinct (row, page) → distinct slots).
+
+        Known cost: staging + scatter are O(max_seq) per layer, not
+        O(S) — a short prompt in a large-capacity engine rewrites the
+        zero tail of every allocated page. Acceptable while prefill is
+        single-shot (one scatter per serve); a page-granular scatter
+        bounded by ceil(S/page) needs per-device drop-masked indices
+        (the position spaces of K (S/w blocks) and the cache (t_loc
+        blocks) disagree when S < capacity) — optimization candidate.
+        """
+        sp = self.sp_axis
+        world = self.mesh.shape[sp]
+        b, s = kv.shape[0], kv.shape[1]
+        page, hkv, d = pool.shape[1], pool.shape[2], pool.shape[3]
+        n_pages = table.shape[2]
+        t_total = page * n_pages * world
+        assert s <= t_total, f"prefill {s} > paged capacity {t_total}"
+        staged = jnp.zeros((b, t_total, hkv, d), pool.dtype)
+        staged = jax.lax.with_sharding_constraint(
+            staged, jax.sharding.NamedSharding(self.mesh,
+                                               P(None, sp, None, None)))
+        staged = jax.lax.dynamic_update_slice(staged, kv, (0, 0, 0, 0))
+
+        def local(pool_l, st_l, tb_l):
+            pages = st_l.reshape(b, n_pages, page, hkv, d)
+            return pool_l.at[tb_l.reshape(-1)].set(
+                pages.reshape(b * n_pages, page, hkv, d))
+
+        return shard_map_fn(
+            local, mesh=self.mesh,
+            in_specs=(P(sp), P(None, sp), P(sp)),
+            out_specs=P(sp), check_vma=False)(pool, staged, table)
 
     # -- HF weights --------------------------------------------------------
     def load_hf_state_dict(self, state: dict) -> dict:

@@ -44,9 +44,11 @@ class Engine:
     def __init__(self, model, batch: int, max_seq: int,
                  prefill_mode: str = "xla_ar", decode_mode: str = "gemm_ar",
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-                 profile_dir: str | None = None, profile_steps: int = 64):
+                 profile_dir: str | None = None, profile_steps: int = 64,
+                 paged: bool = False, page_size: int = 16):
         self.model = model
         c = model.config
+        self.paged = paged
         if "sp" in (prefill_mode, decode_mode):
             # Sequence-parallel serving (long context): both phases must
             # share the sequence-sharded cache layout.
@@ -54,11 +56,28 @@ class Engine:
                 "mode='sp' applies to prefill and decode together")
             assert getattr(model, "sp_axis", None), (
                 "build the model with sp_axis=... for sp serving")
-            self.kv = KVCacheManager(
-                c.num_hidden_layers, batch, max_seq, c.num_key_value_heads,
-                c.head_dim, mesh=model.mesh, axis=model.sp_axis,
-                dtype=c.dtype, seq_shard=True)
+            if paged:
+                # vLLM-style paged pools: physical page slots + per-row
+                # block tables, admission-controlled per serve() call
+                # (models/kv_cache.PagedKVCacheManager + csrc/kvpool).
+                from triton_dist_tpu.models.kv_cache import (
+                    PagedKVCacheManager)
+                world = model.mesh.shape[model.sp_axis]
+                assert max_seq % (world * page_size) == 0, (
+                    f"max_seq {max_seq} must divide into "
+                    f"{world} devices x {page_size}-token pages")
+                self.kv = PagedKVCacheManager(
+                    c.num_hidden_layers, batch, page_size,
+                    max_seq // (world * page_size),
+                    c.num_key_value_heads, c.head_dim, mesh=model.mesh,
+                    axis=model.sp_axis, dtype=c.dtype)
+            else:
+                self.kv = KVCacheManager(
+                    c.num_hidden_layers, batch, max_seq,
+                    c.num_key_value_heads, c.head_dim, mesh=model.mesh,
+                    axis=model.sp_axis, dtype=c.dtype, seq_shard=True)
         else:
+            assert not paged, "paged serving requires the sp modes"
             self.kv = KVCacheManager(
                 c.num_hidden_layers, batch, max_seq, c.num_key_value_heads,
                 c.head_dim, mesh=model.mesh, axis=model.axis, dtype=c.dtype)
@@ -81,10 +100,11 @@ class Engine:
         model, mode = self.model, self.decode_mode
 
         @jax.jit
-        def step(params, caches, token, offset, key, kv_start):
+        def step(params, caches, token, offset, key, kv_start, table):
             logits, caches = model.forward(
                 params, token[:, None], caches, offset, mode=mode,
-                kv_start=None if mode == "sp" else kv_start)
+                kv_start=None if mode == "sp" else kv_start,
+                **({"block_table": table} if table is not None else {}))
             nxt = sample_token(logits[:, -1], key, self.temperature,
                                self.top_k)
             return nxt, caches
@@ -97,10 +117,12 @@ class Engine:
         model, mode = self.model, self.decode_mode
 
         @jax.jit
-        def step(params, caches, token, offset, key, done, stop, kv_start):
+        def step(params, caches, token, offset, key, done, stop, kv_start,
+                 table):
             logits, caches = model.forward(
                 params, token[:, None], caches, offset, mode=mode,
-                kv_start=None if mode == "sp" else kv_start)
+                kv_start=None if mode == "sp" else kv_start,
+                **({"block_table": table} if table is not None else {}))
             nxt = sample_token(logits[:, -1], key, self.temperature,
                                self.top_k)
             nxt = jnp.where(done, token, nxt)
@@ -131,6 +153,15 @@ class Engine:
         kv_start = (jnp.zeros((b,), jnp.int32) if kv_start is None
                     else jnp.asarray(kv_start, jnp.int32))
         self.kv.reset()
+        table = None
+        if self.paged:
+            # Admission control per serve() call: release the previous
+            # call's rows, then reserve this batch's pages atomically
+            # (rollback on exhaustion — csrc/kvpool alloc_many).
+            for row in self.kv.owned_rows():
+                self.kv.free_seq(row)
+            self.kv.alloc_many(range(b))
+            table = self.kv.block_table()
         caches = self.kv.init()
 
         if self.prefill_mode == "sp":
@@ -138,7 +169,8 @@ class Engine:
             assert not bool(kv_start.any()), "sp serving is non-ragged"
         logits, caches = self.model.forward(
             params, input_ids, caches, 0, mode=self.prefill_mode,
-            kv_start=None if self.prefill_mode == "sp" else kv_start)
+            kv_start=None if self.prefill_mode == "sp" else kv_start,
+            **({"block_table": table} if table is not None else {}))
         self.kv.inc_offset(s)
         token = sample_token(logits[:, -1], self.key, self.temperature,
                              self.top_k)
@@ -165,10 +197,10 @@ class Engine:
                 if has_stop:
                     token, caches, done = self._decode_step_stop(
                         params, caches, token, off, sub, done, stop,
-                        kv_start)
+                        kv_start, table)
                 else:
                     token, caches = self._decode_step(
-                        params, caches, token, off, sub, kv_start)
+                        params, caches, token, off, sub, kv_start, table)
                 self.kv.inc_offset(1)
                 out.append(token[:, None])
                 # the all-done check is a host sync; amortize it

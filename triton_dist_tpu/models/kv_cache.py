@@ -175,6 +175,10 @@ class PagedKVCacheManager:
         self._owned[b] = 0
         return 0
 
+    def owned_rows(self) -> list:
+        """Rows currently holding an allocation."""
+        return [int(b) for b in range(self.batch) if self._owned[b]]
+
     def alloc_many(self, rows) -> None:
         """Admission control: allocate a whole REQUEST of rows
         all-or-nothing — on any failure every row of this call is
@@ -217,6 +221,24 @@ class PagedKVCacheManager:
         # arrays are immutable — one zero transfer shared by all refs
         return [(z, z) for _ in range(self.num_layers)]
 
+    @staticmethod
+    def position_to_slot(table: jax.Array, offset, page_size: int,
+                         slots_per_dev: int):
+        """Global position → (global pool rows (B,), in-page row).
+
+        THE one definition of the page-layout address math — shared by
+        :meth:`write` and the model-level paged decode
+        (DenseLLM.forward_sp), so a layout change cannot silently
+        diverge between them.
+        """
+        offset = jnp.asarray(offset, jnp.int32)
+        n_pages = table.shape[2]
+        t_loc = page_size * n_pages
+        r = offset // t_loc
+        lp = (offset % t_loc) // page_size
+        gslots = r * slots_per_dev + table[r, :, lp]
+        return gslots, offset % page_size
+
     def write(self, pools, layer: int, new_k: jax.Array, new_v: jax.Array,
               offset, table: jax.Array) -> list:
         """Scatter one decode step's (B, Hkv, D) K/V into the pools at
@@ -229,13 +251,8 @@ class PagedKVCacheManager:
         ``alloc_seq`` (silent cross-sequence corruption).
         """
         pool_k, pool_v = pools[layer]
-        offset = jnp.asarray(offset, jnp.int32)
-        r = offset // self.t_loc
-        local = offset % self.t_loc
-        lp = local // self.page_size
-        inpage = local % self.page_size
-        slots = table[r, :, lp]                      # (B,) local slots
-        gslots = r * self.slots_per_dev + slots      # global pool rows
+        gslots, inpage = self.position_to_slot(
+            table, offset, self.page_size, self.slots_per_dev)
         pool_k = pool_k.at[gslots, inpage].set(new_k.astype(pool_k.dtype))
         pool_v = pool_v.at[gslots, inpage].set(new_v.astype(pool_v.dtype))
         out = list(pools)
