@@ -358,6 +358,13 @@ def test_paged_kv_cache_matches_contiguous(mesh8, key):
         impl="xla")
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-3, atol=2e-3)
+    # The paged XLA golden (contiguous view rebuilt via table gathers)
+    # must agree with both.
+    got_xla = gqa_fwd_batch_decode_paged(q, pools[0][0], pools[0][1],
+                                         mgr.block_table(), kv_len, ctx,
+                                         impl="xla")
+    np.testing.assert_allclose(np.asarray(got_xla), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
 
 
 def test_paged_kv_pool_exhaustion(mesh8):

@@ -150,9 +150,15 @@ def test_sp_2d_tp_x_sp(devices):
                     decode_mode="sp")
     eng_tp = Engine(model, batch=b, max_seq=64, prefill_mode="xla",
                     decode_mode="xla_ar")
+    out_tp = np.asarray(eng_tp.serve(params, ids, 5))
     np.testing.assert_array_equal(
-        np.asarray(eng_sp.serve(params, ids, 5)),
-        np.asarray(eng_tp.serve(params, ids, 5)))
+        np.asarray(eng_sp.serve(params, ids, 5)), out_tp)
+    # Paged serving composes with the 2-D grid too (head-replicated
+    # pools; the head gather folds into the cache-layout constraint).
+    eng_pg = Engine(model, batch=b, max_seq=64, prefill_mode="sp",
+                    decode_mode="sp", paged=True, page_size=4)
+    np.testing.assert_array_equal(
+        np.asarray(eng_pg.serve(params, ids, 5)), out_tp)
 
     losses = {}
     for mode in ("xla", "sp"):

@@ -298,7 +298,7 @@ class DenseLLM:
                 else:
                     att = gqa_fwd_batch_decode_paged(
                         q[:, 0], ck, cv, block_table, offset + 1,
-                        self.fd_ctx)
+                        self.fd_ctx, impl=self.fd_impl)
                 att = att[:, None]
             else:
                 # Ring attention over the JUST-projected K/V: the SP

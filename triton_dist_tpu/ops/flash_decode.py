@@ -463,8 +463,8 @@ def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
 def gqa_fwd_batch_decode_paged(q: jax.Array, pool_k: jax.Array,
                                pool_v: jax.Array, block_table: jax.Array,
                                kv_len: jax.Array,
-                               ctx: FlashDecodeContext | None = None
-                               ) -> jax.Array:
+                               ctx: FlashDecodeContext | None = None,
+                               impl: str = "pallas") -> jax.Array:
     """Paged-KV distributed decode (reference paged split-KV kernels,
     flash_decode.py:130-393 block_table/page_size :136,:203).
 
@@ -491,6 +491,27 @@ def gqa_fwd_batch_decode_paged(q: jax.Array, pool_k: jax.Array,
     groups = hq // hkv
     t_loc = n_pages * page_size
     kv_len = jnp.asarray(kv_len, jnp.int32)
+
+    if impl == "xla":
+        # Golden: reconstruct the contiguous (B, T, Hkv, D) view via
+        # table gathers (position → slot is the allocator's map), then
+        # run the contiguous xla decode. One big gather per step — the
+        # measuring stick and the fast CPU-mesh path, like the other
+        # ops' xla impls.
+        spd = pool_k.shape[0] // world
+        posn = jnp.arange(world * t_loc)
+        r = posn // t_loc
+        lp = (posn % t_loc) // page_size
+        ip = posn % page_size
+        g = r[:, None] * spd + block_table[r, :, lp]       # (T, B)
+        ck = pool_k[g, ip[:, None]].transpose(1, 0, 2, 3)  # (B, T, ...)
+        cv = pool_v[g, ip[:, None]].transpose(1, 0, 2, 3)
+        sh = jax.sharding.NamedSharding(mesh, P(None, axis))
+        return gqa_fwd_batch_decode(
+            q, jax.lax.with_sharding_constraint(ck, sh),
+            jax.lax.with_sharding_constraint(cv, sh), kv_len, ctx,
+            impl="xla")
+
     interpret = resolve_interpret(ctx.interpret)
 
     kernel = functools.partial(
