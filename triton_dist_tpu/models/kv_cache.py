@@ -224,19 +224,24 @@ class PagedKVCacheManager:
     @staticmethod
     def position_to_slot(table: jax.Array, offset, page_size: int,
                          slots_per_dev: int):
-        """Global position → (global pool rows (B,), in-page row).
+        """Global position(s) → (global pool rows, in-page row).
 
         THE one definition of the page-layout address math — shared by
-        :meth:`write` and the model-level paged decode
-        (DenseLLM.forward_sp), so a layout change cannot silently
-        diverge between them.
+        :meth:`write`, the model-level paged decode
+        (DenseLLM.forward_sp), and the paged flash-decode XLA golden
+        (ops/flash_decode.py), so a layout change cannot silently
+        diverge between them. ``offset`` may be a scalar (one decode
+        step → rows (B,)) or a vector of T positions (golden
+        reconstruction → rows (T, B)).
         """
         offset = jnp.asarray(offset, jnp.int32)
         n_pages = table.shape[2]
         t_loc = page_size * n_pages
         r = offset // t_loc
         lp = (offset % t_loc) // page_size
-        gslots = r * slots_per_dev + table[r, :, lp]
+        # expand_dims makes scalar r broadcast as (1,)+(B,)->(B,) and
+        # vector r as (T,1)+(T,B)->(T,B).
+        gslots = jnp.expand_dims(r * slots_per_dev, -1) + table[r, :, lp]
         return gslots, offset % page_size
 
     def write(self, pools, layer: int, new_k: jax.Array, new_v: jax.Array,

@@ -498,12 +498,11 @@ def gqa_fwd_batch_decode_paged(q: jax.Array, pool_k: jax.Array,
         # run the contiguous xla decode. One big gather per step — the
         # measuring stick and the fast CPU-mesh path, like the other
         # ops' xla impls.
+        from triton_dist_tpu.models.kv_cache import PagedKVCacheManager
         spd = pool_k.shape[0] // world
         posn = jnp.arange(world * t_loc)
-        r = posn // t_loc
-        lp = (posn % t_loc) // page_size
-        ip = posn % page_size
-        g = r[:, None] * spd + block_table[r, :, lp]       # (T, B)
+        g, ip = PagedKVCacheManager.position_to_slot(
+            block_table, posn, page_size, spd)             # (T, B), (T,)
         ck = pool_k[g, ip[:, None]].transpose(1, 0, 2, 3)  # (B, T, ...)
         cv = pool_v[g, ip[:, None]].transpose(1, 0, 2, 3)
         sh = jax.sharding.NamedSharding(mesh, P(None, axis))
