@@ -165,7 +165,8 @@ def _run_parts_in_children(extras: dict) -> None:
         try:
             with open(tmp_path) as f:
                 part = json.load(f).get("extras", {})
-            for key in ("fatal", "timing_selfcheck_error"):
+            for key in ("fatal", "timing_selfcheck",
+                        "timing_selfcheck_error"):
                 if key in part:  # attribute generic keys to their part
                     part[f"{name}_{key}"] = part.pop(key)
             extras.update(part)

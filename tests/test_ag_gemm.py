@@ -254,3 +254,68 @@ def test_gemm_ar_infeasible_config_degrades(mesh8, key):
     out = gemm_ar(a_s, b_s, ctx, impl="pallas")
     full = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
     np.testing.assert_allclose(np.asarray(out), full, rtol=1e-3, atol=1e-3)
+
+
+class TestAgSwiglu:
+    """Fused AG + dual-GEMM + SwiGLU (beyond-reference fusion; the
+    reference's TP_MLP runs AG-GEMM then a separate silu-mul,
+    tp_mlp.py:147-270)."""
+
+    @staticmethod
+    def _golden(a, wg, wu):
+        ag = np.asarray(a, np.float32)
+        g = ag @ np.asarray(wg, np.float32)
+        u = ag @ np.asarray(wu, np.float32)
+        return (g / (1 + np.exp(-g))) * u
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_fallback_shape(self, mesh8, key, dtype):
+        """Small shards route through the composed fallback."""
+        from triton_dist_tpu.ops.allgather_gemm import ag_swiglu
+        ka, kg, ku = jax.random.split(key, 3)
+        a = (jax.random.normal(ka, (M, K)) / 4).astype(dtype)
+        wg = (jax.random.normal(kg, (K, N)) / 4).astype(dtype)
+        wu = (jax.random.normal(ku, (K, N)) / 4).astype(dtype)
+        ctx = create_ag_gemm_context(mesh8)
+        got = ag_swiglu(a, wg, wu, ctx, impl="pallas")
+        ref = ag_swiglu(a, wg, wu, ctx, impl="xla")
+        assert got.shape == (M, N)
+        tol = 1e-5 if dtype == jnp.float32 else 5e-2
+        assert_allclose(got, ref, rtol=tol, atol=tol)
+        assert_allclose(got, self._golden(a, wg, wu), rtol=2e-2, atol=2e-1)
+
+    def test_kernel_shape(self, mesh8, key):
+        """128-divisible shards engage the single fused kernel."""
+        from triton_dist_tpu.ops.allgather_gemm import ag_swiglu
+        m, k, n = 1024, 64, 1024          # rows=128, n_loc=128
+        ka, kg, ku = jax.random.split(key, 3)
+        a = (jax.random.normal(ka, (m, k)) / 4).astype(jnp.float32)
+        wg = (jax.random.normal(kg, (k, n)) / 4).astype(jnp.float32)
+        wu = (jax.random.normal(ku, (k, n)) / 4).astype(jnp.float32)
+        ctx = create_ag_gemm_context(mesh8)
+        got = ag_swiglu(a, wg, wu, ctx, impl="pallas")
+        assert got.shape == (m, n)
+        assert_allclose(got, self._golden(a, wg, wu), rtol=1e-3,
+                        atol=1e-3)
+
+    def test_grad_parity(self, mesh8, key):
+        """VJP grads equal the differentiable composition's."""
+        from triton_dist_tpu.ops import autodiff as ad
+        ka, kg, ku, kd = jax.random.split(key, 4)
+        a = (jax.random.normal(ka, (M, K)) / 4).astype(jnp.float32)
+        wg = (jax.random.normal(kg, (K, N)) / 4).astype(jnp.float32)
+        wu = (jax.random.normal(ku, (K, N)) / 4).astype(jnp.float32)
+        ctx = create_ag_gemm_context(mesh8)
+
+        def fused(a, wg, wu):
+            return jnp.sum(ad.ag_swiglu(a, wg, wu, ctx, "pallas") ** 2)
+
+        def composed(a, wg, wu):
+            g, u = ad.ag_gemm_multi(a, [wg, wu], ctx, "pallas")
+            act = jax.nn.silu(g.astype(jnp.float32)).astype(a.dtype) * u
+            return jnp.sum(act.astype(jnp.float32) ** 2)
+
+        gf = jax.grad(fused, argnums=(0, 1, 2))(a, wg, wu)
+        gc = jax.grad(composed, argnums=(0, 1, 2))(a, wg, wu)
+        for x, y, name in zip(gf, gc, ("da", "dwg", "dwu")):
+            assert_allclose(x, y, rtol=2e-3, atol=2e-3)

@@ -176,3 +176,17 @@ def test_moe_reduce_rs_fused_bench_shape_fits(world):
         jax.ShapeDtypeStruct((e, inter, hid), bf16),
         jax.ShapeDtypeStruct((t * topk,), jnp.int32),
         jax.ShapeDtypeStruct((t, topk), jnp.float32))
+
+
+@pytest.mark.parametrize("world", [1, 8])
+def test_ag_swiglu_bench_shape_fits(world):
+    from triton_dist_tpu.ops.allgather_gemm import (
+        create_ag_gemm_context, ag_swiglu)
+    mesh = _mesh(world)
+    ctx = create_ag_gemm_context(mesh, "tp", interpret=True)
+    m, k, n = 2048, 4096, 4096  # tp_mlp bench: gate/up at (4096, 12288/w)
+    check_entry_vmem(
+        lambda a, wg, wu: ag_swiglu(a, wg, wu, ctx, impl="pallas"),
+        jax.ShapeDtypeStruct((m, k), bf16),
+        jax.ShapeDtypeStruct((k, n), bf16),
+        jax.ShapeDtypeStruct((k, n), bf16))

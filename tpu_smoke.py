@@ -190,6 +190,16 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     case("ag_gemm/injection",
          lambda: ag_gemm(a, b, inj_ctx, impl="pallas"))
 
+    # Fused AG + dual-GEMM + SwiGLU (the MLP front half as one kernel).
+    from triton_dist_tpu.ops.allgather_gemm import ag_swiglu
+    sw_ctx = create_ag_gemm_context(mesh, "tp", interpret=interpret)
+    case("ag_swiglu/small",
+         lambda: ag_swiglu(a, b, b, sw_ctx, impl="pallas"))
+    bu = sharded(randn((4096, 4096), k=17), P(None, "tp"))
+    sw_bench_ctx = create_ag_gemm_context(mesh, "tp", interpret=interpret)
+    case("ag_swiglu/bench_shape",
+         lambda: ag_swiglu(ab, bb, bu, sw_bench_ctx, impl="pallas"))
+
     from triton_dist_tpu.ops.gemm_reduce_scatter import (
         create_gemm_rs_context, gemm_rs, gemm_ar)
     rs_ctx2 = create_gemm_rs_context(mesh, "tp", interpret=interpret)

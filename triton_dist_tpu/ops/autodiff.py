@@ -112,6 +112,48 @@ def ag_gemm(a, b, ctx, impl="pallas"):
     return ag_gemm_multi(a, (b,), ctx, impl)[0]
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ag_swiglu(a, w_gate, w_up, ctx, impl="pallas"):
+    """Differentiable ``allgather_gemm.ag_swiglu`` (fused
+    AG + dual GEMM + SwiGLU). Backward recomputes gate/up with one
+    fused AG-GEMM pass (standard remat trade: the forward never stored
+    them — that is the point of the fusion), then routes dA through the
+    fused GEMM-RS transposes exactly like :func:`ag_gemm_multi`'s
+    backward."""
+    return _ag.ag_swiglu(a, w_gate, w_up, ctx, impl)
+
+
+def _swiglu_fwd(a, w_gate, w_up, ctx, impl):
+    return ag_swiglu(a, w_gate, w_up, ctx, impl), (a, w_gate, w_up)
+
+
+def _swiglu_bwd(ctx, impl, res, dact):
+    a, wg, wu = res
+    g, u = _ag.ag_gemm_multi(a, [wg, wu], ctx, impl)   # remat
+    g32 = g.astype(jnp.float32)
+    u32 = u.astype(jnp.float32)
+    d32 = dact.astype(jnp.float32)
+    s = jax.nn.sigmoid(g32)
+    dg = (d32 * u32 * (s + g32 * s * (1.0 - s))).astype(a.dtype)
+    du = (d32 * g32 * s).astype(a.dtype)
+    rs_ctx = _paired_ctx(ctx, _rs.create_gemm_rs_context)
+    da = (_rs.gemm_rs(dg, wg.T, rs_ctx, impl=impl)
+          + _rs.gemm_rs(du, wu.T, rs_ctx, impl=impl))
+    da = _constrain(da.astype(a.dtype), ctx.mesh, P(ctx.axis, None))
+    dwg = _constrain(jnp.dot(a.T, dg,
+                             preferred_element_type=ctx.acc_dtype
+                             ).astype(wg.dtype),
+                     ctx.mesh, P(None, ctx.axis))
+    dwu = _constrain(jnp.dot(a.T, du,
+                             preferred_element_type=ctx.acc_dtype
+                             ).astype(wu.dtype),
+                     ctx.mesh, P(None, ctx.axis))
+    return da, dwg, dwu
+
+
+ag_swiglu.defvjp(_swiglu_fwd, _swiglu_bwd)
+
+
 # -- GEMM-RS --------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
