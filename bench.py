@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
@@ -806,6 +807,14 @@ def main():
         _checkpoint_extras(extras, "fatal")
 
     print(json.dumps(result))
+    if only_env:
+        # Child mode (one sub-benchmark per process): hard-exit to skip
+        # JAX backend teardown. Teardown waits on the tunnel and has
+        # been observed to linger minutes-to-forever on a wedged remote
+        # (tpu_smoke 07-31); results are checkpointed + printed already.
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
 
 
 if __name__ == "__main__":

@@ -22,6 +22,7 @@ came up (same retry/partial contract as bench.py).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 import traceback
@@ -29,8 +30,16 @@ import traceback
 
 def _init_backend(retries: int = 3, backoff_s: float = 20.0):
     """jax.devices() with retry — the tunneled TPU backend can be
-    transiently UNAVAILABLE (BENCH_r01 died on exactly this)."""
+    transiently UNAVAILABLE (BENCH_r01 died on exactly this).
+
+    ``TDT_SMOKE_CPU=1`` forces the CPU backend (harness validation while
+    the tunnel is down). NOTE: must use jax.config — the JAX_PLATFORMS
+    env var does NOT prevent the axon plugin from dialing the tunnel
+    during plugin discovery (observed 07-31: `JAX_PLATFORMS=cpu
+    jax.devices()` hangs on a wedged tunnel; config.update works)."""
     import jax
+    if os.environ.get("TDT_SMOKE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
     last = None
     for attempt in range(retries):
         try:
@@ -451,43 +460,95 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
 
 
 def run_subproc(log_path: str, timeout_s: float,
-                skip: str | None = None) -> int:
+                skip: str | None = None,
+                start_after: str | None = None) -> int:
     """Run every case in its OWN subprocess with a hard deadline.
 
     A Mosaic compile hang through the tunnel has been observed to wedge
     the backend for hours (round 3); per-case isolation bounds the blast
-    radius: a hung case is killed and reported HANG instead of taking
-    the whole smoke (and possibly the tunnel session) with it."""
+    radius. Hung cases are ABANDONED, never killed: SIGKILLing a client
+    mid-compile is the known tunnel-wedge trigger (BENCH_NOTES_r3.md,
+    wedges #2/#3/#4).
+
+    Children run with ``--hard-exit`` (os._exit after flushing results),
+    skipping JAX backend teardown: a teardown that waits on the tunnel
+    has been observed to linger for minutes and once wedged the whole
+    run (03:23 on 07-31 — the case PASSed, the process never exited).
+    The case's own output is authoritative: a lingering child whose
+    output already says PASS/FAIL is scored as such and the run
+    CONTINUES; only a case with no written result is a genuine compile
+    hang, which stops the run (later compiles would only queue behind
+    the stuck one). ``--start-after`` resumes a stopped run."""
     import subprocess
     names = subprocess.run(
         [sys.executable, __file__, "--list"], capture_output=True,
         text=True, timeout=600).stdout.split()
     skips = [s for s in (skip or "").split(",") if s]
     names = [n for n in names if n not in skips]
+    if start_after:
+        assert start_after in names, f"{start_after!r} not in case list"
+        names = names[names.index(start_after) + 1:]
     n_fail = 0
     lines = []
-    for name in names:
-        t0 = time.perf_counter()
-        try:
-            r = subprocess.run(
-                [sys.executable, __file__, "--only", f"={name}",
-                 "--log", log_path + ".case"],
-                capture_output=True, text=True, timeout=timeout_s)
-            ok = r.returncode == 0
-            tail = [ln for ln in r.stdout.splitlines() if name in ln]
-            detail = tail[-1].split(None, 1)[-1] if tail else f"rc={r.returncode}"
-            status = "PASS" if ok else "FAIL"
-        except subprocess.TimeoutExpired:
-            status, detail = "HANG", f"killed after {timeout_s:.0f}s"
-        dt = time.perf_counter() - t0
-        n_fail += status != "PASS"
-        line = f"{name:<28} {status:<9} {dt:.0f}s {detail}"
+
+    def emit(line):
         lines.append(line)
         print(line, flush=True)
-    report = "\n".join(lines + [f"TOTAL {len(names)} ops, {n_fail} failing"])
+        with open(log_path + ".partial", "a") as f:
+            f.write(line + "\n")
+
+    def case_result(out_path, name):
+        """Parse the child's own result line: (status, detail) or None."""
+        try:
+            with open(out_path) as f:
+                for ln in f.read().splitlines():
+                    toks = ln.split()
+                    if toks[:1] == [name] and len(toks) >= 2 and \
+                            toks[1] in ("PASS", "FAIL"):
+                        return toks[1], " ".join(toks[2:])
+        except OSError:
+            pass
+        return None
+
+    stopped = False
+    for name in names:
+        t0 = time.perf_counter()
+        out_path = log_path + f".case_out.{name.replace('/', '_')}"
+        with open(out_path, "w") as out:
+            child = subprocess.Popen(
+                [sys.executable, __file__, "--only", f"={name}",
+                 "--hard-exit", "--log", log_path + ".case"],
+                stdout=out, stderr=subprocess.STDOUT)
+        hung = False
+        while child.poll() is None:
+            if time.perf_counter() - t0 > timeout_s:
+                hung = True
+                break  # abandon, never kill mid-compile
+            time.sleep(2.0)
+        dt = time.perf_counter() - t0
+        parsed = case_result(out_path, name)
+        if hung and parsed is None:
+            emit(f"{name:<28} {'HANG':<9} {dt:.0f}s abandoned after "
+                 f"{timeout_s:.0f}s (never killed; run stops here)")
+            n_fail += 1
+            stopped = True
+            break
+        if parsed is not None:
+            status, detail = parsed
+            if hung:
+                detail += " (teardown abandoned)"
+        else:
+            status = "PASS" if child.returncode == 0 else "FAIL"
+            detail = f"rc={child.returncode}"
+        if not hung:
+            os.unlink(out_path)
+        n_fail += status != "PASS"
+        emit(f"{name:<28} {status:<9} {dt:.0f}s {detail}")
+    report = "\n".join(lines + [f"TOTAL {len(names)} ops, {n_fail} failing"
+                                + (" [STOPPED on hang]" if stopped else "")])
     with open(log_path, "a") as f:
         f.write(report + "\n")
-    print(f"TOTAL {len(names)} ops, {n_fail} failing")
+    print(report.splitlines()[-1])
     return 1 if n_fail else 0
 
 
@@ -505,11 +566,23 @@ if __name__ == "__main__":
                     help="comma-separated exact case names to exclude "
                          "(e.g. risky never-compiled kernels, run last "
                          "separately)")
+    ap.add_argument("--start-after", default=None,
+                    help="resume a stopped --subproc run: skip every case "
+                         "up to and including this one")
+    ap.add_argument("--hard-exit", action="store_true",
+                    help="os._exit after writing results (skip JAX "
+                         "teardown — it can hang on a wedged tunnel)")
     args = ap.parse_args()
     if args.list:
         sys.exit(run_smoke(None, None, list_only=True))
     with open(args.log, "w") as f:
         f.write(f"tpu_smoke @ {time.strftime('%Y-%m-%d %H:%M:%S')}\n")
     if args.subproc:
-        sys.exit(run_subproc(args.log, args.case_timeout, skip=args.skip))
-    sys.exit(run_smoke(args.log, args.only, skip=args.skip))
+        sys.exit(run_subproc(args.log, args.case_timeout, skip=args.skip,
+                             start_after=args.start_after))
+    rc = run_smoke(args.log, args.only, skip=args.skip)
+    if args.hard_exit:
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(rc)
+    sys.exit(rc)
