@@ -188,12 +188,14 @@ def _sp_fused_kernel(q_ref, k_ref, v_ref, o_hbm, kw_hbm, vw_hbm, k_sub,
     # Row-folded q tiles: head h of q-tile i is a (B, sq_blk·G, D) slab —
     # every value in the flash inner loop stays ≤3-D with B as the single
     # dot batch dim (Mosaic: one-batch-dim matmuls, no 5-D relayouts).
+    # q arrives PRE-SLABBED as (n_q·hkv, B, rows, D) — the (seq, head) →
+    # slab permutation runs in XLA outside the kernel, so the kernel
+    # never reshapes (the in-kernel middle-dim reshape was the one
+    # construct the proven-compiling flash-decode kernels don't use).
     rows = sq_blk * groups
 
     def q_slab(i, h):
-        qi = q_ref[:, i * sq_blk:(i + 1) * sq_blk,
-                   h * groups:(h + 1) * groups, :]
-        return qi.reshape(batch, rows, d).astype(jnp.float32)
+        return q_ref[i * hkv + h].astype(jnp.float32)
 
     def consume_chunk(src):
         """Fold chunk ``src`` (already in the HBM workspace) into the
@@ -294,12 +296,11 @@ def _sp_fused_kernel(q_ref, k_ref, v_ref, o_hbm, kw_hbm, vw_hbm, k_sub,
         lax.fori_loop(0, world - 1, drain, None)
 
     def o_dma(slot, idx):
-        i, h = divmod(idx, hkv)
+        # Slab-shaped output: one contiguous (B, rows, D) block per
+        # (q-tile, head) — the un-permute back to (B, S, H, D) runs in
+        # XLA outside the kernel.
         return pltpu.make_async_copy(
-            o_stage.at[slot],
-            o_hbm.at[:, pl.ds(i * sq_blk, sq_blk),
-                     pl.ds(h * groups, groups), :],
-            o_sem.at[slot])
+            o_stage.at[slot], o_hbm.at[idx], o_sem.at[slot])
 
     n_slabs = n_q * hkv
     for idx in range(n_slabs):
@@ -307,8 +308,7 @@ def _sp_fused_kernel(q_ref, k_ref, v_ref, o_hbm, kw_hbm, vw_hbm, k_sub,
         slot = idx % 2
         if idx >= 2:
             o_dma(slot, idx - 2).wait()
-        o_stage[slot] = out.reshape(batch, sq_blk, groups,
-                                    d).astype(o_stage.dtype)
+        o_stage[slot] = out.astype(o_stage.dtype)
         o_dma(slot, idx).start()
     for idx in range(max(0, n_slabs - 2), n_slabs):
         o_dma(idx % 2, idx).wait()
@@ -340,10 +340,21 @@ def sp_ag_attention_fused(q: jax.Array, k: jax.Array, v: jax.Array,
         hkv=hkv, groups=groups, d=d, sq_blk=sq_blk, t_sub=t_sub,
         causal=ctx.causal)
 
+    n_q = s_loc // sq_blk
+    rows = sq_blk * groups
+    n_slabs = n_q * hkv
+
     def body(qs, ks, vs):
+        # (B, S_loc, Hq, D) → (n_q·hkv, B, sq_blk·G, D): slab s = (i, h)
+        # holds q-tile i of kv-head h with (seq, group) folded into rows.
+        # This permutation (and its inverse on the output) runs in XLA so
+        # the kernel body needs no reshapes at all.
+        qp = qs.reshape(b, n_q, sq_blk, hkv, groups, d)
+        qp = qp.transpose(1, 3, 0, 2, 4, 5).reshape(n_slabs, b, rows, d)
         out, *_ = pl.pallas_call(
             kernel,
-            out_shape=(jax.ShapeDtypeStruct((b, s_loc, hq, d), q.dtype),
+            out_shape=(jax.ShapeDtypeStruct((n_slabs, b, rows, d),
+                                            q.dtype),
                        jax.ShapeDtypeStruct((world, b, s_loc, hkv, d),
                                             k.dtype),
                        jax.ShapeDtypeStruct((world, b, s_loc, hkv, d),
@@ -354,13 +365,10 @@ def sp_ag_attention_fused(q: jax.Array, k: jax.Array, v: jax.Array,
             scratch_shapes=[
                 pltpu.VMEM((2, b, t_sub, hkv, d), k.dtype),
                 pltpu.VMEM((2, b, t_sub, hkv, d), v.dtype),
-                pltpu.VMEM((s_loc // sq_blk * hkv, b, sq_blk * groups),
-                           jnp.float32),
-                pltpu.VMEM((s_loc // sq_blk * hkv, b, sq_blk * groups),
-                           jnp.float32),
-                pltpu.VMEM((s_loc // sq_blk * hkv, b, sq_blk * groups, d),
-                           jnp.float32),
-                pltpu.VMEM((2, b, sq_blk, groups, d), q.dtype),
+                pltpu.VMEM((n_slabs, b, rows), jnp.float32),
+                pltpu.VMEM((n_slabs, b, rows), jnp.float32),
+                pltpu.VMEM((n_slabs, b, rows, d), jnp.float32),
+                pltpu.VMEM((2, b, rows, d), q.dtype),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
@@ -370,8 +378,9 @@ def sp_ag_attention_fused(q: jax.Array, k: jax.Array, v: jax.Array,
             ],
             compiler_params=comm_params(collective_id=6, world=world),
             interpret=interpret,
-        )(qs, ks, vs)
-        return out
+        )(qp, ks, vs)
+        out = out.reshape(n_q, hkv, b, sq_blk, groups, d)
+        return out.transpose(2, 0, 3, 1, 4, 5).reshape(b, s_loc, hq, d)
 
     f = nestable_shard_map(body, mesh=mesh,
                       in_specs=(P(None, axis),) * 3,
