@@ -1,0 +1,106 @@
+"""Tunnel-recovery watcher: probe the axon backend at low cadence and,
+the moment it answers, run the queued hardware evidence steps.
+
+Why: the tunneled TPU backend wedges for 1-12 h at a time (see
+BENCH_NOTES_r3.md); recovery windows are precious and must not be
+missed. The watcher holds NO jax session itself — every probe and every
+step is a fresh subprocess, and timed-out steps are ABANDONED, never
+killed (SIGKILL mid-compile is the known wedge trigger).
+
+Usage: nohup python scripts/hw_watch.py > hw_watch.out 2>&1 &
+Writes progress to hw_watch.log; exits after the queue drains or a step
+wedges the tunnel again (leaving the partial evidence on disk).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(ROOT, "hw_watch.log")
+
+# (name, argv, deadline_s) — run in order; stop the queue if a step
+# wedges (probe after each step to know).
+QUEUE = [
+    # Resume the stopped 07-31 03:30 smoke run: cases after
+    # allreduce/one_shot (which PASSed; its lingering teardown falsely
+    # stopped the old harness), minus the risky never-compiled ones.
+    ("smoke_resume",
+     [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "420",
+      "--start-after", "allreduce/one_shot",
+      "--skip", "ag_gemm_multi,train/fused_step,sp_ag_attention/pallas",
+      "--log", "tpu_smoke_r3_resume.log"],
+     3600.0),
+    # First on-chip compile of the restructured fused SP kernel, alone
+    # so a hang costs nothing else.
+    ("sp_pallas",
+     [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "600",
+      "--only", "=sp_ag_attention/pallas",
+      "--log", "tpu_smoke_r3_sp.log"],
+     900.0),
+]
+
+
+def log(msg: str) -> None:
+    line = f"{time.strftime('%H:%M:%S')} {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def probe(timeout_s: float = 60.0) -> bool:
+    """Fresh-process jax.devices() probe. Killing a probe stuck in INIT
+    (not compile) has been done dozens of times without consequence."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices())"],
+            capture_output=True, text=True, timeout=timeout_s, cwd=ROOT)
+        return p.returncode == 0 and "TPU" in p.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_step(name: str, argv: list[str], deadline_s: float) -> str:
+    log(f"step {name}: start")
+    child = subprocess.Popen(argv, cwd=ROOT, stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+    t0 = time.monotonic()
+    while child.poll() is None:
+        if time.monotonic() - t0 > deadline_s:
+            log(f"step {name}: deadline {deadline_s:.0f}s — ABANDONED "
+                f"(pid {child.pid} left alive)")
+            return "abandoned"
+        time.sleep(10.0)
+    log(f"step {name}: done rc={child.returncode}")
+    return "done"
+
+
+def main() -> None:
+    log(f"watcher up, {len(QUEUE)} steps queued")
+    i = 0
+    while i < len(QUEUE):
+        if not probe():
+            log("tunnel wedged; sleeping 300s")
+            time.sleep(300.0)
+            continue
+        log("tunnel ALIVE")
+        name, argv, deadline = QUEUE[i]
+        status = run_step(name, argv, deadline)
+        i += 1
+        if status == "abandoned":
+            # The abandoned child is still alive and owns the (single)
+            # TPU client slot; starting another step would contend for
+            # the backend and can wedge the tunnel harder. Stop here —
+            # partial evidence is on disk.
+            log("step abandoned; stopping the queue (abandoned child "
+                "still holds the backend)")
+            break
+    log("queue drained; watcher exiting")
+    with open(os.path.join(ROOT, ".hw_watch_done"), "w") as f:
+        f.write(time.strftime("%Y-%m-%d %H:%M:%S") + "\n")
+
+
+if __name__ == "__main__":
+    main()

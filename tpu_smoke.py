@@ -461,7 +461,8 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
 
 def run_subproc(log_path: str, timeout_s: float,
                 skip: str | None = None,
-                start_after: str | None = None) -> int:
+                start_after: str | None = None,
+                only: str | None = None) -> int:
     """Run every case in its OWN subprocess with a hard deadline.
 
     A Mosaic compile hang through the tunnel has been observed to wedge
@@ -485,6 +486,10 @@ def run_subproc(log_path: str, timeout_s: float,
         text=True, timeout=600).stdout.split()
     skips = [s for s in (skip or "").split(",") if s]
     names = [n for n in names if n not in skips]
+    if only:
+        names = [n for n in names
+                 if (n == only[1:] if only.startswith("=") else only in n)]
+        assert names, f"--only {only!r} matches no cases"
     if start_after:
         assert start_after in names, f"{start_after!r} not in case list"
         names = names[names.index(start_after) + 1:]
@@ -579,7 +584,7 @@ if __name__ == "__main__":
         f.write(f"tpu_smoke @ {time.strftime('%Y-%m-%d %H:%M:%S')}\n")
     if args.subproc:
         sys.exit(run_subproc(args.log, args.case_timeout, skip=args.skip,
-                             start_after=args.start_after))
+                             start_after=args.start_after, only=args.only))
     rc = run_smoke(args.log, args.only, skip=args.skip)
     if args.hard_exit:
         sys.stdout.flush()
