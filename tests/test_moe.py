@@ -102,6 +102,58 @@ def test_fast_all_to_all(mesh8, impl):
                                           sent[src, dst, :n])
 
 
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_fast_all_to_all_fp8(mesh8, impl):
+    # Reference headline config class: fp8 tokens + per-row scales
+    # (README.md:97; low_latency_all_to_all.py scale channel).
+    from triton_dist_tpu.ops.all_to_all import fast_all_to_all_fp8
+    world, cap, h = 8, 16, 128
+    ctx = create_all_to_all_context(mesh8, "tp", capacity=cap)
+    buf = jax.random.normal(jax.random.PRNGKey(2),
+                            (world * world, cap, h), jnp.bfloat16)
+    # Mixed magnitudes stress the per-row scale (1e-3 .. 1e3).
+    mags = 10.0 ** jax.random.uniform(jax.random.PRNGKey(3),
+                                      (world * world, cap, 1),
+                                      minval=-3, maxval=3)
+    buf = (buf.astype(jnp.float32) * mags).astype(jnp.bfloat16)
+    counts = jax.random.randint(jax.random.PRNGKey(4), (world * world,),
+                                0, cap + 1, jnp.int32)
+    sharded = jax.device_put(buf, NamedSharding(mesh8, P("tp")))
+    counts_s = jax.device_put(counts, NamedSharding(mesh8, P("tp")))
+
+    recv, rcounts = fast_all_to_all_fp8(sharded, counts_s, ctx, impl=impl)
+    assert recv.dtype == jnp.bfloat16
+    recv = np.asarray(recv, np.float32).reshape(world, world, cap, h)
+    rcounts = np.asarray(rcounts).reshape(world, world)
+    sent = np.asarray(buf, np.float32).reshape(world, world, cap, h)
+    scounts = np.asarray(counts).reshape(world, world)
+    for dst in range(world):
+        for src in range(world):
+            assert rcounts[dst, src] == scounts[src, dst]
+            n = rcounts[dst, src]
+            if n == 0:
+                continue
+            got, want = recv[dst, src, :n], sent[src, dst, :n]
+            # fp8 e4m3 relative error ~2^-3 worst case per element;
+            # row-scaled so tolerance is relative to the row max.
+            row_max = np.abs(want).max(axis=-1, keepdims=True) + 1e-9
+            assert np.max(np.abs(got - want) / row_max) < 0.07
+
+
+def test_fp8_quantize_roundtrip():
+    from triton_dist_tpu.ops.all_to_all import (
+        dequantize_fp8_rows, quantize_fp8_rows)
+    x = jnp.array([[0.0, 0.0, 0.0], [1.0, -448.0, 2.0],
+                   [1e-4, 2e-4, -3e-4]], jnp.float32)
+    q, s = quantize_fp8_rows(x)
+    assert q.dtype == jnp.float8_e4m3fn and s.shape == (3,)
+    back = dequantize_fp8_rows(q, s, jnp.float32)
+    assert np.allclose(np.asarray(back[0]), 0.0)          # zero row exact
+    rel = np.abs(np.asarray(back) - np.asarray(x)) / (
+        np.abs(np.asarray(x)).max(axis=-1, keepdims=True) + 1e-12)
+    assert rel.max() < 0.07
+
+
 def test_moe_align_block_size_native_matches_numpy():
     from triton_dist_tpu.ops import moe_utils as mu
     rng = np.random.RandomState(0)
@@ -213,6 +265,34 @@ def test_ep_dispatch_combine_roundtrip(mesh8, impl, key):
     out = layer.combine(tokens, wts_s, handle)
     np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-5,
                                atol=1e-5)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_ep_dispatch_fp8_wire(mesh8, impl, key):
+    """wire_dtype='fp8': identity-expert roundtrip within fp8 tolerance
+    (reference LL-a2a fp8 config, README.md:97)."""
+    world, rows, h, e, topk = 8, 8, 128, 16, 2
+    t = world * rows
+    layer = EPAll2AllLayer(max_tokens=rows, hidden=h, topk=topk,
+                           num_experts=e, mesh=mesh8, axis="tp",
+                           dtype=jnp.bfloat16, impl=impl,
+                           wire_dtype="fp8")
+    x = jax.random.normal(key, (t, h), jnp.bfloat16)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (t, topk), 0, e,
+                             jnp.int32)
+    wts = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(2), (t, topk)), axis=-1
+    ).astype(jnp.bfloat16)
+    xs = jax.device_put(x, NamedSharding(mesh8, P("tp")))
+    idx_s = jax.device_put(idx, NamedSharding(mesh8, P("tp")))
+    wts_s = jax.device_put(wts, NamedSharding(mesh8, P("tp")))
+
+    tokens, _, handle = layer.dispatch(xs, idx_s)
+    out = layer.combine(tokens, wts_s, handle)
+    want = np.asarray(x, np.float32)
+    got = np.asarray(out, np.float32)
+    denom = np.abs(want).max(axis=-1, keepdims=True) + 1e-9
+    assert np.max(np.abs(got - want) / denom) < 0.1
 
 
 def test_ep_moe_vs_dense(mesh8, key):

@@ -20,7 +20,6 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from triton_dist_tpu.ops.common import nestable_shard_map
 
@@ -50,13 +49,22 @@ class EPAll2AllLayer:
     def __init__(self, max_tokens: int, hidden: int, topk: int,
                  num_experts: int, mesh: Mesh | None = None,
                  axis: str = "ep", capacity: int | None = None,
-                 dtype=jnp.bfloat16, impl: str = "pallas"):
+                 dtype=jnp.bfloat16, impl: str = "pallas",
+                 wire_dtype: str | None = None):
         if mesh is None:
             from triton_dist_tpu.runtime.dist import get_mesh
             mesh = get_mesh()
         self.mesh, self.axis = mesh, axis
         self.world = mesh.shape[axis]
         assert num_experts % self.world == 0
+        # wire_dtype="fp8": DISPATCH tokens travel as float8_e4m3fn with
+        # per-row scales (the reference's headline LL-a2a config —
+        # README.md:97); combine stays at model dtype to keep the topk
+        # weighted sum accurate (DeepEP practice). Inference-only: the
+        # quantizer has no useful gradient, so training uses the plain
+        # wire (ops/autodiff.py).
+        assert wire_dtype in (None, "fp8"), wire_dtype
+        self.wire_dtype = wire_dtype
         self.max_tokens = max_tokens
         self.hidden = hidden
         self.topk = topk
@@ -66,7 +74,11 @@ class EPAll2AllLayer:
         # (reference sizes send_buf the same way: max_tokens * topk rows,
         # ep_a2a_layer.py:70-90).
         cap = capacity or max_tokens * topk
-        cap = max(8, -(-cap // 8) * 8)  # sublane-align for chunked DMA
+        # Sublane-align the slab for chunked DMA: 8 rows for >=2-byte
+        # payloads, 32 for the fp8 path's int8 wire (1-byte native tile
+        # is (32, 128); review r3e finding 1).
+        align = 32 if wire_dtype == "fp8" else 8
+        cap = max(align, -(-cap // align) * align)
         self.capacity = cap
         self.dtype = dtype
         self.impl = impl
@@ -77,13 +89,8 @@ class EPAll2AllLayer:
     def _meta_a2a(self, arr: jax.Array) -> jax.Array:
         """XLA all-to-all for small int sideband arrays (local shape
         (world, ...) → transposed slabs)."""
-        axis = self.axis
-
-        def body(a):
-            return lax.all_to_all(a, axis, split_axis=0, concat_axis=0,
-                                  tiled=True)
-        return nestable_shard_map(body, mesh=self.mesh, in_specs=P(axis),
-                             out_specs=P(axis), check_vma=False)(arr)
+        from triton_dist_tpu.ops.all_to_all import _xla_a2a
+        return _xla_a2a(self.mesh, self.axis, arr)
 
     # -- dispatch ----------------------------------------------------------
     def dispatch(self, x: jax.Array, exp_indices: jax.Array):
@@ -119,8 +126,13 @@ class EPAll2AllLayer:
         send_buf, send_exp, send_counts, dest, pos, valid = pack(
             x, exp_indices)
 
-        recv_buf, recv_counts = fast_all_to_all(
-            send_buf, send_counts, self.a2a_ctx, impl=self.impl)
+        if self.wire_dtype == "fp8":
+            from triton_dist_tpu.ops.all_to_all import fast_all_to_all_fp8
+            recv_buf, recv_counts = fast_all_to_all_fp8(
+                send_buf, send_counts, self.a2a_ctx, impl=self.impl)
+        else:
+            recv_buf, recv_counts = fast_all_to_all(
+                send_buf, send_counts, self.a2a_ctx, impl=self.impl)
         recv_exp = self._meta_a2a(send_exp)
 
         def local_unpack(rb, re, rc):

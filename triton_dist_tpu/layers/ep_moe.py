@@ -35,7 +35,8 @@ class EPMoE:
     def __init__(self, hidden_size: int, intermediate_size: int,
                  num_experts: int, topk: int, mesh: Mesh | None = None,
                  axis: str = "ep", dtype=jnp.bfloat16,
-                 impl: str = "pallas", norm_topk_prob: bool = True):
+                 impl: str = "pallas", norm_topk_prob: bool = True,
+                 wire_dtype: str | None = None):
         if mesh is None:
             from triton_dist_tpu.runtime.dist import get_mesh
             mesh = get_mesh()
@@ -50,6 +51,7 @@ class EPMoE:
         self.dtype = dtype
         self.impl = impl
         self.norm_topk_prob = norm_topk_prob
+        self.wire_dtype = wire_dtype  # "fp8": quantized dispatch wire
         # One a2a layer per distinct per-rank token count (prefill vs
         # decode shapes); the reference similarly sizes its symmetric
         # buffers by max_M and reuses them (ep_a2a_layer.py:70-90).
@@ -63,7 +65,8 @@ class EPMoE:
             self._a2a[t_loc] = EPAll2AllLayer(
                 max_tokens=t_loc, hidden=self.hidden_size, topk=self.topk,
                 num_experts=self.num_experts, mesh=self.mesh,
-                axis=self.axis, dtype=self.dtype, impl=self.impl)
+                axis=self.axis, dtype=self.dtype, impl=self.impl,
+                wire_dtype=self.wire_dtype)
         return self._a2a[t_loc]
 
     # -- params (same pytree as TPMoE; EP sharding) -------------------------
