@@ -423,6 +423,16 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         return lo, dec
     case("sp_model/prefill_decode", sp_model_step)
 
+    # fp8-wire a2a last among non-risky cases: first-ever int8-payload
+    # DMA compile (reference's headline LL-a2a fp8 config).
+    def a2a_fp8_case():
+        from triton_dist_tpu.ops.all_to_all import fast_all_to_all_fp8
+        send8 = sharded(randn((1, 128, 256)), P("tp"))
+        counts8 = sharded(jnp.full((1,), 64, jnp.int32), P("tp"))
+        return fast_all_to_all_fp8(send8, counts8, a2a_ctx,
+                                   impl="pallas")[0]
+    case("fast_all_to_all/fp8", a2a_fp8_case)
+
     def train_step():
         # Fused-mode training step (round 3): compiles the TRANSPOSE
         # fused kernels in the backward (ops/autodiff.py) on the chip —
