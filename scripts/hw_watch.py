@@ -21,8 +21,17 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(ROOT, "hw_watch.log")
 
-# (name, argv, deadline_s) — run in order; stop the queue if a step
-# wedges (probe after each step to know).
+# (name, argv, deadline_s, env) — run in order; stop the queue if a
+# step wedges (probe after each step to know).
+
+
+def _bench_part(part, deadline):
+    return (f"bench_{part}", [sys.executable, "bench.py"], deadline,
+            {"TDT_BENCH_ONLY": part, "TDT_BENCH_SUBPROC": "0",
+             "TDT_BENCH_PROGRESS":
+                 os.path.join(ROOT, f".bench_progress_{part}.json")})
+
+
 QUEUE = [
     # Resume the stopped 07-31 03:30 smoke run: cases after
     # allreduce/one_shot (which PASSed; its lingering teardown falsely
@@ -32,14 +41,20 @@ QUEUE = [
       "--start-after", "allreduce/one_shot",
       "--skip", "ag_gemm_multi,train/fused_step,sp_ag_attention/pallas",
       "--log", "tpu_smoke_r3_resume.log"],
-     3600.0),
+     3600.0, {}),
     # First on-chip compile of the restructured fused SP kernel, alone
     # so a hang costs nothing else.
     ("sp_pallas",
      [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "600",
       "--only", "=sp_ag_attention/pallas",
       "--log", "tpu_smoke_r3_sp.log"],
-     900.0),
+     900.0, {}),
+    # Re-measure the parts whose kernels changed since the 01:00 bench
+    # (tp_mlp now routes ag_swiglu; mega/gemm_ar for fresh numbers).
+    _bench_part("tp_mlp", 2700.0),
+    _bench_part("moe_ag_gg", 2700.0),
+    _bench_part("gemm_ar", 2700.0),
+    _bench_part("mega", 2700.0),
 ]
 
 
@@ -62,9 +77,12 @@ def probe(timeout_s: float = 60.0) -> bool:
         return False
 
 
-def run_step(name: str, argv: list[str], deadline_s: float) -> str:
+def run_step(name: str, argv: list[str], deadline_s: float,
+             env_extra: dict | None = None) -> str:
     log(f"step {name}: start")
-    child = subprocess.Popen(argv, cwd=ROOT, stdout=subprocess.DEVNULL,
+    env = dict(os.environ, **(env_extra or {}))
+    child = subprocess.Popen(argv, cwd=ROOT, env=env,
+                             stdout=subprocess.DEVNULL,
                              stderr=subprocess.DEVNULL)
     t0 = time.monotonic()
     while child.poll() is None:
@@ -86,8 +104,8 @@ def main() -> None:
             time.sleep(300.0)
             continue
         log("tunnel ALIVE")
-        name, argv, deadline = QUEUE[i]
-        status = run_step(name, argv, deadline)
+        name, argv, deadline, env_extra = QUEUE[i]
+        status = run_step(name, argv, deadline, env_extra)
         i += 1
         if status == "abandoned":
             # The abandoned child is still alive and owns the (single)
