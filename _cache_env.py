@@ -1,0 +1,17 @@
+"""Shared pre-jax-import env for the persistent XLA compilation cache.
+
+Import this BEFORE jax in every repo-root entry point that touches the
+tunneled TPU (bench.py, tpu_smoke.py): a once-successful compile of the
+big fused programs (the train step was observed >35 min through the
+tunnel) then persists to .jax_cache, making later runs — including the
+driver's end-of-round bench — near-free. One module so the two entry
+points cannot drift (code-review r3f finding 1). Harmless if the
+backend declines executable serialization.
+"""
+
+import os
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")

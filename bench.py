@@ -39,14 +39,7 @@ import os
 import sys
 import time
 
-# Persistent XLA compilation cache: a once-successful compile of the big
-# fused programs (train step was observed >35 min through the tunnel)
-# makes every later run — including the driver's end-of-round bench —
-# near-free. Harmless if the backend declines serialization.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
+import _cache_env  # noqa: F401  (persistent compile cache; pre-jax)
 
 os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
 # Persist autotune sweeps next to the repo so later rounds (and reruns

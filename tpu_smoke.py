@@ -25,11 +25,7 @@ import argparse
 import os
 import sys
 
-# Share bench.py's persistent compilation cache (see bench.py header).
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
+import _cache_env  # noqa: F401  (persistent compile cache; pre-jax)
 import time
 import traceback
 

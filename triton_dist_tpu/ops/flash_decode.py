@@ -263,32 +263,49 @@ def _tiled_decode_kernel(q_ref, len_ref, table_ref, k_hbm, v_hbm, o_ref,
     n_tiles = lax.div(live_here + t_blk - 1, t_blk)
 
     def k_dma(slot, ti, b):
-        if paged:
-            page = table_ref[b, ti]
-            src = k_hbm.at[page, :, :, :]
-        else:
-            src = k_hbm.at[b, pl.ds(ti * t_blk, t_blk), :, :]
-        return pltpu.make_async_copy(src, k_tile.at[slot, b],
+        # Paged: each sequence's tile lives on its own page → one DMA
+        # per batch row (block_table indirection).
+        page = table_ref[b, ti]
+        return pltpu.make_async_copy(k_hbm.at[page, :, :, :],
+                                     k_tile.at[slot, b],
                                      k_sem.at[slot, b])
 
     def v_dma(slot, ti, b):
-        if paged:
-            page = table_ref[b, ti]
-            src = v_hbm.at[page, :, :, :]
-        else:
-            src = v_hbm.at[b, pl.ds(ti * t_blk, t_blk), :, :]
-        return pltpu.make_async_copy(src, v_tile.at[slot, b],
+        page = table_ref[b, ti]
+        return pltpu.make_async_copy(v_hbm.at[page, :, :, :],
+                                     v_tile.at[slot, b],
                                      v_sem.at[slot, b])
 
+    def k_dma_dense(slot, ti):
+        # Dense cache: the whole (B, t_blk, Hkv, D) tile is one strided
+        # DMA — 2 descriptors per tile instead of 2*B (B=8 serving
+        # batches were paying 16 issue latencies per tile).
+        return pltpu.make_async_copy(
+            k_hbm.at[:, pl.ds(ti * t_blk, t_blk), :, :], k_tile.at[slot],
+            k_sem.at[slot, 0])
+
+    def v_dma_dense(slot, ti):
+        return pltpu.make_async_copy(
+            v_hbm.at[:, pl.ds(ti * t_blk, t_blk), :, :], v_tile.at[slot],
+            v_sem.at[slot, 0])
+
     def start_tile(slot, ti):
-        for b in range(batch):
-            k_dma(slot, ti, b).start()
-            v_dma(slot, ti, b).start()
+        if paged:
+            for b in range(batch):
+                k_dma(slot, ti, b).start()
+                v_dma(slot, ti, b).start()
+        else:
+            k_dma_dense(slot, ti).start()
+            v_dma_dense(slot, ti).start()
 
     def wait_tile(slot, ti):
-        for b in range(batch):
-            k_dma(slot, ti, b).wait()
-            v_dma(slot, ti, b).wait()
+        if paged:
+            for b in range(batch):
+                k_dma(slot, ti, b).wait()
+                v_dma(slot, ti, b).wait()
+        else:
+            k_dma_dense(slot, ti).wait()
+            v_dma_dense(slot, ti).wait()
 
     @pl.when(n_tiles > 0)
     def _():
@@ -443,8 +460,10 @@ def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
             scratch_shapes=[
                 pltpu.VMEM((2, b, t_blk, hkv, d), cache_k.dtype),
                 pltpu.VMEM((2, b, t_blk, hkv, d), cache_v.dtype),
-                pltpu.SemaphoreType.DMA((2, b)),
-                pltpu.SemaphoreType.DMA((2, b)),
+                # Dense path: one whole-tile DMA per slot — only sem
+                # [slot, 0] is used (paged keeps per-batch sems).
+                pltpu.SemaphoreType.DMA((2, 1)),
+                pltpu.SemaphoreType.DMA((2, 1)),
                 pltpu.SemaphoreType.DMA((world, 3)),
                 pltpu.SemaphoreType.DMA((world, 3))],
             compiler_params=comm_params(collective_id=7, world=world),
