@@ -46,3 +46,50 @@ def test_modes_agree(mlp, setup):
     b = mlp(params, x, mode="ag_rs")
     np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+@pytest.mark.parametrize("m,h,i", [(16, 64, 128), (40, 72, 144)])
+def test_tp_mlp_shape_dtype_sweep(mesh8, key, dtype, m, h, i):
+    """Reference test_tp_mlp.py sweeps (M, dtype) per fwd mode; the
+    second shape is deliberately non-tile-aligned (M=40, H=72)."""
+    mlp = TPMLP(h, i, mesh=mesh8, dtype=dtype)
+    params = mlp.init(key)
+    x = jax.random.normal(jax.random.PRNGKey(9), (m, h), dtype)
+    ref = golden(params, x)
+    tol = 2e-4 if dtype == jnp.float32 else 8e-2
+    for mode in ("xla", "ag_rs", "xla_ar", "gemm_ar"):
+        out = mlp(params, x, mode=mode)
+        assert out.dtype == dtype and out.shape == (m, h)
+        np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                                   rtol=tol, atol=tol * 8,
+                                   err_msg=f"mode={mode}")
+
+
+def test_tp_mlp_grads_fused_vs_xla(mesh8, key):
+    """Layer-level grad parity: the fused ag_rs backward (transpose
+    kernels, ops/autodiff.py) must match the xla-collective backward."""
+    mlp = TPMLP(H, I, mesh=mesh8, dtype=jnp.float32)
+    params = mlp.init(key)
+    x = jax.random.normal(jax.random.PRNGKey(11), (M, H), jnp.float32)
+
+    def loss(p, mode):
+        y = mlp(p, x, mode=mode).astype(jnp.float32)
+        return jnp.mean(y * y)
+
+    g_ref = jax.grad(lambda p: loss(p, "xla"))(params)
+    g_fused = jax.grad(lambda p: loss(p, "ag_rs"))(params)
+    for name in g_ref:
+        np.testing.assert_allclose(
+            np.asarray(g_fused[name]), np.asarray(g_ref[name]),
+            rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+def test_tp_mlp_set_fwd_roundtrip(mlp, setup):
+    """set_fwd switches the default mode (reference TP_MLP.set_fwd)."""
+    params, x, ref = setup
+    mlp.set_fwd("gemm_ar")
+    out = mlp(params, x)
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               rtol=2e-4, atol=2e-4)
+    mlp.set_fwd("ag_rs")

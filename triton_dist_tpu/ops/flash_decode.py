@@ -262,50 +262,36 @@ def _tiled_decode_kernel(q_ref, len_ref, table_ref, k_hbm, v_hbm, o_ref,
     live_here = jnp.clip(kv_len - first_pos, 0, t_loc)
     n_tiles = lax.div(live_here + t_blk - 1, t_blk)
 
-    def k_dma(slot, ti, b):
+    def paged_dma(hbm, tile, sem, slot, ti, b):
         # Paged: each sequence's tile lives on its own page → one DMA
         # per batch row (block_table indirection).
         page = table_ref[b, ti]
-        return pltpu.make_async_copy(k_hbm.at[page, :, :, :],
-                                     k_tile.at[slot, b],
-                                     k_sem.at[slot, b])
+        return pltpu.make_async_copy(hbm.at[page, :, :, :],
+                                     tile.at[slot, b], sem.at[slot, b])
 
-    def v_dma(slot, ti, b):
-        page = table_ref[b, ti]
-        return pltpu.make_async_copy(v_hbm.at[page, :, :, :],
-                                     v_tile.at[slot, b],
-                                     v_sem.at[slot, b])
-
-    def k_dma_dense(slot, ti):
+    def dense_dma(hbm, tile, sem, slot, ti):
         # Dense cache: the whole (B, t_blk, Hkv, D) tile is one strided
         # DMA — 2 descriptors per tile instead of 2*B (B=8 serving
         # batches were paying 16 issue latencies per tile).
         return pltpu.make_async_copy(
-            k_hbm.at[:, pl.ds(ti * t_blk, t_blk), :, :], k_tile.at[slot],
-            k_sem.at[slot, 0])
+            hbm.at[:, pl.ds(ti * t_blk, t_blk), :, :], tile.at[slot],
+            sem.at[slot, 0])
 
-    def v_dma_dense(slot, ti):
-        return pltpu.make_async_copy(
-            v_hbm.at[:, pl.ds(ti * t_blk, t_blk), :, :], v_tile.at[slot],
-            v_sem.at[slot, 0])
+    _kv = ((k_hbm, k_tile, k_sem), (v_hbm, v_tile, v_sem))
+
+    def tile_dmas(slot, ti):
+        if paged:
+            return [paged_dma(*refs, slot, ti, b)
+                    for refs in _kv for b in range(batch)]
+        return [dense_dma(*refs, slot, ti) for refs in _kv]
 
     def start_tile(slot, ti):
-        if paged:
-            for b in range(batch):
-                k_dma(slot, ti, b).start()
-                v_dma(slot, ti, b).start()
-        else:
-            k_dma_dense(slot, ti).start()
-            v_dma_dense(slot, ti).start()
+        for dma in tile_dmas(slot, ti):
+            dma.start()
 
     def wait_tile(slot, ti):
-        if paged:
-            for b in range(batch):
-                k_dma(slot, ti, b).wait()
-                v_dma(slot, ti, b).wait()
-        else:
-            k_dma_dense(slot, ti).wait()
-            v_dma_dense(slot, ti).wait()
+        for dma in tile_dmas(slot, ti):
+            dma.wait()
 
     @pl.when(n_tiles > 0)
     def _():
