@@ -37,6 +37,47 @@ def test_server_client_roundtrip(mesh8, key):
         srv.stop()
 
 
+def test_server_concurrent_clients(mesh8, key):
+    """Two clients in flight at once: the ThreadingTCPServer accepts
+    both, the generation lock serializes engine access, and each client
+    gets exactly its own answer (reference model_server is likewise a
+    threaded socket server)."""
+    import threading
+
+    cfg = ModelConfig(hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=8,
+                      num_key_value_heads=8, head_dim=4, vocab_size=64,
+                      max_position_embeddings=32, dtype=jnp.float32)
+    model = DenseLLM(cfg, mesh=mesh8, axis="tp", impl="xla")
+    params = model.init(key)
+    eng = Engine(model, batch=1, max_seq=16, prefill_mode="xla_ar",
+                 decode_mode="gemm_ar")
+    srv = ModelServer(eng, params, port=0).start()
+    results: dict[int, dict] = {}
+    prompts = {0: [1, 2, 3], 1: [7, 8]}
+    try:
+        def worker(i):
+            c = ChatClient(srv.host, srv.port)
+            results[i] = c.generate_ids([prompts[i]], gen_len=3)
+            c.close()
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for i, prompt in prompts.items():
+            assert "tokens" in results[i], results[i]
+            direct = np.asarray(eng.serve(
+                params, jnp.asarray([prompt], jnp.int32), 3))[0]
+            np.testing.assert_array_equal(
+                np.asarray(results[i]["tokens"][0]),
+                direct[len(prompt):])
+    finally:
+        srv.stop()
+
+
 def test_server_ragged_prompts(mesh8, key):
     """Variable-length prompt rows route through serve_ragged and match
     solo generations (greedy)."""
