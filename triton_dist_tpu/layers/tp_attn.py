@@ -103,7 +103,9 @@ class TPAttn:
           position_ids: (B, S) absolute positions.
           rope_cache: (cos, sin) tables (T_max, D/2).
           kv_cache: (k, v) each (B, T, num_kv_heads, D), head-sharded.
-          offset: scalar int32 — write position into the cache.
+          offset: int32 write position into the cache — scalar, or a
+            (B,) per-row vector when S == 1 (continuous batching;
+            see _attention_core).
         Returns:
           (out, (k_cache, v_cache)): out has the same layout as x.
         """
@@ -175,20 +177,34 @@ def _attention_core(q, k, v, cache_k, cache_v, offset, kv_start, *,
     positions kv_start[b] <= j <= offset+i — ``kv_start`` is the
     left-padding boundary for ragged batches (all-zeros = the plain
     causal mask). Fully-masked (pad) query rows get finite garbage (not
-    NaN); their logits are never consumed."""
+    NaN); their logits are never consumed.
+
+    ``offset`` may be a PER-ROW (B,) vector when S == 1 (continuous
+    batching: each row decodes at its own write position,
+    Engine.serve_stream). Scalar offset keeps the contiguous
+    dynamic_update_slice write; the vector path scatters one position
+    per row."""
     b, s, hq, d = q.shape
     t = cache_k.shape[1]
     hkv = cache_k.shape[2]
-    cache_k = lax.dynamic_update_slice(cache_k, k, (0, offset, 0, 0))
-    cache_v = lax.dynamic_update_slice(cache_v, v, (0, offset, 0, 0))
+    if offset.ndim == 0:
+        cache_k = lax.dynamic_update_slice(cache_k, k, (0, offset, 0, 0))
+        cache_v = lax.dynamic_update_slice(cache_v, v, (0, offset, 0, 0))
+        off_b = jnp.broadcast_to(offset, (b,))
+    else:
+        assert s == 1, "per-row offsets support single-token decode only"
+        rows = jnp.arange(b)
+        cache_k = cache_k.at[rows, offset].set(k[:, 0])
+        cache_v = cache_v.at[rows, offset].set(v[:, 0])
+        off_b = offset
 
     qg = q.reshape(b, s, hkv, groups, d).astype(jnp.float32)
     kf = cache_k.astype(jnp.float32)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg, kf) * (d ** -0.5)
-    q_pos = offset + jnp.arange(s)[:, None]
-    causal = jnp.arange(t)[None, :] <= q_pos  # (S, T)
+    q_pos = off_b[:, None, None] + jnp.arange(s)[None, :, None]  # (B,S,1)
+    causal = jnp.arange(t)[None, None, :] <= q_pos  # (B, S, T)
     live = jnp.arange(t)[None, :] >= kv_start[:, None]  # (B, T)
-    mask = causal[None] & live[:, None]  # (B, S, T)
+    mask = causal & live[:, None]  # (B, S, T)
     scores = jnp.where(mask[:, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgst,btkd->bskgd", probs,

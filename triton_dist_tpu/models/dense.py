@@ -151,7 +151,11 @@ class DenseLLM:
         assert block_table is None, "paged caches need mode='sp'"
         b, s = input_ids.shape
         offset = jnp.asarray(offset, jnp.int32)
-        position_ids = offset + jnp.tile(
+        # offset may be a (B,) vector (per-row decode positions —
+        # continuous batching, Engine.serve_stream); S must be 1 then
+        # (enforced by the attention core's scatter write).
+        off2d = offset[:, None] if offset.ndim else offset
+        position_ids = off2d + jnp.tile(
             jnp.arange(s, dtype=jnp.int32)[None], (b, 1))
         if kv_start is not None:
             position_ids = jnp.maximum(

@@ -94,6 +94,8 @@ class Engine:
         self.profile_steps = profile_steps
         self._decode_step = None
         self._decode_step_stop = None
+        self._stream_step = None
+        self._admit = None
 
     # -- decode step (jit once = graph capture, engine.py:75-105) ----------
     def _build_decode_step(self):
@@ -227,6 +229,145 @@ class Engine:
             run_steps(n_total)
         return jnp.concatenate(out, axis=1)
 
+
+    # -- continuous batching ----------------------------------------------
+    def _build_stream_step(self):
+        """One decode step with PER-ROW write offsets: each live row
+        decodes at its own cache position (frozen rows re-emit their
+        token and do not advance). One compiled program per token."""
+        model, mode = self.model, self.decode_mode
+
+        @jax.jit
+        def step(params, caches, token, offsets, key, done):
+            logits, caches = model.forward(
+                params, token[:, None], caches, offsets, mode=mode)
+            nxt = sample_token(logits[:, -1], key, self.temperature,
+                               self.top_k)
+            nxt = jnp.where(done, token, nxt)
+            return nxt, caches, jnp.where(done, offsets, offsets + 1)
+        return step
+
+    def _build_admit(self):
+        """Admission program: prefill on a batch-1 scratch cache sized
+        to the prompt, scatter the prefix into row ``row``'s lane at
+        slot 0, emit the first token. ONE jitted function — jax.jit's
+        shape-keyed cache already compiles once per distinct prompt
+        length (ids is (1, L))."""
+        model, mode = self.model, self.prefill_mode
+
+        @jax.jit
+        def admit(params, caches, ids, row, key):
+            length = ids.shape[1]
+            small = [(jnp.zeros((1, length) + ck.shape[2:], ck.dtype),
+                      jnp.zeros((1, length) + cv.shape[2:], cv.dtype))
+                     for ck, cv in caches]
+            logits, small = model.forward(params, ids, small, 0, mode=mode)
+            first = sample_token(logits[:, -1], key, self.temperature,
+                                 self.top_k)
+            new_caches = []
+            for (ck, cv), (sk, sv) in zip(caches, small):
+                ck = jax.lax.dynamic_update_slice(ck, sk, (row, 0, 0, 0))
+                cv = jax.lax.dynamic_update_slice(cv, sv, (row, 0, 0, 0))
+                new_caches.append((ck, cv))
+            return first[0], new_caches
+        return admit
+
+    def serve_stream(self, params, prompts, gen_len: int,
+                     stop_tokens=None) -> list:
+        """Continuous batching (beyond the reference; vLLM-style): pump
+        a stream of prompts through a fixed ``batch``-row decode window,
+        admitting the next prompt into a row the moment its occupant
+        finishes — no head-of-line blocking on the longest generation.
+
+        Every row runs at its own cache position: admission resets the
+        row's lane (batch-1 prefill scattered to slot 0, rope and mask
+        from the per-row offset), so a freed row is reusable
+        immediately. Greedy results equal serving each prompt alone
+        (tests/test_engine_stream.py). Returns prompt+generated token
+        lists in input order.
+
+        Requires the dense tp modes (per-row offsets thread through
+        ``_attention_core``'s scatter path; sp/paged streaming would
+        virtualize slots via the block table instead — future work).
+        """
+        assert self.decode_mode != "sp" and not self.paged, (
+            "serve_stream supports the dense tp engine modes")
+        b = self.kv.batch
+        if stop_tokens is None:
+            eos = getattr(self.model.config, "eos_token_id", -1)
+            stop_tokens = (eos,) if eos >= 0 else ()
+        stop_set = set(int(t) for t in stop_tokens)
+        if gen_len <= 0:
+            return [list(p) for p in prompts]
+        n_req = len(prompts)
+        assert all(len(p) for p in prompts), "prompts must be non-empty"
+        assert all(len(p) + gen_len <= self.kv.max_seq for p in prompts), \
+            "prompt + gen_len must fit max_seq"
+
+        self.kv.reset()
+        caches = self.kv.init()
+        if self._stream_step is None:
+            self._stream_step = self._build_stream_step()
+        if self._admit is None:
+            self._admit = self._build_admit()
+
+        token = jnp.zeros((b,), jnp.int32)
+        offsets = jnp.zeros((b,), jnp.int32)
+        row_req = [None] * b                 # request id occupying a row
+        row_budget = [0] * b                 # tokens left to generate
+        results: list[list[int] | None] = [None] * n_req
+        generated: dict[int, list[int]] = {}
+        next_req = 0
+
+        def record(r, tok: int):
+            """Book one generated token for row r; retire the row when
+            its budget is spent or a stop token lands. Returns True if
+            the row was freed."""
+            nonlocal row_req
+            rid = row_req[r]
+            generated[rid].append(tok)
+            row_budget[r] -= 1
+            if row_budget[r] <= 0 or tok in stop_set:
+                results[rid] = list(prompts[rid]) + generated.pop(rid)
+                row_req[r] = None
+                return True
+            return False
+
+        def admit_free_rows():
+            nonlocal next_req, token, offsets, caches
+            for r in range(b):
+                if next_req >= n_req:
+                    return
+                while row_req[r] is None and next_req < n_req:
+                    rid = next_req
+                    next_req += 1
+                    prompt = prompts[rid]
+                    self.key, sub = jax.random.split(self.key)
+                    first, caches = self._admit(
+                        params, caches, jnp.asarray([prompt], jnp.int32),
+                        jnp.int32(r), sub)
+                    row_req[r] = rid
+                    row_budget[r] = gen_len
+                    generated[rid] = []
+                    token = token.at[r].set(first)
+                    offsets = offsets.at[r].set(len(prompt))
+                    # gen_len == 1 or an immediate stop frees the row
+                    # again; the inner while then admits the next
+                    # request into the same row.
+                    record(r, int(first))
+
+        admit_free_rows()
+        while any(rid is not None for rid in row_req):
+            done = jnp.asarray([row_req[r] is None for r in range(b)])
+            self.key, sub = jax.random.split(self.key)
+            token, caches, offsets = self._stream_step(
+                params, caches, token, offsets, sub, done)
+            toks = np.asarray(token)
+            for r in range(b):
+                if row_req[r] is not None:
+                    record(r, int(toks[r]))
+            admit_free_rows()
+        return results
 
     def serve_ragged(self, params, prompts, gen_len: int,
                      stop_tokens=None, pad_token: int = 0) -> list:

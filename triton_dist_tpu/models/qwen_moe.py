@@ -136,7 +136,10 @@ class Qwen3MoE:
             attn_mode = mode
         b, s = input_ids.shape
         offset = jnp.asarray(offset, jnp.int32)
-        position_ids = offset + jnp.tile(
+        # (B,) per-row offsets supported for S == 1 decode (continuous
+        # batching — same contract as DenseLLM.forward).
+        off2d = offset[:, None] if offset.ndim else offset
+        position_ids = off2d + jnp.tile(
             jnp.arange(s, dtype=jnp.int32)[None], (b, 1))
         if kv_start is not None:
             position_ids = jnp.maximum(
