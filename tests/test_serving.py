@@ -37,6 +37,35 @@ def test_server_client_roundtrip(mesh8, key):
         srv.stop()
 
 
+def test_server_streams_oversized_batches(mesh8, key):
+    """More prompts than engine rows route through serve_stream and
+    match solo generations (continuous batching behind the protocol)."""
+    cfg = ModelConfig(hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=8,
+                      num_key_value_heads=8, head_dim=4, vocab_size=64,
+                      max_position_embeddings=32, dtype=jnp.float32)
+    model = DenseLLM(cfg, mesh=mesh8, axis="tp", impl="xla")
+    params = model.init(key)
+    eng = Engine(model, batch=2, max_seq=16, prefill_mode="xla_ar",
+                 decode_mode="gemm_ar")
+    srv = ModelServer(eng, params, port=0).start()
+    prompts = [[1, 2], [3, 4, 5], [6], [7, 8]]
+    try:
+        client = ChatClient(srv.host, srv.port)
+        resp = client.generate_ids(prompts, gen_len=3)
+        assert len(resp["tokens"]) == len(prompts)
+        solo = Engine(model, batch=1, max_seq=16, prefill_mode="xla_ar",
+                      decode_mode="gemm_ar")
+        for prompt, row in zip(prompts, resp["tokens"]):
+            want = np.asarray(solo.serve(
+                params, jnp.asarray([prompt], jnp.int32), 3))[0]
+            np.testing.assert_array_equal(np.asarray(row),
+                                          want[len(prompt):])
+        client.close()
+    finally:
+        srv.stop()
+
+
 def test_server_concurrent_clients(mesh8, key):
     """Two clients in flight at once: the ThreadingTCPServer accepts
     both, the generation lock serializes engine access, and each client

@@ -248,22 +248,30 @@ class Engine:
         return step
 
     def _build_admit(self):
-        """Admission program: prefill on a batch-1 scratch cache sized
-        to the prompt, scatter the prefix into row ``row``'s lane at
-        slot 0, emit the first token. ONE jitted function — jax.jit's
-        shape-keyed cache already compiles once per distinct prompt
-        length (ids is (1, L))."""
+        """Admission program: prefill on a batch-1 scratch cache, scatter
+        the prefix into row ``row``'s lane at slot 0, emit the first
+        token.
+
+        Prompts arrive RIGHT-padded to a power-of-two bucket so jit
+        compiles one program per bucket, not per distinct length (a
+        public stream of arbitrary lengths must not compile-storm —
+        code-review r3g). The pad suffix is causally invisible to the
+        first token (sampled at traced position ``length``-1), and its
+        scattered K/V slots are overwritten by the row's own decode
+        steps before the per-row mask ever exposes them — the same
+        argument that makes stale-lane reuse safe."""
         model, mode = self.model, self.prefill_mode
 
         @jax.jit
-        def admit(params, caches, ids, row, key):
-            length = ids.shape[1]
-            small = [(jnp.zeros((1, length) + ck.shape[2:], ck.dtype),
-                      jnp.zeros((1, length) + cv.shape[2:], cv.dtype))
+        def admit(params, caches, ids, length, row, key):
+            lb = ids.shape[1]                       # bucketed length
+            small = [(jnp.zeros((1, lb) + ck.shape[2:], ck.dtype),
+                      jnp.zeros((1, lb) + cv.shape[2:], cv.dtype))
                      for ck, cv in caches]
             logits, small = model.forward(params, ids, small, 0, mode=mode)
-            first = sample_token(logits[:, -1], key, self.temperature,
-                                 self.top_k)
+            last = jax.lax.dynamic_slice_in_dim(logits, length - 1, 1,
+                                                axis=1)[:, 0]
+            first = sample_token(last, key, self.temperature, self.top_k)
             new_caches = []
             for (ck, cv), (sk, sv) in zip(caches, small):
                 ck = jax.lax.dynamic_update_slice(ck, sk, (row, 0, 0, 0))
@@ -271,6 +279,13 @@ class Engine:
                 new_caches.append((ck, cv))
             return first[0], new_caches
         return admit
+
+    @staticmethod
+    def _bucket_len(n: int) -> int:
+        b = 8
+        while b < n:
+            b *= 2
+        return b
 
     def serve_stream(self, params, prompts, gen_len: int,
                      stop_tokens=None) -> list:
@@ -342,10 +357,13 @@ class Engine:
                     rid = next_req
                     next_req += 1
                     prompt = prompts[rid]
+                    lb = min(self._bucket_len(len(prompt)),
+                             self.kv.max_seq)
+                    padded = list(prompt) + [0] * (lb - len(prompt))
                     self.key, sub = jax.random.split(self.key)
                     first, caches = self._admit(
-                        params, caches, jnp.asarray([prompt], jnp.int32),
-                        jnp.int32(r), sub)
+                        params, caches, jnp.asarray([padded], jnp.int32),
+                        jnp.int32(len(prompt)), jnp.int32(r), sub)
                     row_req[r] = rid
                     row_budget[r] = gen_len
                     generated[rid] = []

@@ -66,9 +66,36 @@ class ModelServer:
         stop = req.get("stop_tokens")  # None → engine default (eos)
         lens = [len(p) for p in prompts]
         ragged = len(set(lens)) > 1
+        batch = self.engine.kv.batch
+        # Uniform client contract across all three engine routes: each
+        # row's tokens end at (and include) the first stop token.
+        # serve()/serve_ragged() pad stopped rows to a rectangle with
+        # the stop token; serve_stream() retires exactly — normalize to
+        # the latter (the server branch taken is an internal engine
+        # dimension the client cannot see).
+        if stop is None:
+            eos = getattr(self.engine.model.config, "eos_token_id", -1)
+            stop_set = {eos} if eos >= 0 else set()
+        else:
+            stop_set = set(int(t) for t in stop)
+
+        def trim(row):
+            row = list(row)
+            for i, t in enumerate(row):
+                if t in stop_set:
+                    return row[:i + 1]
+            return row
+
         with self._lock:
             t0 = time.perf_counter()
-            if ragged:
+            if len(prompts) > batch:
+                # More requests than decode rows: continuous batching
+                # pumps the stream through the fixed window
+                # (Engine.serve_stream).
+                rows = self.engine.serve_stream(self.params, prompts,
+                                                gen_len, stop_tokens=stop)
+                tokens = [r[ln:] for r, ln in zip(rows, lens)]
+            elif ragged:
                 rows = self.engine.serve_ragged(self.params, prompts,
                                                 gen_len, stop_tokens=stop)
                 tokens = [r[ln:].tolist() for r, ln in zip(rows, lens)]
@@ -79,7 +106,8 @@ class ModelServer:
                     stop_tokens=stop))
                 tokens = out[:, ids.shape[1]:].tolist()
             ms = (time.perf_counter() - t0) * 1e3
-        return {"tokens": tokens, "latency_ms": round(ms, 3)}
+        return {"tokens": [trim(r) for r in tokens],
+                "latency_ms": round(ms, 3)}
 
     def start(self):
         self._thread = threading.Thread(target=self._srv.serve_forever,
