@@ -55,9 +55,9 @@ def main():
     results = eng.serve_stream(params, prompts, gen_len=6)
 
     # Greedy streamed results must equal serving each prompt alone.
+    solo = Engine(model, batch=1, max_seq=32, prefill_mode="xla_ar",
+                  decode_mode="gemm_ar")
     for prompt, row in zip(prompts, results):
-        solo = Engine(model, batch=1, max_seq=32, prefill_mode="xla_ar",
-                      decode_mode="gemm_ar")
         want = np.asarray(solo.serve(
             params, jnp.asarray([prompt], jnp.int32), 6))[0].tolist()
         assert row == want, (prompt, row, want)

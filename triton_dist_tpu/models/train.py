@@ -174,6 +174,7 @@ def make_train_step(model, optimizer=None, *, mode: str = "xla",
         return jax.tree.map(
             lambda a: (jax.device_put(a, rep)
                        if isinstance(a, jax.Array)
+                       and not isinstance(a, jax.core.Tracer)
                        and not isinstance(a.sharding, NamedSharding)
                        else a), state)
 

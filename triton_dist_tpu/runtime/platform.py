@@ -36,5 +36,13 @@ def is_cpu() -> bool:
 
 
 def default_interpret() -> bool:
-    """Interpret Pallas TPU kernels when not running on real TPU hardware."""
+    """Interpret Pallas TPU kernels when not running on real TPU hardware.
+
+    ``TDT_FORCE_COMPILED=1`` forces the compiled path regardless of the
+    backend — used by the export-lint mode (tpu_smoke --export-lint),
+    which lowers every kernel FOR the tpu platform on a CPU host to run
+    the Pallas→Mosaic verifier without executing anything."""
+    import os
+    if os.environ.get("TDT_FORCE_COMPILED") == "1":
+        return False
     return not is_tpu()
