@@ -17,10 +17,17 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 
-def test_export_lint_all_cases(tmp_path):
+import pytest
+
+
+@pytest.mark.parametrize("world", [1, 8])
+def test_export_lint_all_cases(tmp_path, world):
+    """world=1 lints the on-chip smoke variants; world=8 lints the
+    multi-device ring/remote-DMA variants that NO other check compiles
+    (the chip is a single device; the interpret suite never lowers)."""
     r = subprocess.run(
         [sys.executable, str(REPO / "tpu_smoke.py"), "--export-lint",
-         "--log", str(tmp_path / "lint.log")],
+         "--world", str(world), "--log", str(tmp_path / "lint.log")],
         capture_output=True, text=True, timeout=900, cwd=REPO)
     tail = "\n".join(r.stdout.splitlines()[-45:])
     assert r.returncode == 0, f"export-lint failures:\n{tail}"

@@ -55,7 +55,8 @@ def _init_backend(retries: int = 3, backoff_s: float = 20.0):
 
 def run_smoke(log_path: str | None = None, only: str | None = None,
               interpret: bool = False, list_only: bool = False,
-              skip: str | None = None, export_lint: bool = False) -> int:
+              skip: str | None = None, export_lint: bool = False,
+              world: int = 1) -> int:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -140,7 +141,11 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         mode = "EXPORT-LINT (tpu lowering on cpu host)" if export_lint \
             else f"{dev.platform}:{getattr(dev, 'device_kind', '?')}"
         print(f"SMOKE on {mode}", flush=True)
-    mesh = Mesh(np.array(devices[:1]), ("tp",))
+    assert world == 1 or export_lint, (
+        "world > 1 is an export-lint mode (the chip is a single device; "
+        "multi-device execution is the interpret suite's job)")
+    assert len(devices) >= world, (len(devices), world)
+    mesh = Mesh(np.array(devices[:world]), ("tp",))
     key = jax.random.PRNGKey(0)
     bf16 = jnp.bfloat16
 
@@ -173,7 +178,7 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
 
     from triton_dist_tpu.ops.reduce_scatter import (
         ReduceScatterMethod, create_reduce_scatter_context, reduce_scatter)
-    xp = sharded(randn((1, 256, 256)), P("tp"))  # (w, M, N) partials
+    xp = sharded(randn((world, 256, 256)), P("tp"))  # (w, M, N) partials
     for method in (ReduceScatterMethod.RING, ReduceScatterMethod.ONE_SHOT):
         ctx = create_reduce_scatter_context(mesh, "tp", interpret=interpret)
         ctx.method = method
@@ -248,8 +253,8 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     from triton_dist_tpu.ops.all_to_all import (
         create_all_to_all_context, fast_all_to_all)
     a2a_ctx = create_all_to_all_context(mesh, "tp", interpret=interpret)
-    send = sharded(randn((1, 128, 256)), P("tp"))
-    counts = sharded(jnp.full((1,), 64, jnp.int32), P("tp"))
+    send = sharded(randn((world * world, 128, 256)), P("tp"))
+    counts = sharded(jnp.full((world * world,), 64, jnp.int32), P("tp"))
     case("fast_all_to_all",
          lambda: fast_all_to_all(send, counts, a2a_ctx, impl="pallas")[0])
 
@@ -299,16 +304,21 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
          lambda: gqa_fwd_batch_decode(q, kc, vc, jnp.int32(t // 2),
                                       fd_tiled, impl="pallas"))
     n_pages, page = 4, 256
-    pool_k = sharded(randn((bq * n_pages + 2, page, hkv, hd), k=11), P("tp"))
-    pool_v = sharded(randn((bq * n_pages + 2, page, hkv, hd), k=12), P("tp"))
+    # n_pages is PER-DEVICE; pools/tables are per-device slabs sharded
+    # on the leading dim (world-parametric for --export-lint --world N).
+    pool_k = sharded(randn((world * (bq * n_pages + 2), page, hkv, hd),
+                           k=11), P("tp"))
+    pool_v = sharded(randn((world * (bq * n_pages + 2), page, hkv, hd),
+                           k=12), P("tp"))
     table = sharded(
-        jnp.arange(bq * n_pages, dtype=jnp.int32
-                   ).reshape(1, bq, n_pages), P("tp"))
+        jnp.tile(jnp.arange(bq * n_pages, dtype=jnp.int32
+                            ).reshape(1, bq, n_pages), (world, 1, 1)),
+        P("tp"))
     fd_paged = create_flash_decode_context(mesh, "tp", interpret=interpret)
     case("flash_decode/paged",
          lambda: gqa_fwd_batch_decode_paged(
-             q, pool_k, pool_v, table, jnp.int32(n_pages * page // 2),
-             fd_paged))
+             q, pool_k, pool_v, table,
+             jnp.int32(world * n_pages * page // 2), fd_paged))
 
     # Serving shape (bench.py flash_decode line: B=8, 32 heads, t=8k).
     def fd_serving():
@@ -327,9 +337,10 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     sp_ctx = create_sp_attention_context(mesh, "tp", causal=True,
                                          interpret=interpret)
     s = 512
-    qs = sharded(randn((2, s, 8, 128)), P(None, "tp"))
-    ks = sharded(randn((2, s, 2, 128), k=9), P(None, "tp"))
-    vs = sharded(randn((2, s, 2, 128), k=10), P(None, "tp"))
+    hkv_sp = max(2, world)          # ulysses needs heads % world == 0
+    qs = sharded(randn((2, s, 4 * hkv_sp, 128)), P(None, "tp"))
+    ks = sharded(randn((2, s, hkv_sp, 128), k=9), P(None, "tp"))
+    vs = sharded(randn((2, s, hkv_sp, 128), k=10), P(None, "tp"))
     for impl in ("ring", "pallas"):
         case(f"sp_ag_attention/{impl}",
              lambda impl=impl: sp_ag_attention(qs, ks, vs, sp_ctx,
@@ -341,7 +352,8 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     # next 6; reference test_ep_moe_inference.py).
     def ep_moe_case():
         from triton_dist_tpu.layers.ep_moe import EPMoE
-        layer = EPMoE(256, 512, num_experts=4, topk=2, mesh=mesh,
+        layer = EPMoE(256, 512, num_experts=max(4, 2 * world),
+                      topk=2, mesh=mesh,
                       axis="tp", dtype=bf16)
         params = layer.init(jax.random.PRNGKey(3))
         xe = sharded(randn((64, 256), k=18), P("tp"))
@@ -351,7 +363,7 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     # --- PP ---------------------------------------------------------------
     from triton_dist_tpu.ops.p2p import create_p2p_context, pp_shift
     pp_ctx = create_p2p_context(mesh, "tp", interpret=interpret)
-    xpp = sharded(randn((1, 128, 256)), P("tp"))
+    xpp = sharded(randn((world, 128, 256)), P("tp"))
     case("pp_shift", lambda: pp_shift(xpp, pp_ctx, impl="pallas"))
 
     # --- layers / models --------------------------------------------------
@@ -374,8 +386,9 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         from triton_dist_tpu.models import DenseLLM, ModelConfig
         from triton_dist_tpu.models.kv_cache import KVCacheManager
         cfg = ModelConfig(hidden_size=128, intermediate_size=256,
-                          num_hidden_layers=2, num_attention_heads=4,
-                          num_key_value_heads=2, head_dim=64,
+                          num_hidden_layers=2,
+                          num_attention_heads=max(4, world),
+                          num_key_value_heads=max(2, world), head_dim=64,
                           vocab_size=128, max_position_embeddings=32,
                           dtype=bf16)
         model = DenseLLM(cfg, mesh=mesh, axis="tp", impl="pallas")
@@ -418,8 +431,9 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         from triton_dist_tpu.models.kv_cache import KVCacheManager
         mesh2 = Mesh(np.array(devices[:1]).reshape(1, 1), ("tp", "sp"))
         cfg = ModelConfig(hidden_size=512, intermediate_size=1024,
-                          num_hidden_layers=2, num_attention_heads=8,
-                          num_key_value_heads=4, head_dim=64,
+                          num_hidden_layers=2,
+                          num_attention_heads=max(8, world),
+                          num_key_value_heads=max(4, world), head_dim=64,
                           vocab_size=2048, max_position_embeddings=512,
                           dtype=bf16)
         model = DenseLLM(cfg, mesh=mesh2, axis="tp", sp_axis="sp",
@@ -445,8 +459,8 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     # DMA compile (reference's headline LL-a2a fp8 config).
     def a2a_fp8_case():
         from triton_dist_tpu.ops.all_to_all import fast_all_to_all_fp8
-        send8 = sharded(randn((1, 128, 256)), P("tp"))
-        counts8 = sharded(jnp.full((1,), 64, jnp.int32), P("tp"))
+        send8 = sharded(randn((world * world, 128, 256)), P("tp"))
+        counts8 = sharded(jnp.full((world * world,), 64, jnp.int32), P("tp"))
         return fast_all_to_all_fp8(send8, counts8, a2a_ctx,
                                    impl="pallas")[0]
     case("fast_all_to_all/fp8", a2a_fp8_case)
@@ -458,8 +472,9 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         from triton_dist_tpu.models import (DenseLLM, ModelConfig,
                                             make_train_step)
         cfg = ModelConfig(hidden_size=512, intermediate_size=1024,
-                          num_hidden_layers=2, num_attention_heads=8,
-                          num_key_value_heads=4, head_dim=64,
+                          num_hidden_layers=2,
+                          num_attention_heads=max(8, world),
+                          num_key_value_heads=max(4, world), head_dim=64,
                           vocab_size=2048, max_position_embeddings=256,
                           dtype=bf16)
         model = DenseLLM(cfg, mesh=mesh, axis="tp", impl="pallas",
@@ -609,6 +624,10 @@ if __name__ == "__main__":
                     help="lower every case for the TPU platform on this "
                          "host (Pallas/Mosaic verifier, no execution; "
                          "works without a chip)")
+    ap.add_argument("--world", type=int, default=1,
+                    help="mesh size for --export-lint: verifies the "
+                         "world-N ring/remote-DMA variants' Mosaic "
+                         "lowering (world>1 never executes)")
     args = ap.parse_args()
     if args.list:
         sys.exit(run_smoke(None, None, list_only=True))
@@ -620,8 +639,15 @@ if __name__ == "__main__":
             "drop --subproc (no tunnel involved, nothing to isolate)")
         sys.exit(run_subproc(args.log, args.case_timeout, skip=args.skip,
                              start_after=args.start_after, only=args.only))
+    if args.world > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={args.world}"
+            ).strip()
     rc = run_smoke(args.log, args.only, skip=args.skip,
-                   export_lint=args.export_lint)
+                   export_lint=args.export_lint, world=args.world)
     if args.hard_exit:
         sys.stdout.flush()
         sys.stderr.flush()
