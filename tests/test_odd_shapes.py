@@ -104,6 +104,31 @@ def test_flash_decode_partial_tail(mesh8, key):
     assert_allclose(got, ref, rtol=5e-2, atol=5e-2)
 
 
+def test_flash_decode_per_row_lengths(mesh8, key):
+    """Per-sequence kv lengths (reference kv_length_ptr + bid): each
+    row masked to its own length must equal serving that row alone with
+    a scalar length."""
+    from triton_dist_tpu.ops.flash_decode import (
+        create_flash_decode_context, gqa_fwd_batch_decode)
+    b, hq, hkv, d, t_loc = 4, 8, 2, 64, 64
+    t = WORLD * t_loc
+    kq, kk, kv = jax.random.split(key, 3)
+    q = (jax.random.normal(kq, (b, hq, d)) / 4).astype(jnp.bfloat16)
+    k = (jax.random.normal(kk, (b, t, hkv, d)) / 4).astype(jnp.bfloat16)
+    v = (jax.random.normal(kv, (b, t, hkv, d)) / 4).astype(jnp.bfloat16)
+    lens = jnp.asarray([t, t // 2 + 3, 17, t_loc], jnp.int32)
+    for variant in ("einsum", "tiled"):
+        ctx = dataclasses.replace(
+            create_flash_decode_context(mesh8, axis="tp",
+                                        variant=variant), t_blk=32)
+        got = gqa_fwd_batch_decode(q, k, v, lens, ctx)
+        for r in range(b):
+            ref = gqa_fwd_batch_decode(
+                q[r:r + 1], k[r:r + 1], v[r:r + 1],
+                jnp.int32(lens[r]), ctx)
+            assert_allclose(got[r:r + 1], ref, rtol=4e-2, atol=4e-2)
+
+
 def test_sp_attention_pallas_odd_block_shrink(mesh8, key):
     # s_loc=160 forces both sq_blk and t_sub to shrink (128 -> 32) via
     # the divisor loops; checks the clamped tiling end-to-end.

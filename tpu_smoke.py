@@ -429,7 +429,11 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         # chip; the pallas flash-decode path still compiles.
         from triton_dist_tpu.models import DenseLLM, ModelConfig
         from triton_dist_tpu.models.kv_cache import KVCacheManager
-        mesh2 = Mesh(np.array(devices[:1]).reshape(1, 1), ("tp", "sp"))
+        # (1, world) tp x sp grid: at --export-lint --world N this
+        # lints the seq-sharded model path's multi-device lowering
+        # (review r3h finding 1: it was pinned to 1 device).
+        mesh2 = Mesh(np.array(devices[:world]).reshape(1, world),
+                     ("tp", "sp"))
         cfg = ModelConfig(hidden_size=512, intermediate_size=1024,
                           num_hidden_layers=2,
                           num_attention_heads=max(8, world),
@@ -629,6 +633,13 @@ if __name__ == "__main__":
                          "world-N ring/remote-DMA variants' Mosaic "
                          "lowering (world>1 never executes)")
     args = ap.parse_args()
+    if args.world != 1:
+        # Early, clear validation: the smoke shapes divide by powers of
+        # two up to 8; anything else produces a wall of shape-assert
+        # FAILs that read like lint regressions (review r3h finding 2).
+        assert args.export_lint, "--world N>1 requires --export-lint"
+        assert args.world in (2, 4, 8), (
+            f"--world {args.world}: smoke shapes support 2/4/8")
     if args.list:
         sys.exit(run_smoke(None, None, list_only=True))
     with open(args.log, "w") as f:

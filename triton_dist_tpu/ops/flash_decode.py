@@ -138,9 +138,12 @@ def _local_partials(q, k, v, first_pos, kv_len, groups: int,
 
     q: (B, Hq, D); k/v: (B, T, Hkv, D); positions of the shard are
     ``first_pos + [0, T)``; only positions < ``kv_len`` are live.
-    Returns a (B, K, G, D), l (B, K, G), m (B, K, G) in fp32.
-    ``mosaic=True`` routes the contractions through the per-head
-    single-batch-dim dots (required inside Pallas kernels).
+    ``kv_len`` is PER-BATCH (B,) — the reference loads
+    ``kv_length_ptr + bid`` per sequence (flash_decode.py:182); a
+    scalar is broadcast. Returns a (B, K, G, D), l (B, K, G),
+    m (B, K, G) in fp32. ``mosaic=True`` routes the contractions
+    through the per-head single-batch-dim dots (required inside Pallas
+    kernels).
     """
     b, hq, d = q.shape
     t, hkv = k.shape[1], k.shape[2]
@@ -150,10 +153,12 @@ def _local_partials(q, k, v, first_pos, kv_len, groups: int,
         scores = _qk_scores(qg, kf) * (d ** -0.5)
     else:
         scores = jnp.einsum("bkgd,btkd->bkgt", qg, kf) * (d ** -0.5)
-    live = (first_pos + jnp.arange(t)) < kv_len              # (T,)
-    scores = jnp.where(live[None, None, None, :], scores, _NEG)
+    lens = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
+    live = (first_pos + jnp.arange(t))[None, :] < lens[:, None]  # (B, T)
+    live4 = live[:, None, None, :]
+    scores = jnp.where(live4, scores, _NEG)
     m = jnp.max(scores, axis=-1)
-    p = jnp.exp(scores - m[..., None]) * live[None, None, None, :]
+    p = jnp.exp(scores - m[..., None]) * live4
     l = jnp.sum(p, axis=-1)
     vf = v.astype(jnp.float32)
     if mosaic:
@@ -222,7 +227,9 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, abuf, lbuf, mbuf,
     """Einsum-variant distributed decode: whole-shard partial in VMEM →
     cross-rank combine. Lowest latency for short caches."""
     me = lax.axis_index(axis)
-    kv_len = len_ref[0]
+    # (B,) per-sequence lengths — SMEM loads must be scalar (Mosaic),
+    # so unroll the small batch dim.
+    kv_len = jnp.stack([len_ref[i] for i in range(q_ref.shape[0])])
     a, l, m = _local_partials(q_ref[:], k_ref[:], v_ref[:],
                               me * t_loc, kv_len, groups, mosaic=True)
     abuf[me] = a
@@ -255,11 +262,14 @@ def _tiled_decode_kernel(q_ref, len_ref, table_ref, k_hbm, v_hbm, o_ref,
     me = lax.axis_index(axis)
     scale = d ** -0.5
 
-    # Live positions inside this rank's shard: kv_len is the sequence
-    # maximum (per-batch lens are masked per tile below).
-    kv_len = len_ref[0]
+    # Per-sequence lengths (reference kv_length_ptr + bid,
+    # flash_decode.py:182); the DMA trip count covers the longest live
+    # row, per-row tails are masked per tile below. SMEM loads must be
+    # scalar (Mosaic), so unroll the small batch dim.
+    lens = jnp.stack([len_ref[i] for i in range(batch)])
+    kv_max = jnp.max(lens)
     first_pos = me * t_loc
-    live_here = jnp.clip(kv_len - first_pos, 0, t_loc)
+    live_here = jnp.clip(kv_max - first_pos, 0, t_loc)
     n_tiles = lax.div(live_here + t_blk - 1, t_blk)
 
     def paged_dma(hbm, tile, sem, slot, ti, b):
@@ -313,12 +323,13 @@ def _tiled_decode_kernel(q_ref, len_ref, table_ref, k_hbm, v_hbm, o_ref,
         # dots keep Mosaic's one-batch-dim matmul constraint.
         scores = _qk_scores(q, kt) * scale
         pos = first_pos + ti * t_blk + jnp.arange(t_blk)
-        live = pos < kv_len                                  # (t_blk,)
-        scores = jnp.where(live[None, None, None, :], scores, _NEG)
+        live = pos[None, :] < lens[:, None]                  # (B, t_blk)
+        live4 = live[:, None, None, :]
+        scores = jnp.where(live4, scores, _NEG)
 
         m_new = jnp.maximum(m_run, jnp.max(scores, axis=-1))
         alpha = jnp.exp(m_run - m_new)
-        p = jnp.exp(scores - m_new[..., None]) * live[None, None, None, :]
+        p = jnp.exp(scores - m_new[..., None]) * live4
         l_new = l_run * alpha + jnp.sum(p, axis=-1)
         pv = _pv_accum(p, vt)
         acc_new = acc * alpha[..., None] + pv
@@ -353,7 +364,9 @@ def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
       q: (B, Hq, D) current-step queries, replicated over the SP axis.
       cache_k/cache_v: (B, T, Hkv, D) with T sequence-sharded over
         ``ctx.axis`` (each device holds T/w positions).
-      kv_len: scalar int32 — number of live positions (decode offset + 1).
+      kv_len: int32 number of live positions (decode offset + 1) —
+        scalar, or PER-SEQUENCE (B,) like the reference's kv_length
+        array (flash_decode.py:182).
     Returns:
       (B, Hq, D) attention outputs, replicated.
     """
@@ -364,12 +377,12 @@ def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
     assert t % world == 0
     t_loc = t // world
     groups = hq // hkv
-    kv_len = jnp.asarray(kv_len, jnp.int32)
+    kv_len = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
 
     if impl == "xla":
         def body(qs, ks, vs, n):
             me = lax.axis_index(axis)
-            a, l, m = _local_partials(qs, ks, vs, me * t_loc, n[0], groups)
+            a, l, m = _local_partials(qs, ks, vs, me * t_loc, n, groups)
             m_star = lax.pmax(m, axis)
             sc = jnp.exp(m - m_star)
             num = lax.psum(a * sc[..., None], axis)
@@ -381,7 +394,7 @@ def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
             body, mesh=mesh,
             in_specs=(P(), P(None, axis), P(None, axis), P()),
             out_specs=P(), check_vma=False)
-        return f(q, cache_k, cache_v, kv_len.reshape(1))
+        return f(q, cache_k, cache_v, kv_len)
 
     interpret = resolve_interpret(ctx.interpret)
     shard_bytes = t_loc * hkv * d * cache_k.dtype.itemsize * b
@@ -410,7 +423,7 @@ def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
             body, mesh=mesh,
             in_specs=(P(), P(None, axis), P(None, axis), P()),
             out_specs=P(), check_vma=False)
-        return sync_interpret(f(q, cache_k, cache_v, kv_len.reshape(1)),
+        return sync_interpret(f(q, cache_k, cache_v, kv_len),
                               interpret)
 
     # tiled variant: KV stays in HBM, dummy 1x1 table (dense addressing).
@@ -461,8 +474,7 @@ def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
         body, mesh=mesh,
         in_specs=(P(), P(), P(None, axis), P(None, axis)),
         out_specs=P(), check_vma=False)
-    return sync_interpret(f(q, kv_len.reshape(1), cache_k, cache_v),
-                          interpret)
+    return sync_interpret(f(q, kv_len, cache_k, cache_v), interpret)
 
 
 def gqa_fwd_batch_decode_paged(q: jax.Array, pool_k: jax.Array,
@@ -483,7 +495,7 @@ def gqa_fwd_batch_decode_paged(q: jax.Array, pool_k: jax.Array,
       block_table: (w, B, n_pages) int32, dim 0 sharded — device r's
         table maps its logical page i of sequence b to a *local* slot id
         in [0, P_loc).
-      kv_len: scalar int32 global live length.
+      kv_len: int32 global live length — scalar or per-sequence (B,).
     Returns:
       (B, Hq, D) replicated.
     """
@@ -495,7 +507,7 @@ def gqa_fwd_batch_decode_paged(q: jax.Array, pool_k: jax.Array,
     n_pages = block_table.shape[2]
     groups = hq // hkv
     t_loc = n_pages * page_size
-    kv_len = jnp.asarray(kv_len, jnp.int32)
+    kv_len = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
 
     if impl == "xla":
         # Golden: reconstruct the contiguous (B, T, Hkv, D) view via
@@ -550,4 +562,4 @@ def gqa_fwd_batch_decode_paged(q: jax.Array, pool_k: jax.Array,
         in_specs=(P(), P(), P(axis), P(axis), P(axis)),
         out_specs=P(), check_vma=False)
     return sync_interpret(
-        f(q, kv_len.reshape(1), block_table, pool_k, pool_v), interpret)
+        f(q, kv_len, block_table, pool_k, pool_v), interpret)
