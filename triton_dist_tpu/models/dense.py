@@ -238,8 +238,13 @@ class DenseLLM:
                     "sp prefill is single-shot: pass offset as a static "
                     "0 (chunked prefill needs cache-aware ring steps)")
         offset = jnp.asarray(offset, jnp.int32)
-        pos = offset + jnp.tile(jnp.arange(s, dtype=jnp.int32)[None],
-                                (b, 1))
+        # (B,) per-row offsets supported for decode (continuous
+        # batching, Engine.serve_stream — same contract as the dense tp
+        # forward): per-row cache writes, masks, and rope positions.
+        assert offset.ndim == 0 or decode, "vector offset needs S == 1"
+        off2d = offset[:, None] if offset.ndim else offset
+        pos = off2d + jnp.tile(jnp.arange(s, dtype=jnp.int32)[None],
+                               (b, 1))
         tp = self.sp_ctx.head_axis  # single source of truth (ctor)
         xsh = P() if decode else P(None, sp, None)
         hsh = P() if decode else P(None, sp, tp, None)  # heads over tp
@@ -275,18 +280,29 @@ class DenseLLM:
             kc = constrain(k, csh).astype(ck.dtype)
             vc = constrain(v, csh).astype(cv.dtype)
             if block_table is None:
-                ck = jax.lax.dynamic_update_slice(ck, kc,
-                                                  (0, offset, 0, 0))
-                cv = jax.lax.dynamic_update_slice(cv, vc,
-                                                  (0, offset, 0, 0))
+                if offset.ndim:
+                    # Per-row decode positions: scatter one position
+                    # per row into its own lane.
+                    rows = jnp.arange(b)
+                    ck = ck.at[rows, offset].set(kc[:, 0])
+                    cv = cv.at[rows, offset].set(vc[:, 0])
+                else:
+                    ck = jax.lax.dynamic_update_slice(ck, kc,
+                                                      (0, offset, 0, 0))
+                    cv = jax.lax.dynamic_update_slice(cv, vc,
+                                                      (0, offset, 0, 0))
             elif decode:
                 # Single-position paged write — the address math lives
-                # in ONE place (PagedKVCacheManager.position_to_slot).
+                # in ONE place (PagedKVCacheManager.position_to_slot*).
                 from triton_dist_tpu.models.kv_cache import (
                     PagedKVCacheManager)
-                g, ip = PagedKVCacheManager.position_to_slot(
-                    block_table, offset, ck.shape[1],
-                    ck.shape[0] // self.mesh.shape[sp])
+                spd = ck.shape[0] // self.mesh.shape[sp]
+                if offset.ndim:
+                    g, ip = PagedKVCacheManager.position_to_slot_rows(
+                        block_table, offset, ck.shape[1], spd)
+                else:
+                    g, ip = PagedKVCacheManager.position_to_slot(
+                        block_table, offset, ck.shape[1], spd)
                 ck = ck.at[g, ip].set(kc[:, 0])
                 cv = cv.at[g, ip].set(vc[:, 0])
             else:

@@ -238,9 +238,10 @@ class Engine:
         model, mode = self.model, self.decode_mode
 
         @jax.jit
-        def step(params, caches, token, offsets, key, done):
+        def step(params, caches, token, offsets, key, done, table):
             logits, caches = model.forward(
-                params, token[:, None], caches, offsets, mode=mode)
+                params, token[:, None], caches, offsets, mode=mode,
+                **({"block_table": table} if table is not None else {}))
             nxt = sample_token(logits[:, -1], key, self.temperature,
                                self.top_k)
             nxt = jnp.where(done, token, nxt)
@@ -280,6 +281,24 @@ class Engine:
             return first[0], new_caches
         return admit
 
+    def _build_admit_paged(self):
+        """Paged admission: the batch-1 prefill scatters straight into
+        the freshly-allocated pages of the admitted row (its
+        (w, 1, n_pages) table slice) — no scratch cache, no row copy;
+        the pool IS the row's storage (vLLM-style)."""
+        model, mode = self.model, self.prefill_mode
+
+        @jax.jit
+        def admit(params, pools, ids, length, table_row, key):
+            logits, pools = model.forward(params, ids, pools, 0,
+                                          mode=mode,
+                                          block_table=table_row)
+            last = jax.lax.dynamic_slice_in_dim(logits, length - 1, 1,
+                                                axis=1)[:, 0]
+            first = sample_token(last, key, self.temperature, self.top_k)
+            return first[0], pools
+        return admit
+
     @staticmethod
     def _bucket_len(n: int) -> int:
         b = 8
@@ -301,12 +320,20 @@ class Engine:
         (tests/test_engine_stream.py). Returns prompt+generated token
         lists in input order.
 
-        Requires the dense tp modes (per-row offsets thread through
-        ``_attention_core``'s scatter path; sp/paged streaming would
-        virtualize slots via the block table instead — future work).
+        Works across all three engine families:
+          * dense tp — per-row offsets thread through
+            ``_attention_core``'s scatter path; admission scatters a
+            scratch prefill into the freed row's private lane;
+          * sp (seq-sharded cache) — same, through ``forward_sp``'s
+            per-row write/mask/rope path;
+          * sp + paged — admission allocates the row's pages and
+            prefills STRAIGHT into the pool via its table slice; a
+            retired row keeps its pages until its replacement is
+            admitted (free+realloc happen atomically at admission), so
+            frozen-row writes always land in pages the row still owns
+            and can never corrupt another sequence.
         """
-        assert self.decode_mode != "sp" and not self.paged, (
-            "serve_stream supports the dense tp engine modes")
+        paged = self.paged
         b = self.kv.batch
         if stop_tokens is None:
             eos = getattr(self.model.config, "eos_token_id", -1)
@@ -318,13 +345,21 @@ class Engine:
         assert all(len(p) for p in prompts), "prompts must be non-empty"
         assert all(len(p) + gen_len <= self.kv.max_seq for p in prompts), \
             "prompt + gen_len must fit max_seq"
+        # sp prefill shards S over the sp axis: buckets must divide.
+        sp_world = (self.model.mesh.shape[self.model.sp_axis]
+                    if self.decode_mode == "sp" else 1)
 
         self.kv.reset()
+        if paged:
+            for row in self.kv.owned_rows():
+                self.kv.free_seq(row)
         caches = self.kv.init()
+        cur_table = None
         if self._stream_step is None:
             self._stream_step = self._build_stream_step()
         if self._admit is None:
-            self._admit = self._build_admit()
+            self._admit = (self._build_admit_paged() if paged
+                           else self._build_admit())
 
         token = jnp.zeros((b,), jnp.int32)
         offsets = jnp.zeros((b,), jnp.int32)
@@ -349,7 +384,7 @@ class Engine:
             return False
 
         def admit_free_rows():
-            nonlocal next_req, token, offsets, caches
+            nonlocal next_req, token, offsets, caches, cur_table
             for r in range(b):
                 if next_req >= n_req:
                     return
@@ -357,13 +392,29 @@ class Engine:
                     rid = next_req
                     next_req += 1
                     prompt = prompts[rid]
-                    lb = min(self._bucket_len(len(prompt)),
-                             self.kv.max_seq)
+                    lb = self._bucket_len(len(prompt))
+                    lb = -(-lb // sp_world) * sp_world   # round UP to a
+                    lb = min(lb, self.kv.max_seq)        # world multiple
                     padded = list(prompt) + [0] * (lb - len(prompt))
                     self.key, sub = jax.random.split(self.key)
-                    first, caches = self._admit(
-                        params, caches, jnp.asarray([padded], jnp.int32),
-                        jnp.int32(len(prompt)), jnp.int32(r), sub)
+                    ids = jnp.asarray([padded], jnp.int32)
+                    if paged:
+                        # Atomic row turnover: the retiree's pages are
+                        # released and the newcomer's allocated in one
+                        # place, so no frozen row ever writes through a
+                        # table lane it no longer owns.
+                        if r in self.kv.owned_rows():
+                            self.kv.free_seq(r)
+                        self.kv.alloc_seq(r)
+                        cur_table = self.kv.block_table()
+                        first, caches = self._admit(
+                            params, caches, ids,
+                            jnp.int32(len(prompt)),
+                            cur_table[:, r:r + 1], sub)
+                    else:
+                        first, caches = self._admit(
+                            params, caches, ids, jnp.int32(len(prompt)),
+                            jnp.int32(r), sub)
                     row_req[r] = rid
                     row_budget[r] = gen_len
                     generated[rid] = []
@@ -379,7 +430,7 @@ class Engine:
             done = jnp.asarray([row_req[r] is None for r in range(b)])
             self.key, sub = jax.random.split(self.key)
             token, caches, offsets = self._stream_step(
-                params, caches, token, offsets, sub, done)
+                params, caches, token, offsets, sub, done, cur_table)
             toks = np.asarray(token)
             for r in range(b):
                 if row_req[r] is not None:

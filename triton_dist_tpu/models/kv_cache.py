@@ -222,27 +222,46 @@ class PagedKVCacheManager:
         return [(z, z) for _ in range(self.num_layers)]
 
     @staticmethod
+    def _addr(offset, page_size: int, n_pages: int):
+        """THE one definition of the page-layout address math — every
+        slot resolver below derives from it, so a layout change cannot
+        silently diverge between write()/forward_sp/the XLA golden.
+        Returns (device index r, local page lp, in-page row)."""
+        offset = jnp.asarray(offset, jnp.int32)
+        t_loc = page_size * n_pages
+        return offset // t_loc, (offset % t_loc) // page_size, \
+            offset % page_size
+
+    @staticmethod
     def position_to_slot(table: jax.Array, offset, page_size: int,
                          slots_per_dev: int):
         """Global position(s) → (global pool rows, in-page row).
 
-        THE one definition of the page-layout address math — shared by
-        :meth:`write`, the model-level paged decode
-        (DenseLLM.forward_sp), and the paged flash-decode XLA golden
-        (ops/flash_decode.py), so a layout change cannot silently
-        diverge between them. ``offset`` may be a scalar (one decode
-        step → rows (B,)) or a vector of T positions (golden
-        reconstruction → rows (T, B)).
+        ``offset`` may be a scalar (one decode step → rows (B,)) or a
+        vector of T positions (golden reconstruction → rows (T, B)).
         """
-        offset = jnp.asarray(offset, jnp.int32)
-        n_pages = table.shape[2]
-        t_loc = page_size * n_pages
-        r = offset // t_loc
-        lp = (offset % t_loc) // page_size
+        r, lp, inpage = PagedKVCacheManager._addr(offset, page_size,
+                                                  table.shape[2])
         # expand_dims makes scalar r broadcast as (1,)+(B,)->(B,) and
         # vector r as (T,1)+(T,B)->(T,B).
         gslots = jnp.expand_dims(r * slots_per_dev, -1) + table[r, :, lp]
-        return gslots, offset % page_size
+        return gslots, inpage
+
+    @staticmethod
+    def position_to_slot_rows(table: jax.Array, offsets, page_size: int,
+                              slots_per_dev: int):
+        """PER-ROW positions → (global pool rows (B,), in-page rows (B,)).
+
+        Row b's position ``offsets[b]`` resolves through row b's OWN
+        table lane (aligned indexing ``table[r[b], b, lp[b]]``) — the
+        continuous-batching decode step where every sequence sits at a
+        different write position (Engine.serve_stream paged mode).
+        """
+        r, lp, inpage = PagedKVCacheManager._addr(offsets, page_size,
+                                                  table.shape[2])
+        rows = jnp.arange(table.shape[1])
+        gslots = r * slots_per_dev + table[r, rows, lp]
+        return gslots, inpage
 
     def write(self, pools, layer: int, new_k: jax.Array, new_v: jax.Array,
               offset, table: jax.Array) -> list:
