@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.ops.allgather_gemm import (
     ag_gemm, ag_swiglu, create_ag_gemm_context)
@@ -102,6 +103,68 @@ def test_flash_decode_partial_tail(mesh8, key):
     ctx_e = dataclasses.replace(ctx, variant="einsum")
     ref = gqa_fwd_batch_decode(q, k, v, kv_len, ctx_e)
     assert_allclose(got, ref, rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("method", ["one_shot", "two_shot",
+                                    "recursive_doubling"])
+def test_allreduce_odd_partials(mesh8, key, method):
+    # (w, 136, 72): M=136 is not divisible by world=8, so TWO_SHOT must
+    # fall back rather than mis-slice; the others take it directly.
+    from triton_dist_tpu.ops.allreduce import (
+        AllReduceMethod, create_allreduce_context, all_reduce)
+    x = (jax.random.normal(key, (WORLD, 136, 72)) / 4).astype(jnp.float32)
+    ctx = create_allreduce_context(mesh8, "tp",
+                                   method=AllReduceMethod(method))
+    got = all_reduce(x, ctx, impl="pallas")
+    ref = all_reduce(x, ctx, impl="xla")
+    assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_a2a_unaligned_capacity(mesh8, key):
+    # capacity=12 has no sublane-aligned divisor -> chunk falls back to
+    # the full slab; live-count masking still must hold.
+    from triton_dist_tpu.ops.all_to_all import (
+        create_all_to_all_context, fast_all_to_all)
+    cap, h = 12, 128
+    ctx = create_all_to_all_context(mesh8, "tp", capacity=cap)
+    buf = jax.random.normal(key, (WORLD * WORLD, cap, h), jnp.float32)
+    counts = jax.random.randint(jax.random.PRNGKey(1), (WORLD * WORLD,),
+                                0, cap + 1, jnp.int32)
+    bufs = jax.device_put(buf, NamedSharding(mesh8, P("tp")))
+    counts_s = jax.device_put(counts, NamedSharding(mesh8, P("tp")))
+    recv, rc = fast_all_to_all(bufs, counts_s, ctx, impl="pallas")
+    ref, rc2 = fast_all_to_all(bufs, counts_s, ctx, impl="xla")
+    recv = np.asarray(recv).reshape(WORLD, WORLD, cap, h)
+    ref = np.asarray(ref).reshape(WORLD, WORLD, cap, h)
+    rcn = np.asarray(rc).reshape(WORLD, WORLD)
+    np.testing.assert_array_equal(np.asarray(rc), np.asarray(rc2))
+    for dst in range(WORLD):
+        for src in range(WORLD):
+            n = rcn[dst, src]
+            np.testing.assert_array_equal(recv[dst, src, :n],
+                                          ref[dst, src, :n])
+
+
+def test_hierarchical_nd_odd_payload(key):
+    # 2x2x2 mesh with a (24, 40) payload — no 128-multiples anywhere.
+    import numpy as _np
+    from jax.sharding import Mesh
+    from triton_dist_tpu.ops.hierarchical import (
+        all_gather_nd, all_reduce_nd)
+    devs = jax.devices()
+    mesh = Mesh(_np.array(devs).reshape(2, 2, 2), ("x", "y", "z"))
+    x = (jax.random.normal(key, (24, 40)) / 4).astype(jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P("x")))
+    ag = all_gather_nd(xs, mesh, ("x",))
+    np.testing.assert_allclose(np.asarray(ag)[:24], np.asarray(x),
+                               rtol=0, atol=0)
+    # all_reduce_nd sums the per-device views of a replicated input
+    # (in_specs=P(); see test_hierarchical.py) — replicated x sums to
+    # 8*x. The odd (24, 40) payload stresses the RS-ladder slicing
+    # (24 -> 12 -> 6 rows down the x/y rungs).
+    ar = all_reduce_nd(x, mesh, ("x", "y", "z"))
+    np.testing.assert_allclose(np.asarray(ar), 8 * np.asarray(x),
+                               rtol=1e-5, atol=1e-5)
 
 
 def test_flash_decode_per_row_lengths(mesh8, key):
