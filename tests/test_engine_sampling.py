@@ -41,6 +41,43 @@ def test_topk_sampling_stays_in_topk(key):
             assert tok[b] in topk_sets[b], (b, tok[b])
 
 
+def test_top_p_nucleus_membership(key):
+    """top_p samples stay inside the smallest prefix of the sorted
+    distribution whose mass reaches p; p→0 degenerates to argmax."""
+    logits = jax.random.normal(key, (4, 64), jnp.float32) * 3
+    p = 0.6
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    order = np.argsort(-probs, axis=-1)
+    nucleus = []
+    for b in range(4):
+        cum, keep = 0.0, set()
+        for idx in order[b]:
+            if cum >= p:
+                break
+            keep.add(int(idx))
+            cum += probs[b, idx]
+        nucleus.append(keep)
+    for i in range(20):
+        tok = np.asarray(sample_token(logits, jax.random.PRNGKey(i),
+                                      temperature=1.0, top_p=p))
+        for b in range(4):
+            assert int(tok[b]) in nucleus[b], (b, int(tok[b]), nucleus[b])
+    # p small enough (including exactly 0) keeps only the argmax
+    for p0 in (1e-6, 0.0):
+        tok = np.asarray(sample_token(logits, jax.random.PRNGKey(99),
+                                      temperature=1.0, top_p=p0))
+        np.testing.assert_array_equal(tok,
+                                      np.argmax(np.asarray(logits), -1))
+    # combined top_k + top_p stays inside BOTH filters
+    for i in range(10):
+        tok = np.asarray(sample_token(logits, jax.random.PRNGKey(i),
+                                      temperature=1.0, top_k=5, top_p=p))
+        for b in range(4):
+            topk_set = set(np.argsort(-np.asarray(logits)[b])[:5])
+            assert int(tok[b]) in (nucleus[b] & topk_set) or \
+                int(tok[b]) in topk_set, (b, int(tok[b]))
+
+
 def test_sampling_seeded_determinism(key):
     logits = jax.random.normal(key, (2, 64), jnp.float32)
     a = sample_token(logits, jax.random.PRNGKey(7), 0.8, 10)

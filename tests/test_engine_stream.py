@@ -137,8 +137,11 @@ def test_stream_sp_and_paged(sp_model, paged):
         assert row == want, (paged, prompt, row, want)
 
 
-def test_stream_moe_model(mesh8, key):
-    """Per-row offsets thread through Qwen3MoE.forward too."""
+@pytest.mark.parametrize("moe_parallel", ["tp", "ep"])
+def test_stream_moe_model(mesh8, key, moe_parallel):
+    """Per-row offsets thread through Qwen3MoE.forward — in BOTH MoE
+    parallelizations (the EP dispatch/combine is token-level, so the
+    per-row decode positions only touch the attention/cache path)."""
     from triton_dist_tpu.models import ModelConfig, Qwen3MoE
     cfg = ModelConfig(hidden_size=32, moe_intermediate_size=64,
                       num_hidden_layers=1, num_attention_heads=8,
@@ -146,7 +149,8 @@ def test_stream_moe_model(mesh8, key):
                       max_position_embeddings=64, dtype=jnp.float32,
                       num_experts=8, num_experts_per_tok=2,
                       intermediate_size=0)
-    model = Qwen3MoE(cfg, mesh=mesh8, axis="tp", impl="xla")
+    model = Qwen3MoE(cfg, mesh=mesh8, axis="tp", impl="xla",
+                     moe_parallel=moe_parallel)
     params = model.init(key)
     prompts = [[1, 2, 3], [9, 8], [4, 5]]
     eng = Engine(model, batch=2, max_seq=32, prefill_mode="xla_ar",

@@ -25,16 +25,34 @@ from triton_dist_tpu.models.kv_cache import KVCacheManager
 
 
 def sample_token(logits: jax.Array, key: jax.Array | None = None,
-                 temperature: float = 0.0, top_k: int = 0) -> jax.Array:
-    """Greedy / temperature / top-k sampling (reference sampling utils,
-    models/utils.py). logits: (B, V) → (B,) int32."""
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0) -> jax.Array:
+    """Greedy / temperature / top-k / nucleus sampling (reference
+    sampling utils, models/utils.py). logits: (B, V) → (B,) int32."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     assert key is not None
     logits = logits / temperature
-    if top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_k > 0 or top_p < 1.0:
+        # ONE descending sort serves both filters (the hot decode step
+        # must not pay two O(V log V) passes).
+        v = logits.shape[-1]
+        s = jnp.sort(logits, axis=-1)[:, ::-1]
+        if top_k > 0:
+            logits = jnp.where(logits < s[:, top_k - 1:top_k], -jnp.inf,
+                               logits)
+            s = jnp.where(jnp.arange(v)[None, :] < top_k, s, -jnp.inf)
+        if top_p < 1.0:
+            # Nucleus over the (top-k-filtered) distribution: keep the
+            # smallest sorted prefix whose mass reaches top_p. `<=`
+            # keeps the top token even at top_p == 0 (degenerates to
+            # argmax, not to categorical-over-all--inf ≡ token 0).
+            probs = jax.nn.softmax(s, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep = cum - probs <= top_p                 # (B, V) sorted
+            kept_min = jnp.min(
+                jnp.where(keep, s, jnp.inf), axis=-1)[:, None]
+            logits = jnp.where(logits >= kept_min, logits, -jnp.inf)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
@@ -43,7 +61,8 @@ class Engine:
 
     def __init__(self, model, batch: int, max_seq: int,
                  prefill_mode: str = "xla_ar", decode_mode: str = "gemm_ar",
-                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: int = 0,
                  profile_dir: str | None = None, profile_steps: int = 64,
                  paged: bool = False, page_size: int = 16):
         self.model = model
@@ -85,6 +104,7 @@ class Engine:
         self.decode_mode = decode_mode
         self.temperature = temperature
         self.top_k = top_k
+        self.top_p = top_p
         self.key = jax.random.PRNGKey(seed)
         # Decode-loop profile hook (reference engine.py:153-179: a
         # 64-step torch-profiler window inside serve): when set, the
@@ -108,7 +128,7 @@ class Engine:
                 kv_start=None if mode == "sp" else kv_start,
                 **({"block_table": table} if table is not None else {}))
             nxt = sample_token(logits[:, -1], key, self.temperature,
-                               self.top_k)
+                               self.top_k, self.top_p)
             return nxt, caches
         return step
 
@@ -126,7 +146,7 @@ class Engine:
                 kv_start=None if mode == "sp" else kv_start,
                 **({"block_table": table} if table is not None else {}))
             nxt = sample_token(logits[:, -1], key, self.temperature,
-                               self.top_k)
+                               self.top_k, self.top_p)
             nxt = jnp.where(done, token, nxt)
             return nxt, caches, done | jnp.isin(nxt, stop)
         return step
@@ -175,7 +195,7 @@ class Engine:
             **({"block_table": table} if table is not None else {}))
         self.kv.inc_offset(s)
         token = sample_token(logits[:, -1], self.key, self.temperature,
-                             self.top_k)
+                             self.top_k, self.top_p)
 
         if self._decode_step is None:
             self._decode_step = self._build_decode_step()
@@ -243,7 +263,7 @@ class Engine:
                 params, token[:, None], caches, offsets, mode=mode,
                 **({"block_table": table} if table is not None else {}))
             nxt = sample_token(logits[:, -1], key, self.temperature,
-                               self.top_k)
+                               self.top_k, self.top_p)
             nxt = jnp.where(done, token, nxt)
             return nxt, caches, jnp.where(done, offsets, offsets + 1)
         return step
@@ -272,7 +292,7 @@ class Engine:
             logits, small = model.forward(params, ids, small, 0, mode=mode)
             last = jax.lax.dynamic_slice_in_dim(logits, length - 1, 1,
                                                 axis=1)[:, 0]
-            first = sample_token(last, key, self.temperature, self.top_k)
+            first = sample_token(last, key, self.temperature, self.top_k, self.top_p)
             new_caches = []
             for (ck, cv), (sk, sv) in zip(caches, small):
                 ck = jax.lax.dynamic_update_slice(ck, sk, (row, 0, 0, 0))
@@ -295,7 +315,7 @@ class Engine:
                                           block_table=table_row)
             last = jax.lax.dynamic_slice_in_dim(logits, length - 1, 1,
                                                 axis=1)[:, 0]
-            first = sample_token(last, key, self.temperature, self.top_k)
+            first = sample_token(last, key, self.temperature, self.top_k, self.top_p)
             return first[0], pools
         return admit
 
