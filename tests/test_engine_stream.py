@@ -137,6 +137,32 @@ def test_stream_sp_and_paged(sp_model, paged):
         assert row == want, (paged, prompt, row, want)
 
 
+def test_stream_2d_tp_x_sp(mesh8, key):
+    """Streaming over the 2-D tp×sp grid: heads tensor-parallel inside
+    the sequence ring, per-row offsets through forward_sp."""
+    from jax.sharding import Mesh
+    import numpy as _np
+    devs = [d for d in mesh8.devices.flat]
+    mesh = Mesh(_np.array(devs).reshape(2, 4), ("tp", "sp"))
+    cfg = ModelConfig(hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, head_dim=16, vocab_size=64,
+                      max_position_embeddings=64, dtype=jnp.float32)
+    model = DenseLLM(cfg, mesh=mesh, axis="tp", sp_axis="sp",
+                     impl="pallas", fwd_mode="sp")
+    params = model.init(key)
+    prompts = [[1, 2, 3], [9, 8], [4, 5, 6, 7]]
+    eng = Engine(model, batch=2, max_seq=64, prefill_mode="sp",
+                 decode_mode="sp")
+    got = eng.serve_stream(params, prompts, 4)
+    golden = Engine(model, batch=1, max_seq=64, prefill_mode="xla_ar",
+                    decode_mode="xla_ar")
+    for prompt, row in zip(prompts, got):
+        want = np.asarray(golden.serve(
+            params, jnp.asarray([prompt], jnp.int32), 4))[0].tolist()
+        assert row == want, (prompt, row, want)
+
+
 @pytest.mark.parametrize("moe_parallel", ["tp", "ep"])
 def test_stream_moe_model(mesh8, key, moe_parallel):
     """Per-row offsets thread through Qwen3MoE.forward — in BOTH MoE
