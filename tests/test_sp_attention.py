@@ -190,6 +190,32 @@ def test_sp_fused_multi_tile(mesh8, causal, key):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=3e-4, atol=3e-4)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_sp_fused_q_groups(mesh8, causal, key):
+    """Tiny vmem_budget forces MULTIPLE resident q-groups: group 0
+    drives the ring, later groups replay the landed workspace with no
+    further communication — results must equal the golden exactly as in
+    the single-group case."""
+    import dataclasses as _dc
+    from triton_dist_tpu.ops.sp_attention import sp_ag_attention_fused
+    b, s, hq, hkv, d = 1, 256, 4, 2, 16
+    q = jax.random.normal(key, (b, s, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(8), (b, s, hkv, d),
+                          jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(9), (b, s, hkv, d),
+                          jnp.float32)
+    ctx = _dc.replace(
+        create_sp_attention_context(mesh8, "tp", causal=causal),
+        vmem_budget=20 * 1024)   # n_res = 1 of 4 slabs → 4 groups
+    sh = NamedSharding(mesh8, P(None, "tp"))
+    out = sp_ag_attention_fused(jax.device_put(q, sh),
+                                jax.device_put(k, sh),
+                                jax.device_put(v, sh), ctx,
+                                sq_blk=16, t_sub=16)
+    ref = attention_golden(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=3e-4, atol=3e-4)
+
+
 def test_zigzag_roundtrip(key):
     x = jax.random.normal(key, (2, 32, 3), jnp.float32)
     z = zigzag_reorder(x, world=4)

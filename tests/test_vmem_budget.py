@@ -82,15 +82,33 @@ def test_flash_decode_serving_shape_fits(world):
 
 
 def test_sp_attention_fused_prefill_shape_fits():
-    """The fused SP kernel's documented envelope — q/o and the fp32
-    online-softmax state VMEM-resident, s_loc·hq·d·4B bounded — at a
-    realistic distributed prefill shape (16k positions over 8 ranks)."""
+    """The fused SP kernel streams q in resident groups, so ANY prefill
+    shape must fit the budget — checked at a realistic distributed
+    shape (16k positions over 8 ranks)."""
     from triton_dist_tpu.ops.sp_attention import (
         create_sp_attention_context, sp_ag_attention_fused)
     mesh = _mesh(8)
     ctx = create_sp_attention_context(mesh, "tp", causal=True,
                                       interpret=True)
     b, s, hq, hkv, d = 1, 16384, 8, 2, 128   # s_loc = 2048
+    check_entry_vmem(
+        lambda q, k, v: sp_ag_attention_fused(q, k, v, ctx),
+        jax.ShapeDtypeStruct((b, s, hq, d), bf16),
+        jax.ShapeDtypeStruct((b, s, hkv, d), bf16),
+        jax.ShapeDtypeStruct((b, s, hkv, d), bf16))
+
+
+def test_sp_attention_fused_bench_shape_fits():
+    """THE bench.py sp_attn shape at world=1 (s_loc=4096, hq=16): q +
+    state total ~50 MB — the q-group residency must bound what reaches
+    VMEM (BENCH_r02's class; this shape failed the chip in round-3
+    session 4)."""
+    from triton_dist_tpu.ops.sp_attention import (
+        create_sp_attention_context, sp_ag_attention_fused)
+    mesh = _mesh(1)
+    ctx = create_sp_attention_context(mesh, "tp", causal=True,
+                                      interpret=True)
+    b, s, hq, hkv, d = 1, 4096, 16, 8, 128
     check_entry_vmem(
         lambda q, k, v: sp_ag_attention_fused(q, k, v, ctx),
         jax.ShapeDtypeStruct((b, s, hq, d), bf16),

@@ -78,6 +78,11 @@ class SpAttentionContext:
     causal: bool = True
     interpret: bool | None = None
     head_axis: str | None = None
+    # VMEM budget for the fused kernel's resident q-group + state
+    # (bytes): the wrapper sizes the slab group so q_buf + (m, l, acc)
+    # + the fixed KV tiles/output stage fit (BENCH_r02 class: an
+    # over-budget residency must never reach the compiler).
+    vmem_budget: int = 10 * 1024 * 1024
 
     @property
     def world_size(self) -> int:
@@ -124,12 +129,12 @@ def _online_update(state, scores, v):
     return m_new, l, acc
 
 
-def _sp_fused_kernel(q_ref, k_ref, v_ref, o_hbm, kw_hbm, vw_hbm, k_sub,
-                     v_sub, m_buf, l_buf, acc_buf, o_stage, copy_sem,
-                     ks_sem, vs_sem, o_sem, send_sem, recv_sem, *,
-                     axis: str, world: int, batch: int, s_loc: int,
-                     hkv: int, groups: int, d: int, sq_blk: int,
-                     t_sub: int, causal: bool):
+def _sp_fused_kernel(q_hbm, k_ref, v_ref, o_hbm, kw_hbm, vw_hbm, q_buf,
+                     k_sub, v_sub, m_buf, l_buf, acc_buf, o_stage,
+                     copy_sem, q_sem, ks_sem, vs_sem, o_sem, send_sem,
+                     recv_sem, *, axis: str, world: int, batch: int,
+                     s_loc: int, hkv: int, groups: int, d: int,
+                     sq_blk: int, t_sub: int, causal: bool, n_res: int):
     """Fused SP prefill attention: in-kernel ring AG of KV chunks feeding
     a tiled flash loop.
 
@@ -148,16 +153,22 @@ def _sp_fused_kernel(q_ref, k_ref, v_ref, o_hbm, kw_hbm, vw_hbm, k_sub,
     still forwarded — peers need them), mirroring the reference's
     early-exit blocks.
 
-    VMEM budget: q and the fp32 (m, l, acc) state are VMEM-resident →
-    ~s_loc·hq·d·6B must fit (~2k-4k positions/device at 8 heads). K/V
-    inputs, the AG workspace and the output live in HBM (outputs drain
-    through a double-buffered stage), so total sequence length is
-    unbounded (tests/test_vmem_budget.py checks the 16k/8-rank shape).
+    VMEM discipline: q lives in HBM pre-slabbed and is processed in
+    GROUPS of ``n_res`` slabs — each group's q + fp32 (m, l, acc) state
+    are VMEM-resident, sized to the budget by the wrapper (the bench
+    prefill shape put ~50 MB of q+state against the 16 MB chip —
+    BENCH_r02's class). The KV ring runs ONCE, during group 0 (its
+    forwarding fills the HBM workspace); later groups re-consume the
+    landed chunks with no further communication. K/V inputs, the AG
+    workspace and the output stay in HBM (outputs drain through a
+    double-buffered stage), so sequence length is unbounded
+    (tests/test_vmem_budget.py checks 16k/8-rank AND the bench shape).
     """
     me = lax.axis_index(axis)
     right = lax.rem(me + 1, world)
     n_sub = s_loc // t_sub
     n_q = s_loc // sq_blk
+    n_slabs = n_q * hkv
     scale = d ** -0.5
 
     # local chunk → workspace slot me (HBM→HBM)
@@ -188,21 +199,20 @@ def _sp_fused_kernel(q_ref, k_ref, v_ref, o_hbm, kw_hbm, vw_hbm, k_sub,
     # Row-folded q tiles: head h of q-tile i is a (B, sq_blk·G, D) slab —
     # every value in the flash inner loop stays ≤3-D with B as the single
     # dot batch dim (Mosaic: one-batch-dim matmuls, no 5-D relayouts).
-    # q arrives PRE-SLABBED as (n_q·hkv, B, rows, D) — the (seq, head) →
-    # slab permutation runs in XLA outside the kernel, so the kernel
-    # never reshapes (the in-kernel middle-dim reshape was the one
-    # construct the proven-compiling flash-decode kernels don't use).
+    # q arrives PRE-SLABBED as (n_q·hkv, B, rows, D) in HBM — the
+    # (seq, head) → slab permutation runs in XLA outside the kernel, so
+    # the kernel never reshapes (the in-kernel middle-dim reshape was
+    # the one construct the proven-compiling flash-decode kernels don't
+    # use).
     rows = sq_blk * groups
 
-    def q_slab(i, h):
-        return q_ref[i * hkv + h].astype(jnp.float32)
-
-    def consume_chunk(src):
+    def consume_chunk(src, slabs):
         """Fold chunk ``src`` (already in the HBM workspace) into the
-        online state, streaming KV subtiles through VMEM.
+        resident group's online state, streaming KV subtiles through
+        VMEM.
 
         The (m, l, acc) state lives in VMEM *scratch refs* indexed by a
-        static leading (q-tile, head) index and mutated in place —
+        static leading (group-local slab) index and mutated in place —
         round 2's ``dynamic_slice_in_dim`` loop-carried state failed
         Mosaic (VERDICT r2 weak 3), and a pytree-of-tiles fori_loop
         carry blows the VMEM stack (the compiler double-buffers the
@@ -231,87 +241,112 @@ def _sp_fused_kernel(q_ref, k_ref, v_ref, o_hbm, kw_hbm, vw_hbm, k_sub,
             ktile = k_sub[slot]                   # (B, t_sub, K, D)
             vtile = v_sub[slot]
 
-            for i in range(n_q):                  # static q-tile loop
-                for h in range(hkv):              # static head loop
-                    s = i * hkv + h
-                    kt = ktile[:, :, h, :].astype(jnp.float32)
-                    vt = vtile[:, :, h, :].astype(jnp.float32)
-                    s_blk = lax.dot_general(
-                        q_slab(i, h), kt, (((2,), (2,)), ((0,), (0,))),
-                        preferred_element_type=jnp.float32) * scale
-                    if causal:
-                        q_pos = me * s_loc + i * sq_blk + row_q
-                        k_pos = k_first + jnp.arange(t_sub)[None, :]
-                        s_blk = jnp.where((q_pos >= k_pos)[None],
-                                          s_blk, _NEG)
-                    mi, li, ai = m_buf[s], l_buf[s], acc_buf[s]
-                    m_new = jnp.maximum(mi, jnp.max(s_blk, axis=-1))
-                    p = jnp.exp(s_blk - m_new[..., None])
-                    corr = jnp.exp(mi - m_new)
-                    pv = lax.dot_general(
-                        p, vt, (((2,), (1,)), ((0,), (0,))),
-                        preferred_element_type=jnp.float32)
-                    m_buf[s] = m_new
-                    l_buf[s] = li * corr + jnp.sum(p, axis=-1)
-                    acc_buf[s] = ai * corr[..., None] + pv
+            for li, gidx in enumerate(slabs):     # static slab loop
+                i, h = divmod(gidx, hkv)
+                kt = ktile[:, :, h, :].astype(jnp.float32)
+                vt = vtile[:, :, h, :].astype(jnp.float32)
+                s_blk = lax.dot_general(
+                    q_buf[li].astype(jnp.float32), kt,
+                    (((2,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32) * scale
+                if causal:
+                    q_pos = me * s_loc + i * sq_blk + row_q
+                    k_pos = k_first + jnp.arange(t_sub)[None, :]
+                    s_blk = jnp.where((q_pos >= k_pos)[None],
+                                      s_blk, _NEG)
+                mi, li_, ai = m_buf[li], l_buf[li], acc_buf[li]
+                m_new = jnp.maximum(mi, jnp.max(s_blk, axis=-1))
+                p = jnp.exp(s_blk - m_new[..., None])
+                corr = jnp.exp(mi - m_new)
+                pv = lax.dot_general(
+                    p, vt, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)
+                m_buf[li] = m_new
+                l_buf[li] = li_ * corr + jnp.sum(p, axis=-1)
+                acc_buf[li] = ai * corr[..., None] + pv
             return _
 
         lax.fori_loop(0, n_sub, sub_step, None)
 
-    # Per-(q-tile, head) online-softmax state: (n_q·hkv, B, rows[, D]).
-    for s in range(n_q * hkv):
-        m_buf[s] = jnp.full((batch, rows), _NEG, jnp.float32)
-        l_buf[s] = jnp.zeros((batch, rows), jnp.float32)
-        acc_buf[s] = jnp.zeros((batch, rows, d), jnp.float32)
-
-    def ring_step(s, _):
-        cur = lax.rem(me - s + world, world)
-        nxt = lax.rem(me - s - 1 + world, world)
-        if world > 1:
-            @pl.when(s < world - 1)
-            def _():
-                for c in chunk_copy(cur):
-                    c.start()           # forward current chunk (ICI)
-        if causal:
-            # Chunks strictly in the future contribute nothing.
-            @pl.when(cur <= me)
-            def _():
-                consume_chunk(cur)
-        else:
-            consume_chunk(cur)
-        if world > 1:
-            @pl.when(s < world - 1)
-            def _():
-                for c in chunk_copy(nxt):
-                    c.wait_recv()       # next chunk must have landed
-        return _
-
-    lax.fori_loop(0, world, ring_step, None)
-
-    if world > 1:
-        def drain(s, _):
-            for c in chunk_copy(lax.rem(me - s + world, world)):
-                c.wait_send()
-            return _
-        lax.fori_loop(0, world - 1, drain, None)
-
-    def o_dma(slot, idx):
+    def o_dma(slot, gidx):
         # Slab-shaped output: one contiguous (B, rows, D) block per
         # (q-tile, head) — the un-permute back to (B, S, H, D) runs in
         # XLA outside the kernel.
         return pltpu.make_async_copy(
-            o_stage.at[slot], o_hbm.at[idx], o_sem.at[slot])
+            o_stage.at[slot], o_hbm.at[gidx], o_sem.at[slot])
 
-    n_slabs = n_q * hkv
-    for idx in range(n_slabs):
-        out = acc_buf[idx] / jnp.maximum(l_buf[idx], 1e-20)[..., None]
-        slot = idx % 2
-        if idx >= 2:
-            o_dma(slot, idx - 2).wait()
-        o_stage[slot] = out.astype(o_stage.dtype)
-        o_dma(slot, idx).start()
-    for idx in range(max(0, n_slabs - 2), n_slabs):
-        o_dma(idx % 2, idx).wait()
+    n_groups = -(-n_slabs // n_res)
+    for g in range(n_groups):                     # static group loop
+        slabs = list(range(g * n_res, min((g + 1) * n_res, n_slabs)))
+        glen = len(slabs)
+        # One contiguous DMA loads the group's q slabs.
+        qcp = pltpu.make_async_copy(
+            q_hbm.at[pl.ds(g * n_res, glen)], q_buf.at[pl.ds(0, glen)],
+            q_sem)
+        qcp.start()
+        qcp.wait()
+        for li in range(glen):
+            m_buf[li] = jnp.full((batch, rows), _NEG, jnp.float32)
+            l_buf[li] = jnp.zeros((batch, rows), jnp.float32)
+            acc_buf[li] = jnp.zeros((batch, rows, d), jnp.float32)
+
+        if g == 0:
+            # Group 0 drives the ring: forward each chunk while
+            # consuming it; afterwards the whole gathered KV sits in
+            # this device's workspace for the later groups.
+            def ring_step(s, _):
+                cur = lax.rem(me - s + world, world)
+                nxt = lax.rem(me - s - 1 + world, world)
+                if world > 1:
+                    @pl.when(s < world - 1)
+                    def _():
+                        for c in chunk_copy(cur):
+                            c.start()   # forward current chunk (ICI)
+                if causal:
+                    # Chunks strictly in the future contribute nothing.
+                    @pl.when(cur <= me)
+                    def _():
+                        consume_chunk(cur, slabs)
+                else:
+                    consume_chunk(cur, slabs)
+                if world > 1:
+                    @pl.when(s < world - 1)
+                    def _():
+                        for c in chunk_copy(nxt):
+                            c.wait_recv()   # next chunk must have landed
+                return _
+
+            lax.fori_loop(0, world, ring_step, None)
+
+            if world > 1:
+                def drain(s, _):
+                    for c in chunk_copy(lax.rem(me - s + world, world)):
+                        c.wait_send()
+                    return _
+                lax.fori_loop(0, world - 1, drain, None)
+        else:
+            # Later groups: every chunk already landed — no copies.
+            def replay_step(s, _):
+                cur = lax.rem(me - s + world, world)
+                if causal:
+                    @pl.when(cur <= me)
+                    def _():
+                        consume_chunk(cur, slabs)
+                else:
+                    consume_chunk(cur, slabs)
+                return _
+
+            lax.fori_loop(0, world, replay_step, None)
+
+        for li, gidx in enumerate(slabs):
+            out = acc_buf[li] / jnp.maximum(l_buf[li], 1e-20)[..., None]
+            slot = li % 2
+            if li >= 2:
+                o_dma(slot, slabs[li - 2]).wait()
+            o_stage[slot] = out.astype(o_stage.dtype)
+            o_dma(slot, gidx).start()
+        for li in range(max(0, glen - 2), glen):
+            o_dma(li % 2, slabs[li]).wait()
 
 
 def sp_ag_attention_fused(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -335,14 +370,24 @@ def sp_ag_attention_fused(q: jax.Array, k: jax.Array, v: jax.Array,
         sq_blk //= 2
     interpret = resolve_interpret(ctx.interpret)
 
-    kernel = functools.partial(
-        _sp_fused_kernel, axis=axis, world=world, batch=b, s_loc=s_loc,
-        hkv=hkv, groups=groups, d=d, sq_blk=sq_blk, t_sub=t_sub,
-        causal=ctx.causal)
-
     n_q = s_loc // sq_blk
     rows = sq_blk * groups
     n_slabs = n_q * hkv
+
+    # Size the resident q-group to the VMEM budget (the bench prefill
+    # shape put ~50 MB of q+state on a 16 MB chip — BENCH_r02's class).
+    item = q.dtype.itemsize
+    fixed = (2 * 2 * b * t_sub * hkv * d * k.dtype.itemsize   # k/v tiles
+             + 2 * b * rows * d * item)                       # o stage
+    per_slab = b * rows * (d * 4 + 8        # acc + m + l (fp32)
+                           + d * item)      # q_buf slab
+    n_res = max(1, min(n_slabs,
+                       (ctx.vmem_budget - fixed) // per_slab))
+
+    kernel = functools.partial(
+        _sp_fused_kernel, axis=axis, world=world, batch=b, s_loc=s_loc,
+        hkv=hkv, groups=groups, d=d, sq_blk=sq_blk, t_sub=t_sub,
+        causal=ctx.causal, n_res=n_res)
 
     def body(qs, ks, vs):
         # (B, S_loc, Hq, D) → (n_q·hkv, B, sq_blk·G, D): slab s = (i, h)
@@ -359,17 +404,18 @@ def sp_ag_attention_fused(q: jax.Array, k: jax.Array, v: jax.Array,
                                             k.dtype),
                        jax.ShapeDtypeStruct((world, b, s_loc, hkv, d),
                                             v.dtype)),
-            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
-                      any_spec(), any_spec()],
+            in_specs=[any_spec(), any_spec(), any_spec()],
             out_specs=(any_spec(), any_spec(), any_spec()),
             scratch_shapes=[
+                pltpu.VMEM((n_res, b, rows, d), q.dtype),
                 pltpu.VMEM((2, b, t_sub, hkv, d), k.dtype),
                 pltpu.VMEM((2, b, t_sub, hkv, d), v.dtype),
-                pltpu.VMEM((n_slabs, b, rows), jnp.float32),
-                pltpu.VMEM((n_slabs, b, rows), jnp.float32),
-                pltpu.VMEM((n_slabs, b, rows, d), jnp.float32),
+                pltpu.VMEM((n_res, b, rows), jnp.float32),
+                pltpu.VMEM((n_res, b, rows), jnp.float32),
+                pltpu.VMEM((n_res, b, rows, d), jnp.float32),
                 pltpu.VMEM((2, b, rows, d), q.dtype),
                 pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA(()),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
