@@ -116,6 +116,36 @@ def test_sp_attention_fused_bench_shape_fits():
         jax.ShapeDtypeStruct((b, s, hkv, d), bf16))
 
 
+def test_train_step_bench_config_fits():
+    """Trace the WHOLE fused train step (fwd + transpose-kernel bwd +
+    optax update) at bench.py's train config and assert every
+    pallas_call inside fits — forward gates alone miss the backward's
+    transposed shapes (e.g. gemm_rs contractions over inter=8192)."""
+    from triton_dist_tpu.models import DenseLLM, ModelConfig
+    from triton_dist_tpu.models.train import make_train_step
+    mesh = _mesh(1)   # the bench chip
+    cfg = ModelConfig(hidden_size=2048, intermediate_size=8192,
+                      num_hidden_layers=1,  # layers share kernel shapes
+                      num_attention_heads=16, num_key_value_heads=8,
+                      head_dim=128, vocab_size=32768,
+                      max_position_embeddings=1024, dtype=bf16)
+    model = DenseLLM(cfg, mesh=mesh, axis="tp", impl="pallas",
+                     fwd_mode="ag_rs")
+    for layer in (model.attn, model.mlp):
+        layer.ag_ctx.interpret = True
+        layer.rs_ctx.interpret = True
+    step, init_opt = make_train_step(model, mode="ag_rs", donate=False)
+    params = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
+    # Shape-only optimizer state: trace the STEP, not init_opt (which
+    # device_puts concrete arrays).
+    import optax
+    opt_shapes = jax.eval_shape(lambda p: optax.adamw(1e-4).init(p),
+                                params)
+    batch = {"input_ids": jax.ShapeDtypeStruct((4, 512), jnp.int32)}
+    check_entry_vmem(lambda p, o, bt: step(p, o, bt),
+                     params, opt_shapes, batch)
+
+
 def test_vmem_budget_catches_oversized_kernel():
     """The helper itself must detect an oversized kernel — the BENCH_r02
     config (16.5 MB of scratch on a 16 MB chip) reproduced in miniature."""
@@ -203,10 +233,14 @@ def test_ag_swiglu_bench_shape_fits(world):
     mesh = _mesh(world)
     ctx = create_ag_gemm_context(mesh, "tp", interpret=True)
     m, k = 2048, 4096
-    # world=1 is the bench chip: the full 12288-wide intermediate lands
-    # on one device (the r3 sp_attn lesson: gate at the TRUE bench
-    # shape, not a scaled-down stand-in).
-    for n in (4096, 12288 // world):
+    # ag_swiglu takes the GLOBAL weight width (n_loc = n // world
+    # inside). Gate (a) the exact width bench.py's tp_mlp runs at this
+    # world (inter = 12288 // max(n,8) * n → per-chip 1536), and (b) a
+    # 12288-global stress width (per-chip 12288 at world=1) so a config
+    # that only fits scaled-down stand-ins cannot pass CI (review r3i:
+    # the first version of this gate divided by world twice and tested
+    # an 8x-smaller kernel than the bench runs).
+    for n in (4096, 12288 // max(world, 8) * world, 12288):
         check_entry_vmem(
             lambda a, wg, wu: ag_swiglu(a, wg, wu, ctx, impl="pallas"),
             jax.ShapeDtypeStruct((m, k), bf16),
