@@ -240,7 +240,8 @@ def test_ag_swiglu_bench_shape_fits(world):
     # that only fits scaled-down stand-ins cannot pass CI (review r3i:
     # the first version of this gate divided by world twice and tested
     # an 8x-smaller kernel than the bench runs).
-    for n in (4096, 12288 // max(world, 8) * world, 12288):
+    for n in (4096, 12288 // max(world, 8) * world,
+              3072 * world, 12288):
         check_entry_vmem(
             lambda a, wg, wu: ag_swiglu(a, wg, wu, ctx, impl="pallas"),
             jax.ShapeDtypeStruct((m, k), bf16),
