@@ -55,6 +55,12 @@ QUEUE = [
     _bench_part("moe_ag_gg", 2700.0),
     _bench_part("gemm_ar", 2700.0),
     _bench_part("mega", 2700.0),
+    # The grouped SP kernel and the persistent compile cache give these
+    # two a real shot now; run them LAST so a long compile only costs
+    # the tail. A once-successful train compile persists in .jax_cache,
+    # making the driver's end-of-round bench near-free.
+    _bench_part("sp_attn", 2700.0),
+    _bench_part("train", 5400.0),
 ]
 
 
