@@ -8,6 +8,7 @@ dispatch/combine), SP (AG-KV attention + distributed flash decode), and
 PP (p2p buffers + pipeline schedule).
 """
 
+from triton_dist_tpu.parallel.plan import Plan, plan_parallelism
 from triton_dist_tpu.layers.ep_a2a import DispatchHandle, EPAll2AllLayer
 from triton_dist_tpu.layers.ep_moe import EPMoE
 from triton_dist_tpu.layers.p2p import CommOp
@@ -26,6 +27,8 @@ SP_LAYERS = (SpFlashDecodeLayer, SpAttentionLayer)
 PP_LAYERS = (CommOp,)
 
 __all__ = [
+    "Plan",
+    "plan_parallelism",
     "CommOp",
     "DispatchHandle",
     "EPAll2AllLayer",

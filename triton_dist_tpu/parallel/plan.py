@@ -1,0 +1,159 @@
+"""Parallelism planner: model config + chip count → a recommended
+layout.
+
+The reference leaves strategy choice to the user (its tests hard-code
+TP=8 etc.); here the framework's divisibility rules and the round-3
+measured crossovers (BENCH_NOTES_r3.md; e.g. replicated GEMM-AR wins
+small-batch decode) pick a starting point:
+
+- **tp** divides BOTH the kv-head count and the MLP intermediate
+  (gcd-based cap) and grows until the per-chip parameter bytes fit
+  comfortably in HBM;
+- **ep** covers the expert dim when the config is MoE (experts spread
+  before heads split further — expert FLOPs dominate);
+- **sp** takes the remaining factor when the serving context is long
+  (the sequence-sharded cache is what scales max_seq);
+- anything left replicates as **dp**; chips that no legal factoring
+  can use are reported in ``reasons`` rather than silently dropped.
+
+The output is a starting point, not an oracle — the distributed
+autotuner (tools/autotuner.py) refines tile configs per shape, and
+``Plan.mesh()`` hands back the concrete `jax.sharding.Mesh` to build
+models on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """A recommended parallel layout over ``n_chips``."""
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    dp: int = 1
+    prefill_mode: str = "ag_rs"
+    decode_mode: str = "gemm_ar"
+    moe_parallel: str | None = None   # None for dense configs
+    reasons: tuple = ()
+
+    @property
+    def axis_names(self) -> tuple:
+        names = []
+        for name in ("dp", "ep", "tp", "sp"):
+            if getattr(self, name) > 1 or name == "tp":
+                names.append(name)
+        return tuple(names)
+
+    def mesh(self, devices=None) -> Mesh:
+        devs = list(devices if devices is not None else jax.devices())
+        shape = tuple(getattr(self, n) for n in self.axis_names)
+        n = int(np.prod(shape))
+        assert len(devs) >= n, (len(devs), shape)
+        return Mesh(np.array(devs[:n]).reshape(shape), self.axis_names)
+
+
+def _divisors_leq(n: int, cap: int) -> list:
+    """All divisors of ``n`` that are <= cap, ascending (>= [1])."""
+    return [d for d in range(1, max(1, min(n, cap)) + 1) if n % d == 0]
+
+
+def plan_parallelism(config, n_chips: int, max_seq: int = 4096,
+                     decode_batch: int = 8,
+                     hbm_bytes: int = 16 * 2 ** 30) -> Plan:
+    """Pick (dp, ep, tp, sp) for ``config`` over ``n_chips``.
+
+    Heuristics (each recorded in ``Plan.reasons``):
+      1. MoE configs give the expert dim first claim on chips.
+      2. tp ∈ divisors(gcd(kv_heads, intermediate)) grows until the
+         per-chip parameter bytes fit in ~half HBM (leaving room for
+         activations + KV); if no legal tp fits, the largest legal one
+         is taken and the shortfall is recorded.
+      3. Long contexts (max_seq > 8k) spend remaining chips on sp.
+      4. Anything left becomes dp; chips no legal factoring can use
+         are reported, never silently idled.
+    """
+    c = config
+    reasons = []
+    remaining = n_chips
+    is_moe = getattr(c, "num_experts", 0) and c.num_experts > 0
+
+    ep = 1
+    if is_moe:
+        ep = _divisors_leq(c.num_experts, remaining)[-1]
+        remaining //= ep
+        reasons.append(f"ep={ep}: {c.num_experts} experts spread first "
+                       "(EP moves routed tokens only)")
+
+    # Parameter bytes per chip under tp (dense part + experts under ep).
+    h = c.hidden_size
+    inter = getattr(c, "intermediate_size", 0) or getattr(
+        c, "moe_intermediate_size", 0)
+    n_layers = c.num_hidden_layers
+    head_bytes = 2 * h * (c.num_attention_heads
+                          + 2 * c.num_key_value_heads) * c.head_dim
+    mlp_bytes = 3 * h * inter * 2
+    if is_moe:
+        mlp_bytes = 3 * h * (c.moe_intermediate_size or inter) * 2 \
+            * c.num_experts
+    per_layer = head_bytes + mlp_bytes / max(ep, 1)
+    embed = 2 * 2 * h * c.vocab_size
+    total = per_layer * n_layers + embed
+
+    # tp must divide BOTH the kv heads and the intermediate (review
+    # r3j: a min()-based cap let tp=3 through against 8 kv heads).
+    cap_basis = c.num_key_value_heads
+    if inter:
+        cap_basis = math.gcd(cap_basis, inter)
+    tp = 1
+    for d in _divisors_leq(cap_basis, remaining):  # ascending
+        tp = d
+        if total / d <= hbm_bytes // 2:
+            break
+    if total / tp > hbm_bytes // 2:
+        reasons.append(
+            f"WARNING: even tp={tp} (largest legal) leaves "
+            f"{total / tp / 2**30:.1f} GiB params/chip")
+    remaining //= tp
+    reasons.append(f"tp={tp}: ~{total / tp / 2**30:.1f} GiB params/chip "
+                   f"(gcd cap {cap_basis})")
+
+    sp = 1
+    if max_seq > 8192 and remaining > 1:
+        sp = remaining
+        remaining = 1
+        reasons.append(f"sp={sp}: max_seq {max_seq} wants the "
+                       "sequence-sharded cache")
+    dp = max(1, remaining)
+    if dp > 1:
+        reasons.append(f"dp={dp}: leftover chips replicate for "
+                       "throughput")
+    used = ep * tp * sp * dp
+    if used < n_chips:
+        reasons.append(f"NOTE: {n_chips - used} of {n_chips} chips "
+                       "unused (no legal factoring absorbs them; "
+                       "consider a chip count matching the expert/"
+                       "head divisors)")
+
+    if sp > 1:
+        prefill = decode = "sp"
+    else:
+        prefill = "ag_rs"
+        # Round-3 measured crossover (BENCH_NOTES_r3.md): replicated
+        # GEMM-AR wins small decode batches; the sharded path wins once
+        # the batch splits usefully across tp.
+        decode = "gemm_ar" if decode_batch < 8 * tp else "ag_rs"
+        reasons.append(f"decode={decode} at batch {decode_batch}")
+
+    return Plan(tp=tp, sp=sp, ep=ep, dp=dp, prefill_mode=prefill,
+                decode_mode=decode,
+                moe_parallel=("ep" if ep > 1 else
+                              ("tp" if is_moe else None)),
+                reasons=tuple(reasons))
