@@ -157,3 +157,30 @@ def plan_parallelism(config, n_chips: int, max_seq: int = 4096,
                 moe_parallel=("ep" if ep > 1 else
                               ("tp" if is_moe else None)),
                 reasons=tuple(reasons))
+
+
+def main():  # pragma: no cover — thin CLI over plan_parallelism
+    """``tdt-plan``: recommend a parallel layout for a model + chips."""
+    import argparse
+    import json
+    from triton_dist_tpu.models import ModelConfig
+
+    ap = argparse.ArgumentParser(
+        description="Recommend (dp, ep, tp, sp) for a model")
+    ap.add_argument("--model-dir", default=None,
+                    help="HF checkpoint dir (reads config.json)")
+    ap.add_argument("--chips", type=int, required=True)
+    ap.add_argument("--max-seq", type=int, default=4096)
+    ap.add_argument("--decode-batch", type=int, default=8)
+    ap.add_argument("--hbm-gib", type=float, default=16.0)
+    args = ap.parse_args()
+    cfg = (ModelConfig.from_hf_config(args.model_dir) if args.model_dir
+           else ModelConfig())
+    p = plan_parallelism(cfg, args.chips, max_seq=args.max_seq,
+                         decode_batch=args.decode_batch,
+                         hbm_bytes=int(args.hbm_gib * 2 ** 30))
+    print(json.dumps({
+        "mesh": {n: getattr(p, n) for n in p.axis_names},
+        "prefill_mode": p.prefill_mode, "decode_mode": p.decode_mode,
+        "moe_parallel": p.moe_parallel, "reasons": list(p.reasons),
+    }, indent=2))
