@@ -137,6 +137,25 @@ def test_stream_sp_and_paged(sp_model, paged):
         assert row == want, (paged, prompt, row, want)
 
 
+def test_stream_randomized_admission_fuzz(small_model, mesh8):
+    """Seeded fuzz over the admission scheduler: random prompt lengths,
+    a random stop token, 12 requests through 3 rows — every streamed
+    row must equal its solo generation (reference stress_test_ag_gemm
+    style: randomized loops catching sync bugs)."""
+    model, params = small_model
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, 64, size=int(n)).tolist()
+               for n in rng.integers(1, 7, size=12)]
+    stop = (int(rng.integers(1, 64)),)
+    gen_len = int(rng.integers(2, 7))
+    eng = Engine(model, batch=3, max_seq=32, prefill_mode="xla_ar",
+                 decode_mode="gemm_ar")
+    got = eng.serve_stream(params, prompts, gen_len, stop_tokens=stop)
+    for prompt, row in zip(prompts, got):
+        want = solo(model, params, mesh8, prompt, gen_len, stop=stop)
+        assert row == want, (prompt, row, want)
+
+
 def test_stream_2d_tp_x_sp(mesh8, key):
     """Streaming over the 2-D tp×sp grid: heads tensor-parallel inside
     the sequence ring, per-row offsets through forward_sp."""
