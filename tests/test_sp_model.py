@@ -110,6 +110,44 @@ def test_sp_paged_serving_matches(sp_setup):
                                   out_pg)
 
 
+def test_sp_chunked_prefill_matches_single_shot(sp_setup):
+    """Chunked prefill (cache-aware ring: q_offset/kv_len) must produce
+    the same final logits and caches as the single-shot prefill."""
+    mesh, cfg, model, params = sp_setup
+    b, s = 2, 32
+    ids = jax.random.randint(jax.random.PRNGKey(9), (b, s), 0,
+                             cfg.vocab_size, jnp.int32)
+    kv = KVCacheManager(cfg.num_hidden_layers, b, 64,
+                        cfg.num_key_value_heads, cfg.head_dim, mesh=mesh,
+                        axis="sp", seq_shard=True, dtype=cfg.dtype)
+    lo_full, caches_full = model.forward(params, ids, kv.init(), 0,
+                                         mode="sp")
+    # two chunks of 16
+    lo_a, caches = model.forward(params, ids[:, :16], kv.init(), 0,
+                                 mode="sp")
+    lo_b, caches = model.forward(params, ids[:, 16:], caches, 16,
+                                 mode="sp")
+    np.testing.assert_allclose(np.asarray(lo_a),
+                               np.asarray(lo_full[:, :16]),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(lo_b),
+                               np.asarray(lo_full[:, 16:]),
+                               rtol=2e-4, atol=2e-4)
+    for (ka, va), (kf, vf) in zip(caches, caches_full):
+        np.testing.assert_allclose(np.asarray(ka)[:, :s],
+                                   np.asarray(kf)[:, :s],
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(va)[:, :s],
+                                   np.asarray(vf)[:, :s],
+                                   rtol=1e-5, atol=1e-5)
+    # decode continues identically from either cache
+    tok = ids[:, -1:]
+    dec_a, _ = model.forward(params, tok, caches, s, mode="sp")
+    dec_b, _ = model.forward(params, tok, caches_full, s, mode="sp")
+    np.testing.assert_allclose(np.asarray(dec_a), np.asarray(dec_b),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_sp_engine_rejects_mixed_modes(sp_setup):
     mesh, cfg, model, params = sp_setup
     with pytest.raises(AssertionError, match="prefill and decode"):

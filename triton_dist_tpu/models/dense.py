@@ -228,15 +228,17 @@ class DenseLLM:
         b, s = input_ids.shape
         sp = self.sp_axis
         decode = s == 1
-        if s > 1:
-            # Silent-corruption guard: the S>1 branch attends only over
-            # the just-projected chunk, so a chunked prefill (offset>0)
-            # would never see the cached prefix. A traced offset could
-            # smuggle a nonzero through, so prefill requires a STATIC 0.
-            if isinstance(offset, jax.core.Tracer) or int(offset) != 0:
-                raise NotImplementedError(
-                    "sp prefill is single-shot: pass offset as a static "
-                    "0 (chunked prefill needs cache-aware ring steps)")
+        # Chunked prefill (S > 1, offset > 0): the chunk's K/V are
+        # written into the cache, then ring attention runs with the
+        # CACHE as the rotating KV — q positions offset+[0, S), live KV
+        # limited to offset+S (sp_ag_attention q_offset/kv_len). A
+        # traced offset conservatively selects the chunked path.
+        chunked = s > 1 and (isinstance(offset, jax.core.Tracer)
+                             or int(offset) != 0)
+        if chunked:
+            assert block_table is None, (
+                "chunked sp prefill supports the linear seq-sharded "
+                "cache (paged prefill stages from position 0)")
         offset = jnp.asarray(offset, jnp.int32)
         # (B,) per-row offsets supported for decode (continuous
         # batching, Engine.serve_stream — same contract as the dense tp
@@ -320,10 +322,34 @@ class DenseLLM:
                         q[:, 0], ck, cv, block_table, offset + 1,
                         self.fd_ctx, impl=self.fd_impl)
                 att = att[:, None]
+            elif chunked:
+                # Cache-aware chunk: attend over the updated cache
+                # (prefix [0, offset) + this chunk), ring or xla. With a
+                # STATIC offset (the scheduler's common case) the
+                # rotated KV is sliced to the world-aligned live prefix
+                # — a 512-token chunk at the front of a 64k cache must
+                # not ppermute 64k mostly-masked positions per layer.
+                ck_att, cv_att = ck, cv
+                if not isinstance(offset, jax.core.Tracer):
+                    # Round the live prefix up to whole cache SHARDS so
+                    # the slice keeps the existing sharding (no reshard
+                    # data movement).
+                    world_sp = self.mesh.shape[sp]
+                    t_cache = ck.shape[1]
+                    if t_cache % world_sp == 0:
+                        per = t_cache // world_sp
+                        t_live = min(t_cache,
+                                     -(-(int(offset) + s) // per) * per)
+                        if t_live < t_cache:
+                            ck_att = ck[:, :t_live]
+                            cv_att = cv[:, :t_live]
+                att = sp_ag_attention(
+                    q, ck_att, cv_att, self.sp_ctx,
+                    impl=("xla" if self.sp_impl == "xla" else "ring"),
+                    q_offset=offset, kv_len=offset + s)
             else:
-                # Ring attention over the JUST-projected K/V: the SP
-                # prefill starts at offset 0 (the Engine's contract);
-                # chunked prefill would need cache-aware ring steps.
+                # Ring attention over the JUST-projected K/V (single-
+                # shot prefill from offset 0 — the Engine's fast path).
                 att = sp_ag_attention(q, k, v, self.sp_ctx,
                                       impl=self.sp_impl)
             att = att.reshape(b, s, hq * d)

@@ -64,7 +64,8 @@ class Engine:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: int = 0,
                  profile_dir: str | None = None, profile_steps: int = 64,
-                 paged: bool = False, page_size: int = 16):
+                 paged: bool = False, page_size: int = 16,
+                 prefill_chunk: int | None = None):
         self.model = model
         c = model.config
         self.paged = paged
@@ -112,6 +113,13 @@ class Engine:
         # per-host under ``profile_dir``.
         self.profile_dir = profile_dir
         self.profile_steps = profile_steps
+        # Chunked sp prefill: bound activation memory on very long
+        # prompts by prefilling ``prefill_chunk`` positions at a time
+        # (cache-aware ring attention; dense.forward_sp chunked path).
+        if prefill_chunk is not None:
+            assert prefill_mode == "sp" and not paged, (
+                "prefill_chunk applies to the (non-paged) sp engine")
+        self.prefill_chunk = prefill_chunk
         self._decode_step = None
         self._decode_step_stop = None
         self._stream_step = None
@@ -189,10 +197,22 @@ class Engine:
         if self.prefill_mode == "sp":
             # SP serving has no ragged support (forward_sp's contract).
             assert not bool(kv_start.any()), "sp serving is non-ragged"
-        logits, caches = self.model.forward(
-            params, input_ids, caches, 0, mode=self.prefill_mode,
-            kv_start=None if self.prefill_mode == "sp" else kv_start,
-            **({"block_table": table} if table is not None else {}))
+        chunk = self.prefill_chunk
+        if chunk and self.prefill_mode == "sp" and s > chunk:
+            # Cache-aware chunked prefill: activation memory is bounded
+            # by the chunk, the cache accumulates the prefix.
+            done_pos = 0
+            while done_pos < s:
+                step_s = min(chunk, s - done_pos)
+                logits, caches = self.model.forward(
+                    params, input_ids[:, done_pos:done_pos + step_s],
+                    caches, done_pos, mode="sp")
+                done_pos += step_s
+        else:
+            logits, caches = self.model.forward(
+                params, input_ids, caches, 0, mode=self.prefill_mode,
+                kv_start=None if self.prefill_mode == "sp" else kv_start,
+                **({"block_table": table} if table is not None else {}))
         self.kv.inc_offset(s)
         token = sample_token(logits[:, -1], self.key, self.temperature,
                              self.top_k, self.top_p)

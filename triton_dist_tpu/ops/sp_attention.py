@@ -101,20 +101,26 @@ def create_sp_attention_context(mesh: Mesh | None = None, axis: str = "sp",
                               interpret=interpret, head_axis=head_axis)
 
 
-def _chunk_scores(q, k, q_first, k_first, causal: bool):
+def _chunk_scores(q, k, q_first, k_first, causal: bool, kv_live=None):
     """Masked scores of one (Q block, KV block) pair.
 
     q: (B, K, G, Sq, D) fp32; k: (B, T, K, D); returns (B, K, G, Sq, T).
+    ``kv_live``: global number of live KV positions — KV block entries
+    at or past it are masked (cache-aware chunked prefill, where the
+    KV blocks come from a partially-filled cache).
     """
     d = q.shape[-1]
     scores = jnp.einsum("bkgsd,btkd->bkgst", q,
                         k.astype(jnp.float32)) * (d ** -0.5)
+    sq, t = scores.shape[-2], scores.shape[-1]
+    k_pos = k_first + jnp.arange(t)[None, :]
+    mask = jnp.ones((sq, t), bool)
     if causal:
-        sq, t = scores.shape[-2], scores.shape[-1]
         q_pos = q_first + jnp.arange(sq)[:, None]
-        k_pos = k_first + jnp.arange(t)[None, :]
-        scores = jnp.where(q_pos >= k_pos, scores, _NEG)
-    return scores
+        mask = q_pos >= k_pos
+    if kv_live is not None:
+        mask = mask & (k_pos < kv_live)
+    return jnp.where(mask, scores, _NEG)
 
 
 def _online_update(state, scores, v):
@@ -436,13 +442,20 @@ def sp_ag_attention_fused(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     ctx: SpAttentionContext | None = None,
-                    impl: str = "ring") -> jax.Array:
+                    impl: str = "ring", q_offset=0,
+                    kv_len=None) -> jax.Array:
     """Sequence-parallel (self-)attention (functional entry, reference
     ``fused_sp_ag_attn_inter_node`` sp_ag_attention_inter_node.py:504).
 
     Args:
       q: (B, S, Hq, D), S sequence-sharded over ``ctx.axis``.
-      k/v: (B, S, Hkv, D), sharded the same way.
+      k/v: (B, T, Hkv, D), sharded the same way. T may EXCEED S
+        (cache-aware chunked prefill: k/v are the full sequence-sharded
+        cache, q is one chunk).
+      q_offset: global position of q's first row (chunk base; 0 for
+        whole-sequence prefill). ring/xla impls only.
+      kv_len: number of live KV positions (<= T); positions beyond are
+        masked. Default: all of T.
     Returns:
       (B, S, Hq, D) outputs, sequence-sharded like q.
     """
@@ -454,6 +467,17 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     groups = hq // hkv
     assert s % world == 0
     s_loc = s // world
+    t = k.shape[1]
+    assert t % world == 0
+    t_loc = t // world
+    chunked = (kv_len is not None or t != s
+               or not (isinstance(q_offset, int) and q_offset == 0))
+    if chunked:
+        assert impl in ("xla", "ring"), (
+            f"q_offset/kv_len (chunked prefill) support impl 'ring' and "
+            f"'xla', not {impl!r}")
+    q_offset = jnp.asarray(q_offset, jnp.int32)
+    kv_len = jnp.asarray(t if kv_len is None else kv_len, jnp.int32)
 
     def finish(state, qs_dtype):
         m, l, acc = state
@@ -474,7 +498,8 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         kg = lax.all_gather(ks, axis, axis=1, tiled=True)
         vg = lax.all_gather(vs, axis, axis=1, tiled=True)
         qf = local_q(qs, ks.shape[2])
-        scores = _chunk_scores(qf, kg, me * s_loc, 0, causal)
+        scores = _chunk_scores(qf, kg, q_offset + me * s_loc, 0, causal,
+                               kv_live=kv_len)
         m = jnp.max(scores, axis=-1)
         p = jnp.exp(scores - m[..., None])
         l = jnp.sum(p, axis=-1)
@@ -496,13 +521,15 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             # Next hop first — XLA overlaps it with this step's einsums.
             kn = lax.ppermute(kc, axis, perm)
             vn = lax.ppermute(vc, axis, perm)
-            scores = _chunk_scores(qf, kc, me * s_loc, src * s_loc, causal)
+            scores = _chunk_scores(qf, kc, q_offset + me * s_loc,
+                                   src * t_loc, causal, kv_live=kv_len)
             state = _online_update(state, scores, vc)
             return state, kn, vn
 
         state, kc, vc = lax.fori_loop(0, world - 1, step, (state, ks, vs))
         src = lax.rem(me - (world - 1) + world, world)
-        scores = _chunk_scores(qf, kc, me * s_loc, src * s_loc, causal)
+        scores = _chunk_scores(qf, kc, q_offset + me * s_loc,
+                               src * t_loc, causal, kv_live=kv_len)
         state = _online_update(state, scores, vc)
         return finish(state, qs.dtype)
 
