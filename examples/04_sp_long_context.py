@@ -65,6 +65,18 @@ def main():
     qd = jax.random.normal(jax.random.PRNGKey(3), (b, hq, d), jnp.float32)
     dec = gqa_fwd_batch_decode(qd, k, v, jnp.int32(s), dctx, impl="pallas")
     print("decode out", dec.shape, "finite:", bool(jnp.isfinite(dec).all()))
+
+    # chunked prefill: a LATER chunk of queries attends the cache-like
+    # full KV with live-length masking (q_offset/kv_len) — the
+    # cache-aware path behind Engine(prefill_chunk=...).
+    half = s // 2
+    q2 = jax.device_put(q[:, half:], sh)
+    chunk_out = sp_ag_attention(q2, k, v, ctx, impl="ring",
+                                q_offset=half, kv_len=s)
+    np.testing.assert_allclose(np.asarray(chunk_out),
+                               np.asarray(out[:, half:]), rtol=2e-4,
+                               atol=2e-4)
+    print("chunked prefill (second half) == single-shot second half")
     print("OK")
 
 
