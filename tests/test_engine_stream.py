@@ -137,6 +137,20 @@ def test_stream_sp_and_paged(sp_model, paged):
         assert row == want, (paged, prompt, row, want)
 
 
+def test_stream_sampled_deterministic_per_seed(small_model):
+    """Stochastic streaming is reproducible: same seed → same tokens
+    (the engine key advances identically through admissions + steps)."""
+    model, params = small_model
+    prompts = [[1, 2], [3, 4, 5], [6]]
+    outs = []
+    for _ in range(2):
+        eng = Engine(model, batch=2, max_seq=32, prefill_mode="xla_ar",
+                     decode_mode="gemm_ar", temperature=0.8, top_k=8,
+                     top_p=0.9, seed=13)
+        outs.append(eng.serve_stream(params, prompts, 4))
+    assert outs[0] == outs[1]
+
+
 def test_stream_randomized_admission_fuzz(small_model, mesh8):
     """Seeded fuzz over the admission scheduler: random prompt lengths,
     a random stop token, 12 requests through 3 rows — every streamed
