@@ -638,6 +638,24 @@ def _bench_mega_vs_engine(mesh, n, on_tpu, extras):
     extras["mega_step_ms"] = round(t_mega, 4)
     extras["engine_step_ms"] = round(t_engine, 4)
     extras["mega_vs_engine"] = round(t_engine / t_mega, 4)
+
+    # Continuous-batching hot path: the stream decode step runs every
+    # row at its OWN cache position (per-row scatter writes + per-row
+    # masks/rope — Engine.serve_stream). Its cost vs the plain
+    # uniform-offset step quantifies the scheduling flexibility's price.
+    offsets0 = jnp.full((b,), 4, jnp.int32)
+
+    def stream_step(x, p, cc):
+        token = (jnp.abs(x) * 997).astype(jnp.int32) % cfg.vocab_size
+        logits, _ = model.forward(p, token, cc, offsets0 + token[:, 0] % 2,
+                                  mode="gemm_ar")
+        return jnp.mean(logits[:, -1].astype(jnp.float32), axis=-1,
+                        keepdims=True)
+
+    t_stream = perf_func_chained(_args_step(stream_step, params, caches),
+                                 x0, (8, 24))
+    extras["stream_step_ms"] = round(t_stream, 4)
+    extras["stream_vs_engine_step"] = round(t_engine / t_stream, 4)
     return t_mega, t_engine / t_mega
 
 
