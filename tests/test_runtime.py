@@ -58,3 +58,44 @@ def test_assert_allclose():
         assert_allclose(a, a + 1.0)
     with pytest.raises(AssertionError):
         assert_allclose(a, np.ones((2, 2)))
+
+
+def test_perturb_input_distinct_in_leaf_dtype():
+    """The perturbation step must be representable in the LEAF's dtype —
+    bf16's eps is 2^-7; a fixed 1e-4 step would round to exactly 1.0
+    and silently reintroduce the tunnel-dedup bug (bench methodology,
+    docs/perf.md)."""
+    from triton_dist_tpu.runtime.utils import perturb_input
+    tree = {"bf": jnp.ones((4,), jnp.bfloat16),
+            "f32": jnp.ones((4,), jnp.float32),
+            "ints": jnp.ones((4,), jnp.int32)}
+    seen_bf, seen_f32 = set(), set()
+    for i in range(1, 6):
+        out = perturb_input(tree, i)
+        seen_bf.add(float(out["bf"][0]))
+        seen_f32.add(float(out["f32"][0]))
+        # int leaves pass through untouched
+        np.testing.assert_array_equal(np.asarray(out["ints"]),
+                                      np.asarray(tree["ints"]))
+    assert len(seen_bf) == 5, seen_bf      # distinct at every counter
+    assert len(seen_f32) == 5
+    assert all(v != 1.0 for v in seen_bf)  # never rounds back to 1.0
+
+
+def test_perf_func_chained_measures_real_work():
+    """Off-tunnel: the chained slope returns a positive per-step ms and
+    the chain actually advances (step applied n2 times)."""
+    from triton_dist_tpu.runtime.utils import perf_func_chained
+    calls = [0]
+
+    @jax.jit
+    def step(x):
+        return x * 1.0000001
+
+    def counted(x):
+        calls[0] += 1
+        return step(x)
+
+    ms = perf_func_chained(counted, jnp.ones((8, 8)), iters=(2, 6))
+    assert ms > 0
+    assert calls[0] >= 7   # warmup + n2 chain
