@@ -143,3 +143,113 @@ def test_logical_device_id_2d(mesh4x2):
 
     y = run(x)
     assert (np.asarray(y) == 10.0).all()
+
+
+def test_logical_device_id_3d(devices):
+    """3-level mesh (the n-level hierarchical collectives' address
+    space): ring notify along the MIDDLE axis must translate through
+    both outer and inner coordinates (reference
+    nvshmem_team_translate_pe over a 3-D team split)."""
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(devices).reshape(2, 2, 2), ("x", "y", "z"))
+
+    def kernel(x_ref, o_ref, sem):
+        me = dl.rank("y")
+        n = dl.num_ranks("y")
+        dst = jax.lax.rem(me + 1, n)
+        dl.notify(sem, peer=dst, axis="y")
+        dl.wait(sem, 1)
+        o_ref[:] = x_ref[:] + 3.0
+
+    x = jnp.zeros((8 * 8, 128), jnp.float32)
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=P(("x", "y", "z")),
+                       out_specs=P(("x", "y", "z")), check_vma=False)
+    def run(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.SemaphoreType.REGULAR],
+            compiler_params=comm_params(),
+            interpret=resolve_interpret(None),
+        )(x)
+
+    assert (np.asarray(run(x)) == 3.0).all()
+
+
+def test_notify_accumulates_and_wait_decrements(mesh8):
+    """notify(inc=k) accumulates; wait(v) consumes exactly v — the
+    semaphore is a counter, not a flag (reference SIGNAL_OP add
+    semantics + wait-until-eq)."""
+    def kernel(x_ref, o_ref, sem):
+        # (semaphore_read has no CPU-interpreter rule; completion of the
+        # split waits IS the assertion — flag semantics would deadlock.)
+        dl.notify(sem, inc=3)          # self-notify, accumulate
+        dl.wait(sem, 2)                # consume 2 of 3
+        dl.wait(sem, 1)                # drain the remaining 1
+        o_ref[:] = x_ref[:] + 1.0
+
+    x = jnp.zeros((8 * 8, 128), jnp.float32)
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh8, in_specs=P("tp"),
+                       out_specs=P("tp"), check_vma=False)
+    def run(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.SemaphoreType.REGULAR],
+            compiler_params=comm_params(),
+            interpret=resolve_interpret(None),
+        )(x)
+
+    y = np.asarray(run(x))
+    assert (y == 1.0).all(), y[0, 0]
+
+
+def test_remote_copy_sliced_rows(mesh8):
+    """remote_copy over ROW SLICES of a larger buffer: each device
+    pushes its top half into the right neighbor's bottom half
+    (putmem_nbi_block with offsets, low_latency_all_to_all.py:52-99)."""
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        me = dl.rank("tp")
+        n = dl.num_ranks("tp")
+        right = jax.lax.rem(me + 1, n)
+        o_ref[:8] = x_ref[:8]
+        cp = dl.remote_copy(x_ref.at[pl.ds(0, 8)], o_ref.at[pl.ds(8, 8)],
+                            right, send_sem, recv_sem, axis="tp")
+        cp.start()
+        cp.wait_recv()
+        cp.wait_send()
+
+    x = jnp.tile(jnp.arange(8, dtype=jnp.float32)[None, :],
+                 (8 * 16, 128 // 8))[:, :128]
+    x = jnp.arange(8 * 16 * 128, dtype=jnp.float32).reshape(8 * 16, 128)
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh8, in_specs=P("tp"),
+                       out_specs=P("tp"), check_vma=False)
+    def run(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((16, 128), x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.SemaphoreType.DMA,
+                            pltpu.SemaphoreType.DMA],
+            compiler_params=comm_params(),
+            interpret=resolve_interpret(None),
+        )(x)
+
+    y = np.asarray(run(x)).reshape(8, 16, 128)
+    xs = np.asarray(x).reshape(8, 16, 128)
+    for dev in range(8):
+        left = (dev - 1) % 8
+        np.testing.assert_array_equal(y[dev, :8], xs[dev, :8])
+        np.testing.assert_array_equal(y[dev, 8:], xs[left, :8])
