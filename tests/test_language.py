@@ -228,8 +228,6 @@ def test_remote_copy_sliced_rows(mesh8):
         cp.wait_recv()
         cp.wait_send()
 
-    x = jnp.tile(jnp.arange(8, dtype=jnp.float32)[None, :],
-                 (8 * 16, 128 // 8))[:, :128]
     x = jnp.arange(8 * 16 * 128, dtype=jnp.float32).reshape(8 * 16, 128)
 
     @jax.jit

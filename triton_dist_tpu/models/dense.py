@@ -223,7 +223,8 @@ class DenseLLM:
         from triton_dist_tpu.ops.common import nestable_shard_map
 
         assert self.sp_axis is not None, (
-            "build DenseLLM(sp_axis=...) to use mode='sp'")
+            "build the model with sp_axis=... to use mode='sp' "
+            "(DenseLLM and Qwen3MoE share this forward)")
         c = self.config
         b, s = input_ids.shape
         sp = self.sp_axis
@@ -354,12 +355,8 @@ class DenseLLM:
                                       impl=self.sp_impl)
             att = att.reshape(b, s, hq * d)
             x = x + constrain((att @ a["w_o"]).astype(x.dtype), xsh)
-            m = lp["mlp"]
             h = rms_norm(x, lp["ln_mlp"], eps)
-            gate = h @ m["w_gate"]
-            up = h @ m["w_up"]
-            act = jax.nn.silu(gate.astype(jnp.float32)).astype(h.dtype) * up
-            x = x + constrain((act @ m["w_down"]).astype(x.dtype), xsh)
+            x = x + self._sp_ffn(lp, h, constrain, xsh)
             return x, (ck, cv)
 
         body = jax.checkpoint(layer_body) if remat else layer_body
@@ -373,6 +370,16 @@ class DenseLLM:
         logits = jnp.einsum("bsh,vh->bsv", x.astype(jnp.float32),
                             params["lm_head"].astype(jnp.float32))
         return logits, new_caches
+
+    def _sp_ffn(self, lp, h, constrain, xsh):
+        """FFN block of the sp forward on (B, S, H) activations — the
+        hook Qwen3MoE overrides with its row-local MoE (the rest of
+        forward_sp is model-agnostic and shared)."""
+        m = lp["mlp"]
+        gate = h @ m["w_gate"]
+        up = h @ m["w_up"]
+        act = jax.nn.silu(gate.astype(jnp.float32)).astype(h.dtype) * up
+        return constrain((act @ m["w_down"]).astype(h.dtype), xsh)
 
     def _paged_scatter(self, pool, kv, table, shard_map_fn):
         """Scatter a (B, S, Hkv, D) seq-sharded prefill K/V into the

@@ -33,7 +33,8 @@ class Qwen3MoE:
 
     def __init__(self, config: ModelConfig, mesh: Mesh | None = None,
                  axis: str = "tp", fwd_mode: str = "ag_rs",
-                 impl: str = "pallas", moe_parallel: str = "tp"):
+                 impl: str = "pallas", moe_parallel: str = "tp",
+                 sp_axis: str | None = None):
         if mesh is None:
             from triton_dist_tpu.runtime.dist import get_mesh
             mesh = get_mesh()
@@ -43,6 +44,25 @@ class Qwen3MoE:
         self.mesh, self.axis = mesh, axis
         self.fwd_mode = fwd_mode
         self.moe_parallel = moe_parallel
+        self.sp_axis = sp_axis
+        if sp_axis is not None:
+            # Model-level SP for the MoE decoder (long-context serving):
+            # same attention/cache machinery as DenseLLM (forward_sp is
+            # REUSED, see below); the FFN hook runs a row-local MoE —
+            # every device routes + grouped-FFNs its own S/w tokens with
+            # replicated expert weights (no collectives in the FFN).
+            assert moe_parallel == "tp" and mesh.shape[axis] == 1, (
+                "sp MoE v1: pure-sp grid (tp axis size 1, replicated "
+                "expert weights); ep x sp is future work")
+            from triton_dist_tpu.ops.flash_decode import (
+                create_flash_decode_context)
+            from triton_dist_tpu.ops.sp_attention import (
+                create_sp_attention_context)
+            self.sp_ctx = create_sp_attention_context(
+                mesh, sp_axis, causal=True, head_axis=None)
+            self.fd_ctx = create_flash_decode_context(mesh, sp_axis)
+            self.sp_impl = "ring" if impl == "pallas" else "xla"
+            self.fd_impl = impl
         c = config
         self.attn = TPAttn(c.hidden_size, c.num_attention_heads,
                            c.num_key_value_heads, c.head_dim, mesh=mesh,
@@ -114,11 +134,17 @@ class Qwen3MoE:
 
     # -- forward -----------------------------------------------------------
     def forward(self, params: dict, input_ids: jax.Array, kv_caches,
-                offset, mode: str | None = None, kv_start=None):
+                offset, mode: str | None = None, kv_start=None,
+                block_table=None):
         """Same contract as DenseLLM.forward; MoE FFN needs the
         row-sharded layout (modes xla / ag_rs)."""
         c = self.config
         mode = mode or self.fwd_mode
+        if mode == "sp":
+            assert kv_start is None, "mode='sp' has no ragged support yet"
+            return self.forward_sp(params, input_ids, kv_caches, offset,
+                                   block_table=block_table)
+        assert block_table is None, "paged caches need mode='sp'"
         if self.moe_parallel == "ep":
             moe_mode = "ep"
             if mode == "ep":
@@ -161,6 +187,46 @@ class Qwen3MoE:
         logits = jnp.dot(x.astype(jnp.float32),
                          params["lm_head"].T.astype(jnp.float32))
         return logits.reshape(b, s, c.vocab_size), new_caches
+
+    # -- sequence-parallel forward (REUSED from DenseLLM: the
+    # attention/cache/chunk/paged machinery is model-agnostic; only the
+    # FFN hook differs) ----------------------------------------------------
+    from triton_dist_tpu.models.dense import DenseLLM as _D
+    forward_sp = _D.forward_sp
+    _paged_scatter = _D._paged_scatter
+    del _D
+
+    def _sp_ffn(self, lp, h, constrain, xsh):
+        """Row-local MoE FFN on (B, S, H) S-sharded activations:
+        route + grouped expert FFN per device on its own tokens,
+        replicated expert weights — zero FFN collectives (tokens never
+        leave their sequence shard)."""
+        from triton_dist_tpu.ops.common import nestable_shard_map
+        from triton_dist_tpu.ops.group_gemm import grouped_expert_ffn
+        from triton_dist_tpu.ops.moe_utils import topk_reduce, topk_routing
+        c = self.config
+        k, n_exp = c.num_experts_per_tok, c.num_experts
+        mp = lp["moe"]
+        sp = self.sp_axis
+
+        def local(hs, rt, wg, wu, wd):
+            bb, ss, hh = hs.shape
+            rows = hs.reshape(bb * ss, hh)
+            logits = rows.astype(jnp.float32) @ rt
+            w, idx = topk_routing(logits, k, c.norm_topk_prob)
+            pairs = jnp.repeat(rows, k, axis=0)
+            out = grouped_expert_ffn(pairs, wg, wu, wd,
+                                     idx.reshape(-1), n_exp)
+            red = topk_reduce(out.reshape(bb * ss, k, hh), w)
+            return red.reshape(hs.shape).astype(hs.dtype)
+
+        spec = P() if h.shape[1] == 1 else P(None, sp, None)
+        f = nestable_shard_map(
+            local, mesh=self.mesh,
+            in_specs=(spec, P(), P(), P(), P()), out_specs=spec,
+            check_vma=False)
+        return f(h, mp["w_router"], mp["w_gate"], mp["w_up"],
+                 mp["w_down"])
 
     # -- HF weights --------------------------------------------------------
     def load_hf_state_dict(self, state: dict) -> dict:
