@@ -459,6 +459,39 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         return lo, dec
     case("sp_model/prefill_decode", sp_model_step)
 
+    def moe_sp_step():
+        # Model-level SP MoE (round 3 session 5): seq-sharded forward
+        # with the row-local MoE FFN; world=1 on the bench chip — the
+        # kernels inside (ring attn, flash decode, ragged_dot) are
+        # individually smoked above, this compiles the composition.
+        from triton_dist_tpu.models import ModelConfig, Qwen3MoE
+        from triton_dist_tpu.models.kv_cache import KVCacheManager
+        mesh3 = Mesh(np.array(devices[:1]).reshape(1, 1), ("tp", "sp"))
+        cfgm = ModelConfig(hidden_size=512, intermediate_size=0,
+                           moe_intermediate_size=512,
+                           num_hidden_layers=2, num_attention_heads=8,
+                           num_key_value_heads=4, head_dim=64,
+                           vocab_size=2048, max_position_embeddings=512,
+                           dtype=bf16, num_experts=8,
+                           num_experts_per_tok=2)
+        mm = Qwen3MoE(cfgm, mesh=mesh3, axis="tp", sp_axis="sp",
+                      impl="pallas", fwd_mode="sp")
+        pm = mm.init(jax.random.PRNGKey(40))
+        kvm = KVCacheManager(cfgm.num_hidden_layers, 2, 512,
+                             cfgm.num_key_value_heads, cfgm.head_dim,
+                             mesh=mesh3, axis="sp", seq_shard=True,
+                             dtype=bf16)
+        idsm = jax.random.randint(jax.random.PRNGKey(41), (2, 256), 0,
+                                  2048, jnp.int32)
+        lo, cachesm = jax.jit(
+            lambda p, i, c: mm.forward(p, i, c, 0, mode="sp"))(
+            pm, idsm, kvm.init())
+        dec, _ = jax.jit(
+            lambda p, i, c: mm.forward(p, i, c, 256, mode="sp"))(
+            pm, idsm[:, :1], cachesm)
+        return lo, dec
+    case("moe_sp_model/prefill_decode", moe_sp_step)
+
     # fp8-wire a2a last among non-risky cases: first-ever int8-payload
     # DMA compile (reference's headline LL-a2a fp8 config).
     def a2a_fp8_case():
