@@ -137,6 +137,72 @@ int64_t tdt_schedule_critical_path(int32_t n_tasks, int32_t n_edges,
   return makespan;
 }
 
+// HEFT priority linearization: the order tdt_schedule_critical_path
+// visits tasks in (descending upward rank, ties by topological
+// position). It is itself a valid topological order (a parent's rank is
+// >= any child's by at least its own cost; zero-cost ties fall back to
+// topo position), so the mega executor can EMIT tasks in this order —
+// which biases XLA's buffer-liveness and latency-hiding scheduling
+// toward the critical path (measured: bench.py mega part compares peak
+// temp memory of topo- vs heft-emitted programs). Returns 0, or -1 on
+// a cycle. out receives the task ids in priority order.
+int32_t tdt_priority_order(int32_t n_tasks, int32_t n_edges,
+                           const int32_t* edges, const int64_t* costs,
+                           int32_t* out) {
+  std::vector<std::vector<int32_t>> children(n_tasks), parents(n_tasks);
+  std::vector<int32_t> outdeg(n_tasks, 0);
+  for (int32_t e = 0; e < n_edges; ++e) {
+    int32_t src = edges[2 * e], dst = edges[2 * e + 1];
+    children[src].push_back(dst);
+    parents[dst].push_back(src);
+    outdeg[src]++;
+  }
+  auto cost = [&](int32_t i) -> int64_t { return costs ? costs[i] : 1; };
+  std::vector<int64_t> rank(n_tasks, 0);
+  std::vector<int32_t> od = outdeg;
+  std::queue<int32_t> q;
+  int32_t seen = 0;
+  for (int32_t i = 0; i < n_tasks; ++i)
+    if (od[i] == 0) q.push(i);
+  while (!q.empty()) {
+    int32_t t = q.front();
+    q.pop();
+    seen++;
+    int64_t best = 0;
+    for (int32_t c : children[t])
+      if (rank[c] > best) best = rank[c];
+    rank[t] = cost(t) + best;
+    for (int32_t p : parents[t])
+      if (--od[p] == 0) q.push(p);
+  }
+  if (seen != n_tasks) return -1;
+  std::vector<int32_t> topo(n_tasks), pos(n_tasks);
+  {
+    std::vector<int32_t> indeg(n_tasks, 0);
+    for (int32_t i = 0; i < n_tasks; ++i)
+      for (int32_t c2 : children[i]) indeg[c2]++;
+    std::priority_queue<int32_t, std::vector<int32_t>,
+                        std::greater<int32_t>> rq;
+    for (int32_t i = 0; i < n_tasks; ++i)
+      if (indeg[i] == 0) rq.push(i);
+    int32_t n2 = 0;
+    while (!rq.empty()) {
+      int32_t t = rq.top();
+      rq.pop();
+      topo[n2] = t;
+      pos[t] = n2++;
+      for (int32_t c2 : children[t])
+        if (--indeg[c2] == 0) rq.push(c2);
+    }
+  }
+  for (int32_t i = 0; i < n_tasks; ++i) out[i] = i;
+  std::sort(out, out + n_tasks, [&](int32_t a, int32_t b) {
+    if (rank[a] != rank[b]) return rank[a] > rank[b];
+    return pos[a] < pos[b];
+  });
+  return 0;
+}
+
 // Kahn topological sort with stable tie-break by task id (the dependency
 // resolution of the reference's ModelBuilder). edges: n_edges pairs
 // (src, dst) meaning dst depends on src. Returns 0 on success, -1 on a

@@ -109,10 +109,14 @@ class ModelBuilder:
                               cost=4)[0]
 
     # -- finalize ----------------------------------------------------------
-    def compile(self, input_names, output_names, jit: bool = True):
+    def compile(self, input_names, output_names, jit: bool = True,
+                order_policy: str = "topo"):
         """Resolve deps and emit the step executor (reference
         ``ModelBuilder.compile`` building queues + codegen'ing the
-        persistent kernel, model_builder.py / code_generator.py:153)."""
+        persistent kernel, model_builder.py / code_generator.py:153).
+        ``order_policy="heft"`` emits in critical-path priority order
+        (TaskGraph.priority_order)."""
         import jax
-        run = self.graph.make_executor(input_names, output_names)
+        run = self.graph.make_executor(input_names, output_names,
+                                       order_policy=order_policy)
         return jax.jit(run) if jit else run

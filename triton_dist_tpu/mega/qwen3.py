@@ -21,9 +21,11 @@ class MegaQwen3:
     """One-program decode step for a DenseLLM (reference bench target:
     mega_triton_kernel.md decode latencies, SURVEY.md §6)."""
 
-    def __init__(self, model: DenseLLM, decode_mode: str = "gemm_ar"):
+    def __init__(self, model: DenseLLM, decode_mode: str = "gemm_ar",
+                 order_policy: str = "topo"):
         self.model = model
         self.decode_mode = decode_mode
+        self.order_policy = order_policy
         c = model.config
         model.attn.set_fwd(decode_mode)
         b = ModelBuilder(model.mesh, model.axis, impl=model.attn.impl,
@@ -60,15 +62,17 @@ class MegaQwen3:
         b.make_lm_head("x_final", "lm_head", "logits")
         self._input_names = inputs
         self._output_names = ["logits"] + outputs
-        self._step = b.compile(inputs, self._output_names)
+        self._step = b.compile(inputs, self._output_names,
+                               order_policy=order_policy)
 
     @property
     def graph(self):
         return self.builder.graph
 
-    def step(self, params: dict, token: jax.Array, kv_caches, offset):
-        """token: (B, 1) int32 → (logits (B, 1, V), new_caches)."""
-        c = self.model.config
+    def flat_args(self, params: dict, token: jax.Array, kv_caches,
+                  offset) -> list:
+        """The executor's positional argument list (also used by
+        bench.py to lower the program for memory analysis)."""
         bsz, s = token.shape
         offset = jnp.asarray(offset, jnp.int32)
         pos = offset + jnp.tile(jnp.arange(s, dtype=jnp.int32)[None],
@@ -89,7 +93,14 @@ class MegaQwen3:
             args[p + "w_up"] = lp["mlp"]["w_up"]
             args[p + "w_down"] = lp["mlp"]["w_down"]
             args[p + "ck"], args[p + "cv"] = ck, cv
-        out = self._step(*[args[n] for n in self._input_names])
+        return [args[n] for n in self._input_names]
+
+    def step(self, params: dict, token: jax.Array, kv_caches, offset):
+        """token: (B, 1) int32 → (logits (B, 1, V), new_caches)."""
+        c = self.model.config
+        bsz, s = token.shape
+        out = self._step(*self.flat_args(params, token, kv_caches,
+                                         offset))
         logits, flat = out[0], out[1:]
         caches = [(flat[2 * i], flat[2 * i + 1])
                   for i in range(c.num_hidden_layers)]

@@ -12,6 +12,15 @@ Usage (tests)::
 
     with race_check():
         ag_gemm(a, b, ctx, impl="pallas")   # raises if a race is found
+
+.. warning:: **Private-API dependency (JAX-pin canary).** This module
+   reaches into ``jax._src.pallas.mosaic.interpret.interpret_pallas_call
+   .races`` — a private attribute with no stability guarantee. A JAX
+   upgrade can silently remove or rename it, turning every
+   ``race_check()`` into a no-op. ``tests/test_race.py`` plants a real
+   race and asserts it is DETECTED; that test is the canary — if it
+   starts failing after a JAX bump, update the hook below before
+   trusting any race-clean run.
 """
 
 from __future__ import annotations
