@@ -137,6 +137,24 @@ def test_stream_sp_and_paged(sp_model, paged):
         assert row == want, (paged, prompt, row, want)
 
 
+def test_stream_paged_fewer_requests_than_rows(sp_model):
+    """n_req < batch (advisor r3, medium): lanes that are NEVER admitted
+    still run the per-row KV write each decode step through their
+    block-table lane. Before the fix those lanes held zeros — pointing
+    at slot 0, unowned only by the accident of stack pop order, and
+    aliasable by a live row under a tight (non-default) pool. Stream
+    start now pre-owns pages for EVERY lane, making the
+    frozen-writes-own-their-pages invariant structural; the lone
+    request must decode exactly as when served alone."""
+    model, params = sp_model
+    prompt = [4, 5, 6, 7]
+    gen_len = 6
+    eng = Engine(model, batch=3, max_seq=64, prefill_mode="sp",
+                 decode_mode="sp", paged=True, page_size=4)
+    got = eng.serve_stream(params, [prompt], gen_len)
+    assert got[0] == _solo_sp(model, params, prompt, gen_len)
+
+
 def test_stream_sampled_deterministic_per_seed(small_model):
     """Stochastic streaming is reproducible: same seed → same tokens
     (the engine key advances identically through admissions + steps)."""

@@ -332,15 +332,23 @@ class DenseLLM:
                 # not ppermute 64k mostly-masked positions per layer.
                 ck_att, cv_att = ck, cv
                 if not isinstance(offset, jax.core.Tracer):
-                    # Round the live prefix up to whole cache SHARDS so
-                    # the slice keeps the existing sharding (no reshard
-                    # data movement).
+                    # Slice the cache to the live prefix, rounded up to
+                    # a length sp_ag_attention accepts: a multiple of
+                    # BOTH the cache shard size (so the slice lands on
+                    # shard boundaries) and world (its t % world == 0
+                    # contract — advisor r3: per alone breaks when
+                    # t_cache//world is not itself a world multiple).
+                    # The sliced tensor is re-partitioned over the sp
+                    # axis by the shard_map in_specs (data movement
+                    # proportional to t_live, still far cheaper than
+                    # ring-attending the full mostly-masked cache).
+                    import math
                     world_sp = self.mesh.shape[sp]
                     t_cache = ck.shape[1]
                     if t_cache % world_sp == 0:
                         per = t_cache // world_sp
-                        t_live = min(t_cache,
-                                     -(-(int(offset) + s) // per) * per)
+                        step = math.lcm(per, world_sp)
+                        t_live = -(-(int(offset) + s) // step) * step
                         if t_live < t_cache:
                             ck_att = ck[:, :t_live]
                             cv_att = cv[:, :t_live]

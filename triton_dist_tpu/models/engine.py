@@ -366,12 +366,15 @@ class Engine:
             scratch prefill into the freed row's private lane;
           * sp (seq-sharded cache) — same, through ``forward_sp``'s
             per-row write/mask/rope path;
-          * sp + paged — admission allocates the row's pages and
-            prefills STRAIGHT into the pool via its table slice; a
-            retired row keeps its pages until its replacement is
-            admitted (free+realloc happen atomically at admission), so
-            frozen-row writes always land in pages the row still owns
-            and can never corrupt another sequence.
+          * sp + paged — every lane is page-backed for the whole
+            stream: stream start pre-allocates pages for ALL rows (so
+            lanes that are never admitted when n_req < batch still own
+            what they write into), and admission free+reallocs a row's
+            pages atomically before prefilling STRAIGHT into the pool
+            via its table slice; a retired row keeps its pages until
+            its replacement is admitted. Frozen-row writes therefore
+            always land in pages the row owns and can never corrupt
+            another sequence.
         """
         paged = self.paged
         b = self.kv.batch
@@ -386,15 +389,28 @@ class Engine:
         assert all(len(p) + gen_len <= self.kv.max_seq for p in prompts), \
             "prompt + gen_len must fit max_seq"
         # sp prefill shards S over the sp axis: buckets must divide.
+        # Keyed on EITHER mode being "sp" (init asserts they only come
+        # together, but the prefill is what shards S — advisor r3).
         sp_world = (self.model.mesh.shape[self.model.sp_axis]
-                    if self.decode_mode == "sp" else 1)
+                    if "sp" in (self.prefill_mode, self.decode_mode)
+                    else 1)
 
         self.kv.reset()
+        cur_table = None
         if paged:
             for row in self.kv.owned_rows():
                 self.kv.free_seq(row)
+            # Every lane must own its pages from step 0: the decode step
+            # runs the per-row KV write for ALL rows (frozen rows
+            # included), and a lane that was never admitted would write
+            # through a zeroed table entry that aliases slot 0 of a live
+            # row (advisor r3, medium). Pre-owning all rows makes frozen
+            # writes land in pages nobody else holds; admission below
+            # then free+reallocs per row as before.
+            for row in range(b):
+                self.kv.alloc_seq(row)
+            cur_table = self.kv.block_table()
         caches = self.kv.init()
-        cur_table = None
         if self._stream_step is None:
             self._stream_step = self._build_stream_step()
         if self._admit is None:

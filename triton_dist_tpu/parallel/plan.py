@@ -97,7 +97,9 @@ def plan_parallelism(config, n_chips: int, max_seq: int = 4096,
     inter = getattr(c, "intermediate_size", 0) or getattr(
         c, "moe_intermediate_size", 0)
     n_layers = c.num_hidden_layers
-    head_bytes = 2 * h * (c.num_attention_heads
+    # qkv projections + the output projection w_o (advisor r3: omitting
+    # w_o undercounted attention params by up to ~25%).
+    head_bytes = 2 * h * (2 * c.num_attention_heads
                           + 2 * c.num_key_value_heads) * c.head_dim
     mlp_bytes = 3 * h * inter * 2
     if is_moe:
