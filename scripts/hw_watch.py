@@ -24,43 +24,46 @@ LOG = os.path.join(ROOT, "hw_watch.log")
 # (name, argv, deadline_s, env) — run in order; stop the queue if a
 # step wedges (probe after each step to know).
 
-
-def _bench_part(part, deadline):
-    return (f"bench_{part}", [sys.executable, "bench.py"], deadline,
-            {"TDT_BENCH_ONLY": part, "TDT_BENCH_SUBPROC": "0",
-             "TDT_BENCH_PROGRESS":
-                 os.path.join(ROOT, f".bench_progress_{part}.json")})
-
-
 QUEUE = [
-    # Resume the stopped 07-31 03:30 smoke run: cases after
-    # allreduce/one_shot (which PASSed; its lingering teardown falsely
-    # stopped the old harness), minus the risky never-compiled ones.
-    ("smoke_resume",
+    # Round-4 evidence queue (VERDICT r3 next-3: one full-green on-chip
+    # smoke; next-1: a machine-captured bench).
+    # Pass 1: the bulk of the smoke cases, minus the two historically
+    # risky compiles — a hang in either must not cost the other 41.
+    ("smoke_bulk",
      [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "420",
-      "--start-after", "allreduce/one_shot",
-      "--skip", "ag_gemm_multi,train/fused_step,sp_ag_attention/pallas",
-      "--log", "tpu_smoke_r3_resume.log"],
-     3600.0, {}),
-    # First on-chip compile of the restructured fused SP kernel, alone
-    # so a hang costs nothing else.
+      "--skip", "train/fused_step,sp_ag_attention/pallas",
+      "--log", "tpu_smoke_r4_bulk.log"],
+     7200.0, {}),
+    # The rewritten fused SP kernel's first on-chip compile, alone.
     ("sp_pallas",
      [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "600",
       "--only", "=sp_ag_attention/pallas",
-      "--log", "tpu_smoke_r3_sp.log"],
+      "--log", "tpu_smoke_r4_sp.log"],
      900.0, {}),
-    # Re-measure the parts whose kernels changed since the 01:00 bench
-    # (tp_mlp now routes ag_swiglu; mega/gemm_ar for fresh numbers).
-    _bench_part("tp_mlp", 2700.0),
-    _bench_part("moe_ag_gg", 2700.0),
-    _bench_part("gemm_ar", 2700.0),
-    _bench_part("mega", 2700.0),
-    # The grouped SP kernel and the persistent compile cache give these
-    # two a real shot now; run them LAST so a long compile only costs
-    # the tail. A once-successful train compile persists in .jax_cache,
-    # making the driver's end-of-round bench near-free.
-    _bench_part("sp_attn", 2700.0),
-    _bench_part("train", 5400.0),
+    # The train-step compile (observed 35 min once; cache may help).
+    ("train_step",
+     [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "900",
+      "--only", "=train/fused_step",
+      "--log", "tpu_smoke_r4_train.log"],
+     1200.0, {}),
+    # Consolidated full-green run for the committed log: every compile
+    # is now warm in .jax_cache, so 43 cases fit one pass.
+    ("smoke_full",
+     [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "420",
+      "--log", "tpu_smoke_r4.log"],
+     7200.0, {}),
+    # Full machine-captured bench through the new budgeted orchestrator
+    # (streams cumulative JSON; also warms every part for the driver's
+    # end-of-round run). Its checkpoint goes to a DEDICATED file —
+    # .bench_progress_latest.json is cleared by every fresh bench run,
+    # which would erase this evidence if the driver's end-of-round run
+    # starts and then wedges (review r4a-2); stdout is kept in
+    # hw_bench_full.out by run_step.
+    ("bench_full",
+     [sys.executable, "bench.py"], 2700.0,
+     {"TDT_BENCH_BUDGET_S": "2400",
+      "TDT_BENCH_PROGRESS":
+          os.path.join(ROOT, ".bench_progress_watcher.json")}),
 ]
 
 
@@ -87,16 +90,21 @@ def run_step(name: str, argv: list[str], deadline_s: float,
              env_extra: dict | None = None) -> str:
     log(f"step {name}: start")
     env = dict(os.environ, **(env_extra or {}))
+    # Keep every step's stdout (the bench's streamed cumulative JSON
+    # lines are machine-captured evidence, not noise — review r4a-2).
+    out = open(os.path.join(ROOT, f"hw_{name}.out"), "ab")
     child = subprocess.Popen(argv, cwd=ROOT, env=env,
-                             stdout=subprocess.DEVNULL,
-                             stderr=subprocess.DEVNULL)
+                             stdout=out, stderr=subprocess.STDOUT)
     t0 = time.monotonic()
-    while child.poll() is None:
-        if time.monotonic() - t0 > deadline_s:
-            log(f"step {name}: deadline {deadline_s:.0f}s — ABANDONED "
-                f"(pid {child.pid} left alive)")
-            return "abandoned"
-        time.sleep(10.0)
+    try:
+        while child.poll() is None:
+            if time.monotonic() - t0 > deadline_s:
+                log(f"step {name}: deadline {deadline_s:.0f}s — ABANDONED "
+                    f"(pid {child.pid} left alive)")
+                return "abandoned"
+            time.sleep(10.0)
+    finally:
+        out.close()
     log(f"step {name}: done rc={child.returncode}")
     return "done"
 

@@ -222,7 +222,7 @@ def test_ag_group_gemm(mesh8, impl, key):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
 
 
-@pytest.mark.parametrize("impl", ["xla", "ring"])
+@pytest.mark.parametrize("impl", ["xla", "ring", "fused", "auto"])
 def test_moe_reduce_rs(mesh8, impl, key):
     world, rows, i, h, e, topk = 8, 4, 32, 16, 4, 2
     t = world * rows

@@ -134,3 +134,54 @@ def test_stress_injection_options_accepted(mesh8):
     rb = np.asarray(ref_buf).reshape(WORLD, WORLD, 16, 128)
     np.testing.assert_allclose(gb[:, :, :8], rb[:, :, :8], rtol=1e-5,
                                atol=1e-5)
+
+
+def test_stress_flash_decode_random_kv_lens(mesh8):
+    """Randomized PER-SEQUENCE kv lengths over the tiled split-KV decode
+    (the reference's kv_length_ptr parity): boundary tiles (len not a
+    t_blk multiple, len < one block, len == cache) all in one batch."""
+    from triton_dist_tpu.ops.flash_decode import (
+        create_flash_decode_context, gqa_fwd_batch_decode)
+    rng = np.random.RandomState(11)
+    b, hq, hkv, d, t = 4, 8, 2, 32, 128
+    ctx = create_flash_decode_context(mesh8, "tp", variant="tiled",
+                                      t_blk=32)
+    for it in range(3):
+        q = jnp.asarray(rng.randn(b, hq, d), jnp.float32)
+        kc = jax.device_put(jnp.asarray(rng.randn(b, t, hkv, d),
+                                        jnp.float32),
+                            NamedSharding(mesh8, P(None, "tp")))
+        vc = jax.device_put(jnp.asarray(rng.randn(b, t, hkv, d),
+                                        jnp.float32),
+                            NamedSharding(mesh8, P(None, "tp")))
+        lens = jnp.asarray(
+            [int(rng.randint(1, t + 1)) for _ in range(b)], jnp.int32)
+        out = gqa_fwd_batch_decode(q, kc, vc, lens, ctx, impl="pallas")
+        ref = gqa_fwd_batch_decode(q, kc, vc, lens, ctx, impl="xla")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"iter {it} lens={lens}")
+
+
+def test_stress_sp_attention_random_seq(mesh8):
+    """Randomized sequence lengths through the ring SP attention
+    (causal): the rotation/mask bookkeeping must hold at every s."""
+    from triton_dist_tpu.ops.sp_attention import (
+        create_sp_attention_context, sp_ag_attention)
+    rng = np.random.RandomState(12)
+    ctx = create_sp_attention_context(mesh8, "tp", causal=True)
+    for it in range(3):
+        s = WORLD * int(rng.choice([2, 4, 8]))
+        b, hq, hkv, d = 2, 4, 2, 16
+        sh = NamedSharding(mesh8, P(None, "tp"))
+        q = jax.device_put(jnp.asarray(rng.randn(b, s, hq, d),
+                                       jnp.float32), sh)
+        k = jax.device_put(jnp.asarray(rng.randn(b, s, hkv, d),
+                                       jnp.float32), sh)
+        v = jax.device_put(jnp.asarray(rng.randn(b, s, hkv, d),
+                                       jnp.float32), sh)
+        out = sp_ag_attention(q, k, v, ctx, impl="ring")
+        ref = sp_ag_attention(q, k, v, ctx, impl="xla")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"iter {it} s={s}")
