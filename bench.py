@@ -181,8 +181,9 @@ def _run_parts_in_children(extras: dict) -> None:
         if budget_left < 250.0:
             extras.setdefault("skipped_budget", []).append(name)
             continue
-        deadline = min(_PART_DEADLINE_S.get(name, _PART_DEADLINE_DEFAULT_S),
-                       budget_left - 45.0)
+        part_max = _PART_DEADLINE_S.get(name, _PART_DEADLINE_DEFAULT_S)
+        deadline = min(part_max, budget_left - 45.0)
+        budget_clamped = deadline < part_max
         fd, tmp_path = tempfile.mkstemp(suffix=f".bench_{name}.json")
         os.close(fd)
         env = dict(os.environ)
@@ -233,10 +234,18 @@ def _run_parts_in_children(extras: dict) -> None:
         _checkpoint_extras(extras, name)
         _emit(extras)
         if name + "_timeout_s" in extras:
-            # The tunnel is now occupied by the abandoned compile; stop
-            # here so completed metrics survive (remaining parts would
-            # only queue behind the stuck one).
+            # The run stops either way (the abandoned child still holds
+            # the backend), but the evidence must say WHY: a deadline
+            # clamped by the remaining budget is ordinary budget
+            # exhaustion, not a wedge signal (review r4b-3).
             extras["aborted_after"] = name
+            if budget_clamped:
+                extras[name + "_timeout_budget_clamped"] = True
+                extras["aborted_reason"] = "budget_exhausted"
+                extras.setdefault("skipped_budget", []).extend(
+                    p for p in _PART_ORDER[_PART_ORDER.index(name) + 1:])
+            else:
+                extras["aborted_reason"] = "possible_wedge"
             break
 
 
@@ -698,11 +707,15 @@ def _bench_mega_vs_engine(mesh, n, on_tpu, extras):
                              dtype=jnp.bfloat16), 8),
             # Qwen3-8B per-chip TP8 slice at reference depth-class:
             # 32 layers, hidden 4096, heads 32/8, kv 8/8, inter 12288/8.
+            # Per-chip dims scale back up with the mesh so a real
+            # n-chip run keeps 4 heads / 1536 inter PER CHIP (and
+            # satisfies heads % world == 0 — review r4b-1).
             ("deep_", ModelConfig(hidden_size=4096,
-                                  intermediate_size=1536,
+                                  intermediate_size=1536 * max(n, 1),
                                   num_hidden_layers=32,
-                                  num_attention_heads=4,
-                                  num_key_value_heads=1, head_dim=128,
+                                  num_attention_heads=4 * max(n, 1),
+                                  num_key_value_heads=max(n, 1),
+                                  head_dim=128,
                                   vocab_size=32768,
                                   max_position_embeddings=512,
                                   dtype=jnp.bfloat16), 1),
@@ -1124,6 +1137,11 @@ def main():
         print(json.dumps(_select_result(extras)))
         return
     try:
+        # Inline / TDT_BENCH_ONLY mode: clear any stale checkpoint up
+        # front — a run that wedges before its first part must not
+        # leave the previous run's metrics in the file as its own
+        # (review r4b-2; the parent branch above does the same).
+        _checkpoint_extras(extras, "init")
         import numpy as np
         devices = _init_backend()
         import jax

@@ -8,7 +8,6 @@ import contextlib
 import importlib.util
 import io
 import json
-import os
 import pathlib
 
 import jax.numpy as jnp
@@ -82,7 +81,7 @@ def test_chain_fold_shapes():
     assert out.shape == (m, k) and out.dtype == jnp.bfloat16
 
 
-def test_probe_failure_exits_zero_with_prior(tmp_path):
+def test_probe_failure_exits_zero_with_prior(tmp_path, monkeypatch):
     """A wedged tunnel must yield rc=0 + a JSON line labeling any prior
     checkpoint as prior_run (never as this run's metrics)."""
     prior = tmp_path / "progress.json"
@@ -93,15 +92,12 @@ def test_probe_failure_exits_zero_with_prior(tmp_path):
     # (hermetic stand-in for the wedged tunnel).
     mod = _load_bench()
     mod._probe_backend_subprocess = lambda *_a, **_k: False
-    os.environ["TDT_BENCH_PROGRESS"] = str(prior)
-    os.environ.pop("TDT_BENCH_CPU", None)
-    os.environ.pop("TDT_BENCH_ONLY", None)
+    monkeypatch.setenv("TDT_BENCH_PROGRESS", str(prior))
+    monkeypatch.delenv("TDT_BENCH_CPU", raising=False)
+    monkeypatch.delenv("TDT_BENCH_ONLY", raising=False)
     buf = io.StringIO()
-    try:
-        with contextlib.redirect_stdout(buf):
-            mod.main()
-    finally:
-        os.environ.pop("TDT_BENCH_PROGRESS", None)
+    with contextlib.redirect_stdout(buf):
+        mod.main()
     out = json.loads(buf.getvalue().strip().splitlines()[-1])
     assert out["value"] is None                      # headline stays null
     assert out["extras"]["probe_failed"] is True

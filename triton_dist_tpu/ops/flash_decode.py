@@ -147,12 +147,19 @@ def _local_partials(q, k, v, first_pos, kv_len, groups: int,
     """
     b, hq, d = q.shape
     t, hkv = k.shape[1], k.shape[2]
-    qg = q.reshape(b, hkv, groups, d).astype(jnp.float32)
-    kf = k.astype(jnp.float32)
+    # QK in the cache dtype when q matches it (MXU-native; f32
+    # accumulation makes the scores bit-identical to an upcast-first
+    # dot); precision-mismatched callers keep the exact f32 path —
+    # see the tiled kernel (review r4b-4).
+    dt = k.dtype if q.dtype == k.dtype else jnp.float32
+    qg = q.reshape(b, hkv, groups, d).astype(dt)
+    kc = k.astype(dt)
     if mosaic:
-        scores = _qk_scores(qg, kf) * (d ** -0.5)
+        scores = _qk_scores(qg, kc) * (d ** -0.5)
     else:
-        scores = jnp.einsum("bkgd,btkd->bkgt", qg, kf) * (d ** -0.5)
+        scores = jnp.einsum("bkgd,btkd->bkgt", qg, kc,
+                            preferred_element_type=jnp.float32
+                            ) * (d ** -0.5)
     lens = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
     live = (first_pos + jnp.arange(t))[None, :] < lens[:, None]  # (B, T)
     live4 = live[:, None, None, :]
@@ -160,11 +167,13 @@ def _local_partials(q, k, v, first_pos, kv_len, groups: int,
     m = jnp.max(scores, axis=-1)
     p = jnp.exp(scores - m[..., None]) * live4
     l = jnp.sum(p, axis=-1)
-    vf = v.astype(jnp.float32)
+    pv_in = p.astype(dt)   # PV in the compute dtype, f32 accumulate
+    vc = v.astype(dt)
     if mosaic:
-        a = _pv_accum(p, vf)
+        a = _pv_accum(pv_in, vc)
     else:
-        a = jnp.einsum("bkgt,btkd->bkgd", p, vf)
+        a = jnp.einsum("bkgt,btkd->bkgd", pv_in, vc,
+                       preferred_element_type=jnp.float32)
     return a, l, m
 
 
@@ -316,9 +325,18 @@ def _tiled_decode_kernel(q_ref, len_ref, table_ref, k_hbm, v_hbm, o_ref,
             start_tile(lax.rem(ti + 1, 2), ti + 1)
         wait_tile(slot, ti)
 
-        kt = k_tile[slot].astype(jnp.float32)   # (B, t_blk, Hkv, D)
-        vt = v_tile[slot].astype(jnp.float32)
-        q = q_ref[:].reshape(batch, hkv, groups, d).astype(jnp.float32)
+        # Dots run in the CACHE dtype when q matches it (MXU-native: a
+        # bf16 matmul is up to 3x an f32 one on TPU and skips two
+        # full-tile f32 conversions per step; bf16->f32 upcast before
+        # the dot would produce bit-identical scores anyway since the
+        # accumulation is f32 either way — r4, targeting the 0.90x
+        # bench line). A precision-MISMATCHED caller (e.g. f32 q over a
+        # bf16 cache) keeps the exact f32 path: casting q down would
+        # silently change results (review r4b-4).
+        dt = k_tile.dtype if q_ref.dtype == k_tile.dtype else jnp.float32
+        kt = k_tile[slot].astype(dt)            # (B, t_blk, Hkv, D)
+        vt = v_tile[slot].astype(dt)
+        q = q_ref[:].reshape(batch, hkv, groups, d).astype(dt)
         # (B, K, G, D) x (B, t_blk, K, D) -> (B, K, G, t_blk); per-head
         # dots keep Mosaic's one-batch-dim matmul constraint.
         scores = _qk_scores(q, kt) * scale
@@ -331,7 +349,10 @@ def _tiled_decode_kernel(q_ref, len_ref, table_ref, k_hbm, v_hbm, o_ref,
         alpha = jnp.exp(m_run - m_new)
         p = jnp.exp(scores - m_new[..., None]) * live4
         l_new = l_run * alpha + jnp.sum(p, axis=-1)
-        pv = _pv_accum(p, vt)
+        # PV in the cache dtype with f32 accumulation (standard flash
+        # practice; p in [0,1] loses <0.5% per element to bf16 and the
+        # f32 accumulate keeps the sum exact). No-op for f32 caches.
+        pv = _pv_accum(p.astype(vt.dtype), vt)
         acc_new = acc * alpha[..., None] + pv
         return m_new, l_new, acc_new
 

@@ -104,14 +104,19 @@ def create_sp_attention_context(mesh: Mesh | None = None, axis: str = "sp",
 def _chunk_scores(q, k, q_first, k_first, causal: bool, kv_live=None):
     """Masked scores of one (Q block, KV block) pair.
 
-    q: (B, K, G, Sq, D) fp32; k: (B, T, K, D); returns (B, K, G, Sq, T).
+    q: (B, K, G, Sq, D); k: (B, T, K, D); returns (B, K, G, Sq, T) fp32.
+    When q and k share a dtype the dot runs in it (MXU-native; the f32
+    accumulation makes scores bit-identical to an upcast-first dot);
+    precision-mismatched inputs keep the exact f32 path (casting q
+    down would silently change results — review r4b-4).
     ``kv_live``: global number of live KV positions — KV block entries
     at or past it are masked (cache-aware chunked prefill, where the
     KV blocks come from a partially-filled cache).
     """
     d = q.shape[-1]
-    scores = jnp.einsum("bkgsd,btkd->bkgst", q,
-                        k.astype(jnp.float32)) * (d ** -0.5)
+    dt = k.dtype if q.dtype == k.dtype else jnp.float32
+    scores = jnp.einsum("bkgsd,btkd->bkgst", q.astype(dt), k.astype(dt),
+                        preferred_element_type=jnp.float32) * (d ** -0.5)
     sq, t = scores.shape[-2], scores.shape[-1]
     k_pos = k_first + jnp.arange(t)[None, :]
     mask = jnp.ones((sq, t), bool)
@@ -124,14 +129,17 @@ def _chunk_scores(q, k, q_first, k_first, causal: bool, kv_live=None):
 
 
 def _online_update(state, scores, v):
-    """Fold one KV block into the (m, l, acc) online-softmax state."""
+    """Fold one KV block into the (m, l, acc) online-softmax state.
+    The PV product runs in v's dtype (f32 accumulation) — standard
+    flash practice; exact for f32 caches."""
     m, l, acc = state
     m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
     p = jnp.exp(scores - m_new[..., None])
     corr = jnp.exp(m - m_new)
     l = l * corr + jnp.sum(p, axis=-1)
     acc = acc * corr[..., None] + jnp.einsum(
-        "bkgst,btkd->bkgsd", p, v.astype(jnp.float32))
+        "bkgst,btkd->bkgsd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32)
     return m_new, l, acc
 
 
@@ -249,10 +257,16 @@ def _sp_fused_kernel(q_hbm, k_ref, v_ref, o_hbm, kw_hbm, vw_hbm, q_buf,
 
             for li, gidx in enumerate(slabs):     # static slab loop
                 i, h = divmod(gidx, hkv)
-                kt = ktile[:, :, h, :].astype(jnp.float32)
-                vt = vtile[:, :, h, :].astype(jnp.float32)
+                # MXU-native dtype dots when q matches KV (bf16 matmul
+                # is up to 3x f32 on TPU; the f32 accumulate keeps
+                # scores bit-identical to an upcast-first dot); a
+                # mismatched q keeps the exact f32 path (r4b-4).
+                dt = (k_sub.dtype if q_buf.dtype == k_sub.dtype
+                      else jnp.float32)
+                kt = ktile[:, :, h, :].astype(dt)
+                vt = vtile[:, :, h, :].astype(dt)
                 s_blk = lax.dot_general(
-                    q_buf[li].astype(jnp.float32), kt,
+                    q_buf[li].astype(dt), kt,
                     (((2,), (2,)), ((0,), (0,))),
                     preferred_element_type=jnp.float32) * scale
                 if causal:
@@ -265,7 +279,7 @@ def _sp_fused_kernel(q_hbm, k_ref, v_ref, o_hbm, kw_hbm, vw_hbm, q_buf,
                 p = jnp.exp(s_blk - m_new[..., None])
                 corr = jnp.exp(mi - m_new)
                 pv = lax.dot_general(
-                    p, vt, (((2,), (1,)), ((0,), (0,))),
+                    p.astype(vt.dtype), vt, (((2,), (1,)), ((0,), (0,))),
                     preferred_element_type=jnp.float32)
                 m_buf[li] = m_new
                 l_buf[li] = li_ * corr + jnp.sum(p, axis=-1)
@@ -489,9 +503,10 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             b, s_loc, kl * gl, d).astype(qs_dtype)
 
     def local_q(qs, hkv_l):
-        # (B, S_loc, hq_l, D) → (B, K, G, S_loc, D) fp32
+        # (B, S_loc, hq_l, D) → (B, K, G, S_loc, D); dtype preserved —
+        # the scores dot runs MXU-native in the KV dtype.
         return qs.reshape(b, s_loc, hkv_l, qs.shape[2] // hkv_l, d
-                          ).transpose(0, 2, 3, 1, 4).astype(jnp.float32)
+                          ).transpose(0, 2, 3, 1, 4)
 
     def ag_body(qs, ks, vs):
         me = lax.axis_index(axis)
@@ -503,7 +518,8 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         m = jnp.max(scores, axis=-1)
         p = jnp.exp(scores - m[..., None])
         l = jnp.sum(p, axis=-1)
-        acc = jnp.einsum("bkgst,btkd->bkgsd", p, vg.astype(jnp.float32))
+        acc = jnp.einsum("bkgst,btkd->bkgsd", p.astype(vg.dtype), vg,
+                         preferred_element_type=jnp.float32)
         return finish((m, l, acc), qs.dtype)
 
     def ring_body(qs, ks, vs):
@@ -571,12 +587,13 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                                 tiled=True)
             hkv_loc = hkv // world
             qf = qh.reshape(b, s, hkv_loc, groups, d
-                            ).transpose(0, 2, 3, 1, 4).astype(jnp.float32)
+                            ).transpose(0, 2, 3, 1, 4)
             scores = _chunk_scores(qf, kh, 0, 0, causal)
             m = jnp.max(scores, axis=-1)
             p = jnp.exp(scores - m[..., None])
             l = jnp.sum(p, axis=-1)
-            acc = jnp.einsum("bkgst,btkd->bkgsd", p, vh.astype(jnp.float32))
+            acc = jnp.einsum("bkgst,btkd->bkgsd", p.astype(vh.dtype), vh,
+                             preferred_element_type=jnp.float32)
             out = (acc / jnp.maximum(l, 1e-20)[..., None]
                    ).transpose(0, 3, 1, 2, 4).reshape(
                        b, s, hq // world, d).astype(qs.dtype)
@@ -614,8 +631,8 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             m = jnp.max(scores, axis=-1)
             p = jnp.exp(scores - m[..., None])
             l = jnp.sum(p, axis=-1)
-            acc = jnp.einsum("bkgst,btkd->bkgsd", p,
-                             vgs.astype(jnp.float32))
+            acc = jnp.einsum("bkgst,btkd->bkgsd", p.astype(vgs.dtype),
+                             vgs, preferred_element_type=jnp.float32)
             return finish((m, l, acc), qs.dtype)
 
         f = nestable_shard_map(body, mesh=mesh,
