@@ -198,15 +198,21 @@ def _attention_core(q, k, v, cache_k, cache_v, offset, kv_start, *,
         cache_v = cache_v.at[rows, offset].set(v[:, 0])
         off_b = offset
 
-    qg = q.reshape(b, s, hkv, groups, d).astype(jnp.float32)
-    kf = cache_k.astype(jnp.float32)
-    scores = jnp.einsum("bskgd,btkd->bkgst", qg, kf) * (d ** -0.5)
+    # Contractions run in the cache dtype when q matches it (MXU-native
+    # bf16 is up to 3x an f32 matmul; f32 accumulation keeps scores
+    # bit-identical to an upcast-first dot — r4, same treatment as
+    # ops/flash_decode). Mismatched precision keeps the exact f32 path.
+    dt = cache_k.dtype if q.dtype == cache_k.dtype else jnp.float32
+    qg = q.reshape(b, s, hkv, groups, d).astype(dt)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, cache_k.astype(dt),
+                        preferred_element_type=jnp.float32) * (d ** -0.5)
     q_pos = off_b[:, None, None] + jnp.arange(s)[None, :, None]  # (B,S,1)
     causal = jnp.arange(t)[None, None, :] <= q_pos  # (B, S, T)
     live = jnp.arange(t)[None, :] >= kv_start[:, None]  # (B, T)
     mask = causal & live[:, None]  # (B, S, T)
     scores = jnp.where(mask[:, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgst,btkd->bskgd", probs,
-                     cache_v.astype(jnp.float32))
+    out = jnp.einsum("bkgst,btkd->bskgd", probs.astype(dt),
+                     cache_v.astype(dt),
+                     preferred_element_type=jnp.float32)
     return out.reshape(b, s, hq, d).astype(q.dtype), cache_k, cache_v
