@@ -287,7 +287,10 @@ def _finalize_checks(extras: dict) -> None:
     if ag and rs:
         r = max(ag, rs) / min(ag, rs)
         extras["baseline_xla_ratio"] = round(r, 3)
-        if r > 1.5:
+        # Judge the ratio only on chip runs (calib present): the CPU
+        # validation path times µs-scale toy shapes where fixed
+        # overheads legitimately dominate the comparison.
+        if r > 1.5 and calib:
             anomalies.append(f"ag_gemm_xla {ag} vs gemm_rs_xla {rs}: "
                              f"same matmul, {r:.2f}x apart")
     # calib_ms times the FULL matmul on one chip, while the baselines
