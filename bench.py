@@ -1116,18 +1116,26 @@ def main():
                 and not (_probe_backend_subprocess(75.0)
                          or _probe_backend_subprocess(75.0)):
             extras["probe_failed"] = True
-            # Carry any prior checkpoint, clearly labeled as such (a
-            # wedged tunnel at round end must not zero out knowledge of
-            # the last good run — but its metrics stay OUT of the
-            # headline fields).
-            try:
-                with open(_progress_path()) as f:
-                    prior = json.load(f)
-                extras["prior_run"] = prior.get("extras", {})
-                extras["prior_run_age_s"] = round(
-                    time.time() - float(prior.get("ts", 0)))
-            except (OSError, ValueError):
-                pass
+            # Carry the NEWEST prior checkpoint, clearly labeled as
+            # such (a wedged tunnel at round end must not zero out
+            # knowledge of the last good run — but its metrics stay OUT
+            # of the headline fields). The watcher's bench writes to a
+            # dedicated path, so scan both.
+            here = os.path.dirname(os.path.abspath(__file__))
+            best_ts = -1.0
+            for path in (_progress_path(),
+                         os.path.join(here, ".bench_progress_watcher.json")):
+                try:
+                    with open(path) as f:
+                        prior = json.load(f)
+                    ts = float(prior.get("ts", 0))
+                    if ts > best_ts:
+                        best_ts = ts
+                        extras["prior_run"] = prior.get("extras", {})
+                        extras["prior_run_age_s"] = round(time.time() - ts)
+                        extras["prior_run_path"] = os.path.basename(path)
+                except (OSError, ValueError):
+                    pass
             print(json.dumps(result))
             return
         # Fresh run: clear any stale checkpoint so a run that dies
