@@ -205,7 +205,7 @@ def test_grouped_expert_ffn_sentinel_masked(key):
                                atol=1e-4)
 
 
-@pytest.mark.parametrize("impl", ["xla", "ring"])
+@pytest.mark.parametrize("impl", ["xla", "ring", "auto"])
 def test_ag_group_gemm(mesh8, impl, key):
     world, rows, kdim, n, e = 8, 4, 16, 256, 4
     m = world * rows
