@@ -247,3 +247,36 @@ def test_ag_swiglu_bench_shape_fits(world):
             jax.ShapeDtypeStruct((m, k), bf16),
             jax.ShapeDtypeStruct((k, n), bf16),
             jax.ShapeDtypeStruct((k, n), bf16))
+
+
+@pytest.mark.parametrize("world", [1, 8])
+@pytest.mark.parametrize("dims", [
+    ("8b", 4096, 4, 1, 128, 1536), ("32b", 5120, 8, 1, 128, 3200)])
+def test_layer_bench_dims_fit(world, dims):
+    """bench.py layer_8b/32b (Qwen3 per-chip TP8 slice, prefill M=2048
+    + decode M=128): every Pallas kernel in the fused decoder-layer
+    step must fit the chip budget at both worlds."""
+    from triton_dist_tpu.layers import TPAttn, precompute_rope_cache
+    from triton_dist_tpu.layers.tp_mlp import TPMLP
+    tag, h, nq, nkv, d, inter = dims
+    mesh = _mesh(world)
+    nq, nkv, inter = nq * world, nkv * world, inter * world
+    attn = TPAttn(h, nq, nkv, d, mesh=mesh, axis="tp", dtype=bf16)
+    mlp = TPMLP(h, inter, mesh=mesh, axis="tp", dtype=bf16)
+    rope = precompute_rope_cache(d, 512)
+    pa = jax.eval_shape(attn.init, jax.random.PRNGKey(0))
+    pm = jax.eval_shape(mlp.init, jax.random.PRNGKey(1))
+    for phase, b, s, mode in (("prefill", 16, 128, "ag_rs"),
+                              ("decode", 128, 1, "gemm_ar")):
+        m = b * s
+        pos = jnp.zeros((b, s), jnp.int32)
+        offset = jnp.int32(0 if phase == "prefill" else 256)
+
+        def f(x, pa, pm, kc, vc, mode=mode, pos=pos, offset=offset):
+            a_out, _ = attn(pa, x, pos, rope, (kc, vc), offset, mode=mode)
+            y = x + a_out
+            return y + mlp(pm, y, mode=mode)
+        check_entry_vmem(
+            f, jax.ShapeDtypeStruct((m, h), bf16), pa, pm,
+            jax.ShapeDtypeStruct((b, 512, nkv, d), bf16),
+            jax.ShapeDtypeStruct((b, 512, nkv, d), bf16))
