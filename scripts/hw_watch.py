@@ -110,25 +110,34 @@ def run_step(name: str, argv: list[str], deadline_s: float,
 
 
 def main() -> None:
-    log(f"watcher up, {len(QUEUE)} steps queued")
+    queue = list(QUEUE)
+    retried: set[str] = set()
+    log(f"watcher up, {len(queue)} steps queued")
     i = 0
-    while i < len(QUEUE):
+    while i < len(queue):
         if not probe():
             log("tunnel wedged; sleeping 300s")
             time.sleep(300.0)
             continue
         log("tunnel ALIVE")
-        name, argv, deadline, env_extra = QUEUE[i]
+        name, argv, deadline, env_extra = queue[i]
         status = run_step(name, argv, deadline, env_extra)
         i += 1
         if status == "abandoned":
-            # The abandoned child is still alive and owns the (single)
-            # TPU client slot; starting another step would contend for
-            # the backend and can wedge the tunnel harder. Stop here —
-            # partial evidence is on disk.
-            log("step abandoned; stopping the queue (abandoned child "
-                "still holds the backend)")
-            break
+            # The abandoned child may still own the (single) TPU client
+            # slot — do NOT race it. But a later probe SUCCEEDING means
+            # the backend answers again (the child finished or the
+            # wedge cleared), so rather than ending the queue forever
+            # (r3 behavior — it cost the whole evidence tail), wait for
+            # health and continue; the abandoned step itself gets ONE
+            # retry at the back of the queue (r4).
+            log("step abandoned; waiting for the tunnel before the "
+                "next step")
+            if name not in retried:
+                retried.add(name)
+                queue.append((name, argv, deadline, env_extra))
+                log(f"step {name}: re-queued once at the back")
+            time.sleep(300.0)
     log("queue drained; watcher exiting")
     with open(os.path.join(ROOT, ".hw_watch_done"), "w") as f:
         f.write(time.strftime("%Y-%m-%d %H:%M:%S") + "\n")
