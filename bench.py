@@ -173,7 +173,16 @@ def _run_parts_in_children(extras: dict) -> None:
     import subprocess
     import tempfile
     me = os.path.abspath(__file__)
-    for name in _PART_ORDER:
+    # TDT_BENCH_PARTS: comma-separated subset of _PART_ORDER for the
+    # PARENT orchestrator (per-part child isolation preserved, unlike
+    # TDT_BENCH_ONLY which runs inline). Lets the hardware watcher
+    # queue a short headline-only bench at position 1 (VERDICT r4
+    # next-1) without giving up the abandon-don't-kill machinery.
+    parts_env = [s for s in os.environ.get("TDT_BENCH_PARTS", "").split(",")
+                 if s]  # validated up front in main()
+    part_order = tuple(p for p in _PART_ORDER
+                       if not parts_env or p in parts_env)
+    for name in part_order:
         budget_left = _remaining_s()
         # A child pays up to ~180 s of backend-init (two 75 s probes +
         # backoff) before benching; spawning it with less would expire
@@ -243,7 +252,7 @@ def _run_parts_in_children(extras: dict) -> None:
                 extras[name + "_timeout_budget_clamped"] = True
                 extras["aborted_reason"] = "budget_exhausted"
                 extras.setdefault("skipped_budget", []).extend(
-                    p for p in _PART_ORDER[_PART_ORDER.index(name) + 1:])
+                    p for p in part_order[part_order.index(name) + 1:])
             else:
                 extras["aborted_reason"] = "possible_wedge"
             break
@@ -1105,6 +1114,15 @@ def main():
     extras: dict = {}
     result = {"metric": "ag_gemm_tflops", "value": None, "unit": "TFLOPS",
               "vs_baseline": None, "extras": extras}
+    # Validate part selectors BEFORE the probe and the checkpoint
+    # clear: a typo'd TDT_BENCH_PARTS must fail loud without first
+    # erasing the previous run's evidence (and must fail even when the
+    # tunnel is wedged and the probe branch would return early).
+    bad = [s for s in os.environ.get("TDT_BENCH_PARTS", "").split(",")
+           if s and s not in _PART_ORDER]
+    if bad:
+        raise SystemExit(f"unknown TDT_BENCH_PARTS entries {bad}; "
+                         f"known: {list(_PART_ORDER)}")
     only_env = [s for s in os.environ.get("TDT_BENCH_ONLY", "").split(",")
                 if s]
     if not only_env and os.environ.get("TDT_BENCH_SUBPROC", "1") != "0":
@@ -1122,18 +1140,46 @@ def main():
             # of the headline fields). The watcher's bench writes to a
             # dedicated path, so scan both.
             here = os.path.dirname(os.path.abspath(__file__))
-            best_ts = -1.0
-            for path in (_progress_path(),
-                         os.path.join(here, ".bench_progress_watcher.json")):
+            # Among candidates the NEWEST one that carries at least one
+            # measured metric wins: plain newest-wins lets a wedged
+            # run's near-empty "init" checkpoint mask the good run it
+            # followed, while metric-count-wins would let an
+            # arbitrarily stale full run outrank this round's fresh
+            # headline evidence (review r5a-1, r5b-1). Scan every path
+            # a bench may have checkpointed to, deduplicated: the
+            # active TDT_BENCH_PROGRESS target, the default, and both
+            # watcher files (review r5b-2).
+
+            def _n_measured(ex: dict) -> int:
+                return sum(1 for k, v in ex.items()
+                           if isinstance(v, (int, float))
+                           and k.endswith(("_ms", "_tflops", "_ratio",
+                                           "_tokens_per_s", "_pct",
+                                           "_bytes")))
+            candidates = []
+            for path in (
+                    _progress_path(),
+                    os.path.join(here, ".bench_progress_latest.json"),
+                    os.path.join(here, ".bench_progress_watcher.json"),
+                    os.path.join(here,
+                                 ".bench_progress_watcher_headline.json")):
+                if path not in candidates:
+                    candidates.append(path)
+            best = (-1, -1.0)  # (has_measured, ts)
+            for path in candidates:
                 try:
                     with open(path) as f:
                         prior = json.load(f)
                     ts = float(prior.get("ts", 0))
-                    if ts > best_ts:
-                        best_ts = ts
-                        extras["prior_run"] = prior.get("extras", {})
+                    prior_extras = prior.get("extras", {})
+                    n_measured = _n_measured(prior_extras)
+                    score = (1 if n_measured else 0, ts)
+                    if score > best:
+                        best = score
+                        extras["prior_run"] = prior_extras
                         extras["prior_run_age_s"] = round(time.time() - ts)
                         extras["prior_run_path"] = os.path.basename(path)
+                        extras["prior_run_n_measured"] = n_measured
                 except (OSError, ValueError):
                     pass
             print(json.dumps(result))

@@ -25,45 +25,56 @@ LOG = os.path.join(ROOT, "hw_watch.log")
 # step wedges (probe after each step to know).
 
 QUEUE = [
-    # Round-4 evidence queue (VERDICT r3 next-3: one full-green on-chip
-    # smoke; next-1: a machine-captured bench).
-    # Pass 1: the bulk of the smoke cases, minus the two historically
-    # risky compiles — a hang in either must not cost the other 41.
-    ("smoke_bulk",
-     [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "420",
-      "--skip", "train/fused_step,sp_ag_attention/pallas",
-      "--log", "tpu_smoke_r4_bulk.log"],
-     7200.0, {}),
-    # The rewritten fused SP kernel's first on-chip compile, alone.
+    # Round-5 evidence queue, PERF-FIRST (VERDICT r4 next-1: "on any
+    # tunnel window >=20 min, BENCH-quality numbers exist before
+    # anything else runs"). Four rounds have produced zero
+    # machine-captured TPU perf because smoke always ran first and the
+    # window closed before the bench's turn.
+    #
+    # Position 1: the contract metrics alone — ag_gemm, gemm_rs,
+    # gemm_ar, flash_decode, tp_mlp at the 2048x4096x4096 class.
+    # ~10 min warm, <=20 min cold. Dedicated checkpoint file so a
+    # later wedged run can never erase it (bench.py's probe-failure
+    # fallback scans all checkpoint paths; newest WITH measured
+    # metrics wins, so an empty init checkpoint can't mask this).
+    ("bench_headline",
+     [sys.executable, "bench.py"], 1500.0,
+     {"TDT_BENCH_BUDGET_S": "1300",
+      "TDT_BENCH_PARTS": "ag_gemm,gemm_rs,gemm_ar,flash_decode,tp_mlp",
+      "TDT_BENCH_PROGRESS":
+          os.path.join(ROOT, ".bench_progress_watcher_headline.json")}),
+    # Position 2: the fused SP kernel's first-ever on-chip compile
+    # (VERDICT r4 missing-2; three rounds export-lint-only).
     ("sp_pallas",
      [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "600",
       "--only", "=sp_ag_attention/pallas",
-      "--log", "tpu_smoke_r4_sp.log"],
+      "--log", "tpu_smoke_r5_sp.log"],
      900.0, {}),
-    # The train-step compile (observed 35 min once; cache may help).
-    ("train_step",
-     [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "900",
-      "--only", "=train/fused_step",
-      "--log", "tpu_smoke_r4_train.log"],
-     1200.0, {}),
-    # Consolidated full-green run for the committed log: every compile
-    # is now warm in .jax_cache, so 43 cases fit one pass.
-    ("smoke_full",
-     [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "420",
-      "--log", "tpu_smoke_r4.log"],
-     7200.0, {}),
-    # Full machine-captured bench through the new budgeted orchestrator
-    # (streams cumulative JSON; also warms every part for the driver's
-    # end-of-round run). Its checkpoint goes to a DEDICATED file —
-    # .bench_progress_latest.json is cleared by every fresh bench run,
-    # which would erase this evidence if the driver's end-of-round run
-    # starts and then wedges (review r4a-2); stdout is kept in
-    # hw_bench_full.out by run_step.
+    # Position 3: the full 12-part bench (adds layer_8b/layer_32b
+    # real-dim e2e, overlap, mega, moe, sp, train). Headline parts
+    # recompile warm from position 1's cache.
     ("bench_full",
      [sys.executable, "bench.py"], 2700.0,
      {"TDT_BENCH_BUDGET_S": "2400",
       "TDT_BENCH_PROGRESS":
           os.path.join(ROOT, ".bench_progress_watcher.json")}),
+    # Position 4: the train-step compile (observed 35 min once cold).
+    ("train_step",
+     [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "900",
+      "--only", "=train/fused_step",
+      "--log", "tpu_smoke_r5_train.log"],
+     1200.0, {}),
+    # Positions 5-6: the smoke bulk, LAST (it is correctness evidence,
+    # not the contract deliverable; ~2 h cold).
+    ("smoke_bulk",
+     [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "420",
+      "--skip", "train/fused_step,sp_ag_attention/pallas",
+      "--log", "tpu_smoke_r5_bulk.log"],
+     7200.0, {}),
+    ("smoke_full",
+     [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "420",
+      "--log", "tpu_smoke_r5.log"],
+     7200.0, {}),
 ]
 
 
