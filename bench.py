@@ -296,10 +296,12 @@ def _finalize_checks(extras: dict) -> None:
     if ag and rs:
         r = max(ag, rs) / min(ag, rs)
         extras["baseline_xla_ratio"] = round(r, 3)
-        # Judge the ratio only on chip runs (calib present): the CPU
-        # validation path times µs-scale toy shapes where fixed
-        # overheads legitimately dominate the comparison.
-        if r > 1.5 and calib:
+        # Fires on CPU runs too since r5: with min-of-5 windowed timing
+        # (perf_func_chained) the toy-shape pair agrees within ~1.05x
+        # unloaded / 1.36x under bursty load on the 1-core host, so
+        # >1.5x is a real signal, not scheduler noise (docs/perf.md
+        # "2.845x ... root cause").
+        if r > 1.5:
             anomalies.append(f"ag_gemm_xla {ag} vs gemm_rs_xla {rs}: "
                              f"same matmul, {r:.2f}x apart")
     # calib_ms times the FULL matmul on one chip, while the baselines
@@ -1110,6 +1112,30 @@ def _bench_train(mesh, n, on_tpu, extras):
     return times["fused"], times["xla"] / times["fused"]
 
 
+def _n_measured(ex: dict) -> int:
+    """Count measured-metric keys in a checkpoint's extras."""
+    return sum(1 for k, v in ex.items()
+               if isinstance(v, (int, float))
+               and k.endswith(("_ms", "_tflops", "_ratio",
+                               "_tokens_per_s", "_pct", "_bytes")))
+
+
+def _fallback_scan_paths() -> list:
+    """Every path a bench may have checkpointed to, deduplicated: the
+    active TDT_BENCH_PROGRESS target, the default, and both watcher
+    files (review r5b-2). Module-level so tests can patch it."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = []
+    for path in (
+            _progress_path(),
+            os.path.join(here, ".bench_progress_latest.json"),
+            os.path.join(here, ".bench_progress_watcher.json"),
+            os.path.join(here, ".bench_progress_watcher_headline.json")):
+        if path not in candidates:
+            candidates.append(path)
+    return candidates
+
+
 def main():
     extras: dict = {}
     result = {"metric": "ag_gemm_tflops", "value": None, "unit": "TFLOPS",
@@ -1139,34 +1165,14 @@ def main():
             # knowledge of the last good run — but its metrics stay OUT
             # of the headline fields). The watcher's bench writes to a
             # dedicated path, so scan both.
-            here = os.path.dirname(os.path.abspath(__file__))
             # Among candidates the NEWEST one that carries at least one
             # measured metric wins: plain newest-wins lets a wedged
             # run's near-empty "init" checkpoint mask the good run it
             # followed, while metric-count-wins would let an
             # arbitrarily stale full run outrank this round's fresh
-            # headline evidence (review r5a-1, r5b-1). Scan every path
-            # a bench may have checkpointed to, deduplicated: the
-            # active TDT_BENCH_PROGRESS target, the default, and both
-            # watcher files (review r5b-2).
-
-            def _n_measured(ex: dict) -> int:
-                return sum(1 for k, v in ex.items()
-                           if isinstance(v, (int, float))
-                           and k.endswith(("_ms", "_tflops", "_ratio",
-                                           "_tokens_per_s", "_pct",
-                                           "_bytes")))
-            candidates = []
-            for path in (
-                    _progress_path(),
-                    os.path.join(here, ".bench_progress_latest.json"),
-                    os.path.join(here, ".bench_progress_watcher.json"),
-                    os.path.join(here,
-                                 ".bench_progress_watcher_headline.json")):
-                if path not in candidates:
-                    candidates.append(path)
+            # headline evidence (review r5a-1, r5b-1).
             best = (-1, -1.0)  # (has_measured, ts)
-            for path in candidates:
+            for path in _fallback_scan_paths():
                 try:
                     with open(path) as f:
                         prior = json.load(f)

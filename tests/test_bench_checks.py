@@ -89,9 +89,12 @@ def test_probe_failure_exits_zero_with_prior(tmp_path, monkeypatch):
         {"last_done": "ag_gemm", "ts": 0,
          "extras": {"ag_gemm_tflops": 123.0}}))
     # Drive main() in-process with the subprocess probe forced to fail
-    # (hermetic stand-in for the wedged tunnel).
+    # (hermetic stand-in for the wedged tunnel). The scan list is
+    # pinned to the planted file so the repo's own live checkpoints
+    # can't shadow it.
     mod = _load_bench()
     mod._probe_backend_subprocess = lambda *_a, **_k: False
+    mod._fallback_scan_paths = lambda: [str(prior)]
     monkeypatch.setenv("TDT_BENCH_PROGRESS", str(prior))
     monkeypatch.delenv("TDT_BENCH_CPU", raising=False)
     monkeypatch.delenv("TDT_BENCH_ONLY", raising=False)
@@ -103,3 +106,51 @@ def test_probe_failure_exits_zero_with_prior(tmp_path, monkeypatch):
     assert out["extras"]["probe_failed"] is True
     assert out["extras"]["prior_run"]["ag_gemm_tflops"] == 123.0
     assert "prior_run_age_s" in out["extras"]
+
+
+def test_probe_failure_prior_ranking(tmp_path, monkeypatch):
+    """The fallback picks the NEWEST checkpoint that carries measured
+    metrics: a wedged run's fresh-but-empty init checkpoint must not
+    mask an older run with real evidence, and among runs WITH evidence
+    recency wins (review r5a-1/r5b-1)."""
+    old_good = tmp_path / "old_good.json"
+    old_good.write_text(json.dumps(
+        {"ts": 1000.0, "extras": {"ag_gemm_tflops": 1.0,
+                                  "ag_gemm_pallas_ms": 2.0}}))
+    new_good = tmp_path / "new_good.json"
+    new_good.write_text(json.dumps(
+        {"ts": 2000.0, "extras": {"tp_mlp_fused_ms": 3.0}}))
+    fresh_empty = tmp_path / "fresh_empty.json"
+    fresh_empty.write_text(json.dumps(
+        {"ts": 3000.0, "extras": {"checkpoint_after": "init"}}))
+    mod = _load_bench()
+    mod._probe_backend_subprocess = lambda *_a, **_k: False
+    mod._fallback_scan_paths = lambda: [str(old_good), str(new_good),
+                                        str(fresh_empty)]
+    monkeypatch.delenv("TDT_BENCH_CPU", raising=False)
+    monkeypatch.delenv("TDT_BENCH_ONLY", raising=False)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        mod.main()
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    # new_good wins: newest among metric-bearing; fresh_empty loses
+    # despite being newest overall.
+    assert out["extras"]["prior_run"] == {"tp_mlp_fused_ms": 3.0}
+    assert out["extras"]["prior_run_n_measured"] == 1
+
+
+def test_bench_parts_typo_fails_before_checkpoint(tmp_path, monkeypatch):
+    """A typo'd TDT_BENCH_PARTS must SystemExit before the checkpoint
+    clear — prior evidence survives (review r5a-2)."""
+    import pytest
+
+    progress = tmp_path / "progress.json"
+    progress.write_text(json.dumps(
+        {"ts": 1.0, "extras": {"ag_gemm_tflops": 9.0}}))
+    mod = _load_bench()
+    monkeypatch.setenv("TDT_BENCH_PROGRESS", str(progress))
+    monkeypatch.setenv("TDT_BENCH_PARTS", "ag_gemm,flash_deocde")
+    with pytest.raises(SystemExit):
+        mod.main()
+    assert json.loads(progress.read_text())["extras"] == {
+        "ag_gemm_tflops": 9.0}
