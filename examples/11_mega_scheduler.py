@@ -6,10 +6,12 @@ decoder step as a task graph, inspect the dependency structure
 (wavefronts), run the HEFT critical-path scheduler (queue assignment +
 speed-of-light makespan), and execute the SAME graph as one fused jit
 program under both emission orders — topological and HEFT
-priority-first — verifying numerics are identical. On TPU the emission
-order is the schedule input XLA accepts from us (it seeds buffer
-liveness and the latency-hiding scheduler); bench.py measures its
-peak-temp-memory effect at 32-layer depth.
+priority-first — verifying numerics are identical. Note: emission
+order does NOT change the compiled program (XLA schedules the dataflow
+graph; see docs/architecture.md "Mega scheduler" for the r5
+experiments demoting the scheduler to a perf-model/observability
+tool). What IS live: the dependency structure fed to jit, and the
+makespan perf model shown below.
 
 Run:
   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

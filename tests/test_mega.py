@@ -311,3 +311,33 @@ def test_mega_qwen3_heft_matches_topo(mesh8, key):
         params, token, kv.init(), 0)
     np.testing.assert_allclose(np.asarray(out_t), np.asarray(out_h),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_heft_emission_inert_under_xla():
+    """Pins the r5 demotion finding (docs/architecture.md "Mega
+    scheduler"): topo- and heft-ordered emissions of the same graph
+    compile to programs with IDENTICAL peak temp memory — XLA
+    schedules the dataflow graph and normalizes instruction order
+    away, so emission order is an observability knob, not a schedule
+    input. If this ever fails, emission order has become meaningful
+    and the scheduler's demotion should be revisited."""
+    n = 128
+    g = TaskGraph()
+    for i in range(4):
+        g.add("mm1", lambda x: x @ (jnp.ones((n, n)) * 0.01),
+              ["x"], [f"t{i}"], cost=10 * (i + 1))
+        g.add("mm2", lambda t: t @ (jnp.ones((n, n)) * 0.01),
+              [f"t{i}"], [f"u{i}"], cost=5)
+    g.add("sum", lambda *us: sum(jnp.sum(u) for u in us),
+          [f"u{i}" for i in range(4)], ["out"], cost=1)
+    assert g.order().tolist() != g.priority_order().tolist()
+    x = jnp.ones((n, n), jnp.float32)
+    temps = {}
+    for pol in ("topo", "heft"):
+        run = g.make_executor(["x"], ["out"], order_policy=pol)
+        compiled = jax.jit(lambda x, run=run: run(x)).lower(x).compile()
+        ma = compiled.memory_analysis()
+        assert ma is not None, "memory_analysis unavailable: test is moot"
+        temps[pol] = int(ma.temp_size_in_bytes)
+    assert temps["topo"] >= 0
+    assert temps["topo"] == temps["heft"], temps
