@@ -1,0 +1,105 @@
+"""Worker process for tests/test_multihost.py — NOT collected by pytest.
+
+One of two cooperating `jax.distributed` processes on CPU (gloo
+collectives). Exercises the code paths no single-process 8-device mesh
+can touch (VERDICT r4 next-5): ``runtime/dist.py::_maybe_multihost_init``
+(driven by the JAX_COORDINATOR_ADDRESS/... env the TPU pod launcher
+would set), a cross-process collective through the global mesh, and one
+``tools/autotuner.py`` round whose multi-host agreement protocol
+(worst-rank scores via ``process_allgather``, process-0 cache-hit
+broadcast) must leave both processes with the same winner.
+
+Reference analog: every reference test runs under torchrun with
+NCCL/gloo process groups (SURVEY.md §4); this is the TPU-native spine's
+DCN-path equivalent.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    pid, port, tmpdir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_NUM_PROCESSES"] = "2"
+    os.environ["JAX_PROCESS_ID"] = str(pid)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # Exercise the device-kind-keyed disk cache path too (shared dir —
+    # both processes see the same file, like a shared NFS home on a pod).
+    os.environ["TDT_AUTOTUNE_CACHE"] = os.path.join(tmpdir, "autotune.json")
+
+    import jax
+
+    # BEFORE any backend init: the axon sitecustomize pins the tunneled
+    # TPU platform otherwise, and jax.distributed would then block on
+    # the (often wedged) tunnel instead of gloo/CPU.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from triton_dist_tpu.runtime import dist as tdist
+
+    ctx = tdist.initialize_distributed()
+    assert jax.process_count() == 2, jax.process_count()
+    assert ctx.num_processes == 2
+    assert ctx.world_size == 8, ctx.world_size
+    mesh = ctx.mesh
+
+    # -- 1. cross-process collective through the global mesh (DCN path).
+    # Data lives sharded across BOTH processes; the psum must cross them.
+    x = jax.device_put(
+        jnp.arange(8, dtype=jnp.float32),
+        NamedSharding(mesh, P("tp")))
+
+    @jax.jit
+    def total(v):
+        return jnp.sum(v)
+
+    s = float(total(x))
+    assert s == 28.0, s
+
+    # A shard_map psum over the mesh axis — the framework's collective
+    # idiom (ops use this shape) across the process boundary.
+    from jax import shard_map
+
+    @jax.jit
+    def allred(v):
+        return shard_map(
+            lambda t: jax.lax.psum(t, "tp"),
+            mesh=mesh, in_specs=P("tp"), out_specs=P())(v)
+
+    r = np.asarray(allred(jnp.ones((8,), jnp.float32)))
+    assert float(r[0]) == 8.0, r
+
+    # -- 2. one autotune round: both processes must agree on the winner
+    # even though their local timings differ.
+    from triton_dist_tpu.tools.autotuner import autotune
+
+    a64 = jnp.ones((64, 64), jnp.float32)
+    a512 = jnp.ones((512, 512), jnp.float32)
+
+    def make_fn(n):
+        mat = a64 if n == 64 else a512
+        f = jax.jit(lambda: (mat @ mat).sum())
+
+        def run():
+            return jax.block_until_ready(f())
+        return run
+
+    res = autotune(make_fn, [{"n": 512}, {"n": 64}], key="mh_test")
+    # Second call must be served from the (agreed) cache.
+    res2 = autotune(make_fn, [{"n": 512}, {"n": 64}], key="mh_test")
+    assert res2.config == res.config
+    print(f"RESULT pid={pid} winner={res.config['n']} psum={float(r[0])}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

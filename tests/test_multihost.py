@@ -1,0 +1,80 @@
+"""Two-process `jax.distributed` CPU test (VERDICT r4 next-5).
+
+Spawns two cooperating processes that each run
+``tests/_multihost_worker.py``: `jax.distributed.initialize` via the
+env-var path `runtime/dist.py::_maybe_multihost_init` reads, a
+cross-process collective on the global 2x4-device mesh, and one
+multi-host autotune round. This covers the DCN code path that no
+single-process 8-device mesh touches — the reference's whole test spine
+is multi-process launch (SURVEY.md §4, torchrun), and this is its
+TPU-native equivalent.
+"""
+
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = pathlib.Path(__file__).resolve().parent / "_multihost_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_mesh_collective_and_autotune(tmp_path):
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_COORDINATOR_ADDRESS",
+                        "JAX_NUM_PROCESSES", "JAX_PROCESS_ID")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(_WORKER), str(pid), str(port),
+             str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"multihost workers timed out; partial: {outs}")
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    results = sorted(
+        line for out in outs for line in out.splitlines()
+        if line.startswith("RESULT"))
+    assert len(results) == 2, outs
+    # Same winner on both processes (the agreement contract), and the
+    # cross-process psum saw all 8 shards.
+    w0 = results[0].split("winner=")[1]
+    w1 = results[1].split("winner=")[1]
+    assert w0 == w1, results
+    assert all("psum=8.0" in r for r in results)
+
+
+def test_multihost_init_env_validation(monkeypatch):
+    """Partial/garbled JAX_* multihost env fails with a clear
+    configuration error, not a raw int()/JAX traceback (review r5d-3)."""
+    from triton_dist_tpu.runtime import dist as tdist
+
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    with pytest.raises(RuntimeError, match="JAX_PROCESS_ID"):
+        tdist._maybe_multihost_init()
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "two")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    with pytest.raises(RuntimeError, match="num_processes"):
+        tdist._maybe_multihost_init()

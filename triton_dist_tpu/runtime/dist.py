@@ -72,9 +72,28 @@ def _maybe_multihost_init() -> None:
     or explicitly via JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
     JAX_PROCESS_ID.
     """
-    if os.environ.get("JAX_COORDINATOR_ADDRESS") and os.environ.get("JAX_NUM_PROCESSES"):
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = os.environ.get("JAX_NUM_PROCESSES")
+    pid = os.environ.get("JAX_PROCESS_ID")
+    if coord and nproc:
         try:
-            jax.distributed.initialize()
+            nproc_i, pid_i = int(nproc), int(pid)  # type: ignore[arg-type]
+        except (TypeError, ValueError):
+            raise RuntimeError(
+                "multi-host init needs all three of "
+                "JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES and "
+                "JAX_PROCESS_ID set to valid values; got "
+                f"num_processes={nproc!r}, process_id={pid!r}") from None
+        try:
+            # Passed explicitly: bare ``initialize()`` only auto-detects
+            # under recognized cluster launchers (Slurm/MPI/K8s), NOT
+            # from these env vars — found by tests/test_multihost.py
+            # (the r4 path raised "Number of processes must be
+            # defined" on any pod launched this way).
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=nproc_i,
+                process_id=pid_i)
         except RuntimeError:
             # Already initialized (idempotent re-entry, like the reference's
             # barrier-guarded re-init).
