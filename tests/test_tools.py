@@ -256,3 +256,93 @@ def test_autotune_isolates_failing_config():
     with _pytest.raises(RuntimeError, match="every autotune config"):
         autotune(make_fn, [{"ok": False}], key="isolate-test-2",
                  iters=2, warmup_iters=1)
+
+
+def test_disk_cache_device_kind_quarantine(tmp_path, monkeypatch):
+    """A winner persisted under one device kind must NEVER be served
+    under another (VERDICT r4 next-6): a CPU interpret-mode verdict
+    (where ring beats fused by 100-300x of pure artifact) leaking onto
+    TPU would silently pin the wrong impl on chip. The disk key is
+    '{device_kind}::{key}'."""
+    monkeypatch.setenv("TDT_AUTOTUNE_CACHE", str(tmp_path / "t.json"))
+    autotuner.clear_cache()
+    calls = []
+
+    def make_fn(v):
+        calls.append(v)
+        return lambda: None
+
+    cfgs = [{"v": 1}, {"v": 2}]
+    # Plant a cpu-keyed winner by sweeping under the real (cpu) backend.
+    r1 = autotune(make_fn, cfgs, key="quar", iters=1, warmup_iters=1)
+    assert (tmp_path / "t.json").exists()
+    import json
+    keys = list(json.loads((tmp_path / "t.json").read_text()))
+    assert all("::" in k for k in keys), keys
+
+    # Same key looked up under a FAKE TPU platform: must miss.
+    class _Dev:
+        device_kind = "TPU v5 lite"
+
+    class _FakeJax:
+        @staticmethod
+        def devices():
+            return [_Dev()]
+    real_jax = autotuner.jax
+    monkeypatch.setattr(autotuner, "jax", _FakeJax)
+    assert autotuner._disk_load("quar") is None
+    # And back under the real platform it still hits.
+    monkeypatch.setattr(autotuner, "jax", real_jax)
+    hit = autotuner._disk_load("quar")
+    assert hit is not None and hit.config == r1.config
+
+
+def test_trace_fallback_multiprocess_refuses_disk(tmp_path, monkeypatch):
+    """consult_disk_for_trace returns None on multi-process deployments
+    even when a local cache hit exists (ADVICE r4-1: a per-host disk
+    consult with no agreement step can bake MISMATCHED collective
+    programs across ranks — a hang), and warns once."""
+    import warnings
+
+    monkeypatch.setenv("TDT_AUTOTUNE_CACHE", str(tmp_path / "t.json"))
+    autotuner.clear_cache()
+    autotuner._TRACE_FALLBACK_WARNED.clear()
+    autotune(lambda v: (lambda: None), [{"v": 1}], key="mp", iters=1,
+             warmup_iters=1)
+    assert autotuner._disk_load("mp") is not None  # local hit exists
+
+    class _FakeJax:
+        @staticmethod
+        def process_count():
+            return 2
+
+        @staticmethod
+        def devices():
+            return autotuner.jax.devices()
+    real_jax = autotuner.jax
+    monkeypatch.setattr(autotuner, "jax", _FakeJax)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert autotuner.consult_disk_for_trace("mp") is None
+        assert autotuner.consult_disk_for_trace("mp") is None  # warn once
+    assert len([x for x in w if "multi-process" in str(x.message)]) == 1
+    monkeypatch.setattr(autotuner, "jax", real_jax)
+    # Single-process: the same consult hits.
+    autotuner._TRACE_FALLBACK_WARNED.clear()
+    assert autotuner.consult_disk_for_trace("mp") is not None
+
+
+def test_trace_fallback_miss_warns_once(tmp_path, monkeypatch):
+    """A traced auto call with NO cached winner warns once that the
+    program baked the default impl for its lifetime (ADVICE r4-4)."""
+    import warnings
+
+    monkeypatch.setenv("TDT_AUTOTUNE_CACHE", str(tmp_path / "t.json"))
+    autotuner._TRACE_FALLBACK_WARNED.clear()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert autotuner.consult_disk_for_trace("missing_key") is None
+        assert autotuner.consult_disk_for_trace("missing_key") is None
+    msgs = [x for x in w if "baked" in str(x.message).lower()
+            or "bakes" in str(x.message)]
+    assert len(msgs) == 1

@@ -398,6 +398,18 @@ class Engine:
         self.kv.reset()
         cur_table = None
         if paged:
+            # Fail with a sizing message BEFORE touching the allocator:
+            # streaming pre-allocates every lane (see below), so an
+            # oversubscribed pool (legal for plain serve) would
+            # otherwise die mid-loop with a bare "device pool
+            # exhausted" (ADVICE r4-2).
+            need = b * self.kv.pages_per_seq_dev
+            assert self.kv.slots_per_dev >= need, (
+                f"serve_stream pre-allocates pages for every batch row: "
+                f"pool has {self.kv.slots_per_dev} slots/device, needs "
+                f"{need} (batch {b} x {self.kv.pages_per_seq_dev} "
+                f"pages/seq/device). Construct the paged pool with "
+                f"full-batch capacity for streaming, or lower batch.")
             for row in self.kv.owned_rows():
                 self.kv.free_seq(row)
             # Every lane must own its pages from step 0: the decode step

@@ -349,9 +349,12 @@ def ag_group_gemm(x: jax.Array, w: jax.Array, expert_ids: jax.Array,
                            key=tune_key, iters=8, warmup_iters=2)
             choice = _IMPL_TUNED[shape_key] = res.config["impl"]
         elif choice is None:
-            # Traced: a prior run's disk-cached winner still counts.
-            from triton_dist_tpu.tools.autotuner import _disk_load
-            hit = _disk_load(tune_key)
+            # Traced: a prior run's disk-cached winner still counts —
+            # single-controller only, warns once on a miss (ADVICE r4;
+            # see consult_disk_for_trace).
+            from triton_dist_tpu.tools.autotuner import (
+                consult_disk_for_trace)
+            hit = consult_disk_for_trace(tune_key)
             if hit is not None:
                 choice = _IMPL_TUNED[shape_key] = hit.config["impl"]
         impl = choice or "ring"   # no sweep, no cache: ring default

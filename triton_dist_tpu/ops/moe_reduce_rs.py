@@ -341,8 +341,9 @@ def moe_reduce_rs(act: jax.Array, w_down: jax.Array, expert_ids: jax.Array,
             # winner in the autotuner's disk cache still counts — the
             # docstring's "measured once per shape, disk-cached"
             # promise must hold under jit too (review r4b-5).
-            from triton_dist_tpu.tools.autotuner import _disk_load
-            hit = _disk_load(tune_key)
+            from triton_dist_tpu.tools.autotuner import (
+                consult_disk_for_trace)
+            hit = consult_disk_for_trace(tune_key)
             if hit is not None:
                 choice = _IMPL_TUNED[shape_key] = hit.config["impl"]
         impl = choice or "ring"   # no sweep, no cache: ring default

@@ -114,6 +114,50 @@ def _disk_store(key: str, result: TuneResult) -> None:
         pass
 
 
+_TRACE_FALLBACK_WARNED: set = set()
+
+
+def consult_disk_for_trace(key: str) -> "TuneResult | None":
+    """Disk-cache consult for an ``impl="auto"`` call first hit under
+    jit TRACING (no eager sweep possible there).
+
+    Two deliberate restrictions (ADVICE r4 items 1 and 4):
+
+    - **Multi-process: always None.** The cache file may exist on only
+      some hosts, and a winner applied on some ranks but not others
+      would bake MISMATCHED collective programs across the deployment —
+      a hang, not a slowdown. Eager ``autotune`` sweeps are rank-agreed
+      (worst-rank scores + process-0 hit broadcast); this traced
+      shortcut has no agreement step, so it is single-controller-only.
+    - **One-time warning on a miss**, so users know the traced program
+      baked the default impl for its lifetime and a later eager tune
+      will not update it.
+    """
+    if jax.process_count() > 1:
+        if key not in _TRACE_FALLBACK_WARNED:
+            _TRACE_FALLBACK_WARNED.add(key)
+            import warnings
+            warnings.warn(
+                f"impl='auto' for {key!r} hit under jit tracing in a "
+                "multi-process deployment: using the default impl on "
+                "every rank (the per-host disk cache is not consulted "
+                "— divergent winners would hang collectives). Tune "
+                "eagerly once before jit to pick a measured winner.",
+                stacklevel=3)
+        return None
+    hit = _disk_load(key)
+    if hit is None and key not in _TRACE_FALLBACK_WARNED:
+        _TRACE_FALLBACK_WARNED.add(key)
+        import warnings
+        warnings.warn(
+            f"impl='auto' for {key!r} was first reached under jit "
+            "tracing with no cached winner: the traced program bakes "
+            "the default impl for its LIFETIME (a later eager tune "
+            "cannot update it). Run one eager call first to tune.",
+            stacklevel=3)
+    return hit
+
+
 def autotune(make_fn: Callable[..., Callable], configs: Sequence[dict],
              key: str | None = None, iters: int = 20,
              warmup_iters: int = 5) -> TuneResult:
