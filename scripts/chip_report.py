@@ -1,0 +1,104 @@
+"""Assemble on-chip evidence into one judge-readable markdown table.
+
+Run after the hardware watcher drains (or any manual chip session):
+
+    python scripts/chip_report.py > CHIP_EVIDENCE_r5.md
+
+Collects, without touching the tunnel:
+- the newest streamed JSON line from each ``hw_*.out`` bench capture,
+- every ``.bench_progress*.json`` checkpoint (ts, device kind, measured
+  metric count),
+- PASS/FAIL counts from ``tpu_smoke_r5*.log``.
+
+Pure host-side I/O — safe to run while the tunnel is wedged.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_METRIC_SUFFIXES = ("_ms", "_tflops", "_ratio", "_tokens_per_s", "_pct",
+                    "_bytes")
+
+
+def _measured(extras: dict) -> dict:
+    return {k: v for k, v in extras.items()
+            if isinstance(v, (int, float)) and k.endswith(_METRIC_SUFFIXES)}
+
+
+def _last_json_line(path: str) -> dict | None:
+    best = None
+    try:
+        with open(path, errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("{") and line.endswith("}"):
+                    try:
+                        best = json.loads(line)
+                    except ValueError:
+                        pass
+    except OSError:
+        return None
+    return best
+
+
+def main() -> None:
+    print("# Chip evidence report")
+    print(f"\nGenerated {time.strftime('%Y-%m-%d %H:%M:%S')} from "
+          f"`{ROOT}` (host-side files only).\n")
+
+    print("## Bench captures (hw_*.out streamed JSON)\n")
+    rows = []
+    for path in sorted(glob.glob(os.path.join(ROOT, "hw_*.out"))):
+        d = _last_json_line(path)
+        if not d:
+            continue
+        e = d.get("extras", {})
+        rows.append((os.path.basename(path), d.get("metric"),
+                     d.get("value"), e.get("device_kind", "?"),
+                     len(_measured(e)),
+                     e.get("baseline_anomaly")))
+    if rows:
+        print("| file | headline metric | value | device | measured keys |"
+              " anomaly |")
+        print("|---|---|---|---|---|---|")
+        for r in rows:
+            print("| " + " | ".join(str(x) for x in r) + " |")
+    else:
+        print("(none found)")
+
+    print("\n## Checkpoints (.bench_progress*.json)\n")
+    print("| file | age | device | measured keys | last part |")
+    print("|---|---|---|---|---|")
+    for path in sorted(glob.glob(os.path.join(ROOT, ".bench_progress*.json"))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        e = d.get("extras", {})
+        age_s = int(time.time() - float(d.get("ts", 0)))
+        print(f"| {os.path.basename(path)} | {age_s // 3600}h"
+              f"{(age_s % 3600) // 60:02d}m | {e.get('device_kind', '?')} | "
+              f"{len(_measured(e))} | {d.get('last_done', '?')} |")
+
+    print("\n## Smoke logs (tpu_smoke_r5*.log)\n")
+    print("| log | PASS | FAIL | TIMEOUT |")
+    print("|---|---|---|---|")
+    for path in sorted(glob.glob(os.path.join(ROOT, "tpu_smoke_r5*.log"))):
+        try:
+            with open(path, errors="replace") as f:
+                text = f.read()
+        except OSError:
+            continue
+        print(f"| {os.path.basename(path)} | {text.count(' PASS')} | "
+              f"{text.count(' FAIL')} | {text.count(' TIMEOUT')} |")
+
+
+if __name__ == "__main__":
+    main()

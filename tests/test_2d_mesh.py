@@ -14,6 +14,9 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+#: Heavy interpret-mode numerics -> full tier only (quick tier: pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 def _put(mesh, x, spec):
     return jax.device_put(x, NamedSharding(mesh, spec))

@@ -12,6 +12,9 @@ from triton_dist_tpu.ops.gemm_reduce_scatter import (
     create_gemm_rs_context, gemm_ar, gemm_rs)
 from triton_dist_tpu.runtime.utils import assert_allclose
 
+#: Heavy interpret-mode numerics -> full tier only (quick tier: pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 WORLD = 8
 M, K, N = 64, 32, 256   # per-device: (8, 32) x (32, 32)
 

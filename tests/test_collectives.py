@@ -16,6 +16,9 @@ from triton_dist_tpu.ops.reduce_scatter import (
     ReduceScatterMethod, create_reduce_scatter_context, reduce_scatter)
 from triton_dist_tpu.runtime.utils import assert_allclose, bitwise_equal
 
+#: Heavy interpret-mode numerics -> full tier only (quick tier: pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 WORLD = 8
 
 

@@ -14,6 +14,9 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+#: Heavy interpret-mode numerics -> full tier only (quick tier: pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture()
 def mesh_dp(devices):

@@ -9,6 +9,9 @@ import pytest
 
 from triton_dist_tpu.models import DenseLLM, Engine, ModelConfig
 
+#: Heavy interpret-mode numerics -> full tier only (quick tier: pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture()
 def small_model(mesh8, key):

@@ -12,6 +12,9 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
+#: Heavy interpret-mode numerics -> full tier only (quick tier: pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def hf_dir(tmp_path_factory):

@@ -7,6 +7,9 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+#: Heavy interpret-mode numerics -> full tier only (quick tier: pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 from triton_dist_tpu.ops.hierarchical import (
     all_gather_2d, all_gather_nd, all_reduce_2d, all_reduce_nd,
     create_hier_context, reduce_scatter_2d, reduce_scatter_nd)

@@ -98,6 +98,7 @@ def test_queue_assignment_costs():
 
 # -- qwen3 mega step ---------------------------------------------------------
 
+@pytest.mark.slow
 def test_mega_qwen3_matches_dense(mesh8, key):
     cfg = ModelConfig(hidden_size=64, intermediate_size=128,
                       num_hidden_layers=2, num_attention_heads=8,
@@ -127,6 +128,7 @@ def test_mega_qwen3_matches_dense(mesh8, key):
     assert n_waves >= 6
 
 
+@pytest.mark.slow
 def test_mega_decode_loop(mesh8, key):
     """Multi-step decode through the mega step matches DenseLLM decode."""
     cfg = ModelConfig(hidden_size=32, intermediate_size=64,
@@ -290,6 +292,7 @@ def test_executor_heft_order_matches_topo():
     assert order.index(1) < order.index(2)
 
 
+@pytest.mark.slow
 def test_mega_qwen3_heft_matches_topo(mesh8, key):
     """MegaQwen3(order_policy='heft') is numerically identical to the
     default emission order (same graph, different linearization)."""

@@ -12,6 +12,9 @@ from triton_dist_tpu.models import (
     AutoLLM, DenseLLM, Engine, ModelConfig, Qwen3MoE)
 from triton_dist_tpu.models.kv_cache import KVCacheManager
 
+#: Heavy interpret-mode numerics -> full tier only (quick tier: pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 def tiny_dense_cfg():
     return ModelConfig(hidden_size=64, intermediate_size=128,

@@ -26,6 +26,9 @@ from triton_dist_tpu.layers.ep_a2a import EPAll2AllLayer
 from triton_dist_tpu.layers.ep_moe import EPMoE
 from triton_dist_tpu.layers.tp_moe import TPMoE
 
+#: Heavy interpret-mode numerics -> full tier only (quick tier: pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 def dense_moe_golden(x, w_router, w_gate, w_up, w_down, topk,
                      norm_topk_prob=True):

@@ -8,6 +8,9 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.ops.p2p import create_p2p_context, pp_shift
+
+#: Heavy interpret-mode numerics -> full tier only (quick tier: pytest -m 'not slow').
+pytestmark = pytest.mark.slow
 from triton_dist_tpu.layers.p2p import (CommOp, pipeline_forward,
                                         pipeline_schedule)
 

@@ -17,6 +17,9 @@ import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import comm_params, resolve_interpret
 from triton_dist_tpu.testing.race import race_check, races_were_found
 
+#: Heavy interpret-mode numerics -> full tier only (quick tier: pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 def test_fused_ops_race_free(mesh8, key):
     """AG-GEMM + GEMM-RS signal protocols pass the race detector."""

@@ -13,6 +13,9 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+#: Heavy interpret-mode numerics -> full tier only (quick tier: pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 from triton_dist_tpu.ops.flash_decode import (
     create_flash_decode_context, gqa_fwd_batch_decode,
     gqa_fwd_batch_decode_paged)

@@ -20,6 +20,9 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh
 
+#: Heavy interpret-mode numerics -> full tier only (quick tier: pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 from triton_dist_tpu.models import (
     DenseLLM, Engine, KVCacheManager, ModelConfig, make_train_step)
 

@@ -9,6 +9,9 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+#: Heavy interpret-mode numerics -> full tier only (quick tier: pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 from triton_dist_tpu.ops.allgather_gemm import (
     create_ag_gemm_context, ag_gemm)
 from triton_dist_tpu.ops.gemm_reduce_scatter import (

@@ -22,6 +22,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from triton_dist_tpu.models import DenseLLM, ModelConfig, make_train_step
 from triton_dist_tpu.models.train import cross_entropy_loss
 
+#: Heavy interpret-mode numerics -> full tier only (quick tier: pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 def _tiny_cfg(world: int, dtype=jnp.float32, layers: int = 2):
     return ModelConfig(
