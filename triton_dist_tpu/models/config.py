@@ -54,6 +54,23 @@ class ModelConfig:
     def is_moe(self) -> bool:
         return self.num_experts > 0
 
+    def param_split(self) -> tuple[int, int, int]:
+        """(attn params/layer, mlp params/layer incl. all experts,
+        embedding params) — the ONE accounting shared by
+        ``models.presets.param_count`` and
+        ``parallel.plan_parallelism`` (review r5f-1: two hand-rolled
+        copies had already diverged on tied embeddings). Norm weights
+        are omitted (<0.1%)."""
+        h = self.hidden_size
+        attn = h * self.head_dim * (2 * self.num_attention_heads
+                                    + 2 * self.num_key_value_heads)
+        if self.is_moe:
+            mlp = 3 * h * self.moe_intermediate_size * self.num_experts
+        else:
+            mlp = 3 * h * self.intermediate_size
+        embed = (1 if self.tie_word_embeddings else 2) * h * self.vocab_size
+        return attn, mlp, embed
+
     @classmethod
     def from_hf_config(cls, path_or_dict) -> "ModelConfig":
         """Build from a HF ``config.json`` (file path, model dir, or dict) —

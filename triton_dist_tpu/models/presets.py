@@ -1,0 +1,76 @@
+"""Named configs for the models the reference benchmarks.
+
+The reference's published numbers are all Qwen3-8B / Qwen3-32B /
+Qwen3-MoE decodes on TP8 (docs/getting-started/e2e/e2e_dense.md:21-38,
+docs/mega_triton_kernel.md:30-39; Seed-OSS-36B README.md:82). These
+presets reproduce those architectures so `AutoLLM.build(presets.*())` +
+`parallel.plan_parallelism` give a reference user the same model menu
+without hunting for HF config JSONs. Values follow the public HF
+configs for the Qwen3 family.
+
+The bench's `layer_8b`/`layer_32b` parts use the same dimensions
+(hidden 4096/5120, inter 12288/25600, TP8 per-chip slices) — these
+presets are the whole-model form of those shapes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from triton_dist_tpu.models.config import ModelConfig
+
+
+def qwen3_0_6b(**overrides) -> ModelConfig:
+    """Qwen3-0.6B — the smallest real checkpoint; fits one chip easily.
+    (Tied embeddings, like the HF config.)"""
+    return _build(hidden_size=1024, intermediate_size=3072,
+                  num_hidden_layers=28, num_attention_heads=16,
+                  num_key_value_heads=8, head_dim=128,
+                  tie_word_embeddings=True, **overrides)
+
+
+def qwen3_8b(**overrides) -> ModelConfig:
+    """Qwen3-8B (reference e2e_dense.md + mega 8B rows)."""
+    return _build(hidden_size=4096, intermediate_size=12288,
+                  num_hidden_layers=36, num_attention_heads=32,
+                  num_key_value_heads=8, head_dim=128, **overrides)
+
+
+def qwen3_32b(**overrides) -> ModelConfig:
+    """Qwen3-32B (reference e2e prefill/decode + mega 32B rows)."""
+    return _build(hidden_size=5120, intermediate_size=25600,
+                  num_hidden_layers=64, num_attention_heads=64,
+                  num_key_value_heads=8, head_dim=128, **overrides)
+
+
+def qwen3_30b_a3b(**overrides) -> ModelConfig:
+    """Qwen3-30B-A3B MoE: 128 experts, top-8, ~3B active params
+    (reference Qwen3-MoE EP path, test_ep_moe_inference.py)."""
+    return _build(hidden_size=2048, intermediate_size=0,
+                  num_hidden_layers=48, num_attention_heads=32,
+                  num_key_value_heads=4, head_dim=128,
+                  num_experts=128, num_experts_per_tok=8,
+                  moe_intermediate_size=768, **overrides)
+
+
+def _build(**kw) -> ModelConfig:
+    base = dict(vocab_size=151936, max_position_embeddings=40960,
+                rope_theta=1_000_000.0, dtype=jnp.bfloat16)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def param_count(cfg: ModelConfig) -> int:
+    """Approximate parameter count — delegates to the shared
+    ``ModelConfig.param_split`` accounting (also used by
+    ``parallel.plan_parallelism``)."""
+    attn, mlp, embed = cfg.param_split()
+    return (attn + mlp) * cfg.num_hidden_layers + embed
+
+
+PRESETS = {
+    "qwen3-0.6b": qwen3_0_6b,
+    "qwen3-8b": qwen3_8b,
+    "qwen3-32b": qwen3_32b,
+    "qwen3-30b-a3b": qwen3_30b_a3b,
+}

@@ -92,22 +92,15 @@ def plan_parallelism(config, n_chips: int, max_seq: int = 4096,
         reasons.append(f"ep={ep}: {c.num_experts} experts spread first "
                        "(EP moves routed tokens only)")
 
-    # Parameter bytes per chip under tp (dense part + experts under ep).
-    h = c.hidden_size
+    # Parameter bytes per chip under tp (dense part + experts under
+    # ep). Shared accounting with models.presets.param_count — one
+    # counter, two consumers (review r5f-1; this path previously
+    # overcounted tied embeddings by 2x). bf16 = 2 bytes.
     inter = getattr(c, "intermediate_size", 0) or getattr(
         c, "moe_intermediate_size", 0)
-    n_layers = c.num_hidden_layers
-    # qkv projections + the output projection w_o (advisor r3: omitting
-    # w_o undercounted attention params by up to ~25%).
-    head_bytes = 2 * h * (2 * c.num_attention_heads
-                          + 2 * c.num_key_value_heads) * c.head_dim
-    mlp_bytes = 3 * h * inter * 2
-    if is_moe:
-        mlp_bytes = 3 * h * (c.moe_intermediate_size or inter) * 2 \
-            * c.num_experts
-    per_layer = head_bytes + mlp_bytes / max(ep, 1)
-    embed = 2 * 2 * h * c.vocab_size
-    total = per_layer * n_layers + embed
+    attn_p, mlp_p, embed_p = c.param_split()
+    per_layer = 2 * (attn_p + mlp_p / max(ep, 1))
+    total = per_layer * c.num_hidden_layers + 2 * embed_p
 
     # tp must divide BOTH the kv heads and the intermediate (review
     # r3j: a min()-based cap let tp=3 through against 8 kv heads).
@@ -167,17 +160,23 @@ def main():  # pragma: no cover — thin CLI over plan_parallelism
     import json
     from triton_dist_tpu.models import ModelConfig
 
+    from triton_dist_tpu.models import presets
+
     ap = argparse.ArgumentParser(
         description="Recommend (dp, ep, tp, sp) for a model")
-    ap.add_argument("--model-dir", default=None,
-                    help="HF checkpoint dir (reads config.json)")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--model-dir", default=None,
+                     help="HF checkpoint dir (reads config.json)")
+    src.add_argument("--preset", default=None,
+                     choices=sorted(presets.PRESETS),
+                     help="named architecture (models/presets.py)")
     ap.add_argument("--chips", type=int, required=True)
     ap.add_argument("--max-seq", type=int, default=4096)
     ap.add_argument("--decode-batch", type=int, default=8)
     ap.add_argument("--hbm-gib", type=float, default=16.0)
     args = ap.parse_args()
-    cfg = (ModelConfig.from_hf_config(args.model_dir) if args.model_dir
-           else ModelConfig())
+    cfg = (presets.PRESETS[args.preset]() if args.preset
+           else ModelConfig.from_hf_config(args.model_dir))
     p = plan_parallelism(cfg, args.chips, max_seq=args.max_seq,
                          decode_batch=args.decode_batch,
                          hbm_bytes=int(args.hbm_gib * 2 ** 30))
