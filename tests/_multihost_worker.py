@@ -103,8 +103,47 @@ def main() -> None:
     np.testing.assert_allclose(
         ag, np.arange(16, dtype=np.float32).reshape(8, 2))
 
-    # Restore the flat-tp context for the autotune round below.
-    tdist.initialize_distributed()
+    # -- 1c. op-layer entry points on the cross-process mesh: the
+    # context objects + shard_map plumbing of the fused-op API must
+    # work when the tp axis spans processes (impl="xla" — the
+    # XLA-collective path is what rides DCN; Pallas interpret mode is
+    # single-process by construction).
+    from triton_dist_tpu.ops.allgather_gemm import (
+        create_ag_gemm_context, ag_gemm)
+    from triton_dist_tpu.ops.gemm_reduce_scatter import (
+        create_gemm_rs_context, gemm_rs)
+
+    tdist.initialize_distributed()  # flat 8-way tp across both hosts
+    fmesh = tdist.get_mesh()
+    m, k, nn = 16, 32, 32
+    # Row-graded A (row i is all i) so a misrouted/reordered chunk in
+    # the cross-process gather/scatter produces WRONG values, not a
+    # coincidental pass (review r5g-1): out[i, :] = i * k.
+    a_mat = jnp.broadcast_to(
+        jnp.arange(m, dtype=jnp.float32)[:, None], (m, k))
+
+    def check_shards(arr):
+        expect = np.broadcast_to(
+            (np.arange(arr.shape[0], dtype=np.float32)
+             * float(k))[:, None], arr.shape)
+        assert arr.addressable_shards, "no local shards"
+        for sh in arr.addressable_shards:
+            np.testing.assert_allclose(np.asarray(sh.data),
+                                       expect[sh.index])
+
+    a_g = jax.device_put(a_mat, NamedSharding(fmesh, P("tp")))
+    b_g = jax.device_put(jnp.ones((k, nn), jnp.float32),
+                         NamedSharding(fmesh, P(None, "tp")))
+    ctx_ag = create_ag_gemm_context(fmesh, "tp")
+    check_shards(jax.block_until_ready(
+        ag_gemm(a_g, b_g, ctx_ag, impl="xla")))
+
+    a_r = jax.device_put(a_mat, NamedSharding(fmesh, P(None, "tp")))
+    b_r = jax.device_put(jnp.ones((k, nn), jnp.float32),
+                         NamedSharding(fmesh, P("tp")))
+    ctx_rs = create_gemm_rs_context(fmesh, "tp")
+    check_shards(jax.block_until_ready(
+        gemm_rs(a_r, b_r, ctx_rs, impl="xla")))
 
     # -- 2. one autotune round: both processes must agree on the winner
     # even though their local timings differ.
