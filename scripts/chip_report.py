@@ -48,9 +48,12 @@ def _last_json_line(path: str) -> dict | None:
 
 
 def main() -> None:
+    # No generation timestamp and absolute (not relative) checkpoint
+    # times: the output must be byte-stable when the underlying
+    # evidence is unchanged, so the watcher's after-every-step commit
+    # hook produces commits only when NEW evidence exists.
     print("# Chip evidence report")
-    print(f"\nGenerated {time.strftime('%Y-%m-%d %H:%M:%S')} from "
-          f"`{ROOT}` (host-side files only).\n")
+    print(f"\nAssembled from `{ROOT}` (host-side files only).\n")
 
     print("## Bench captures (hw_*.out streamed JSON)\n")
     rows = []
@@ -73,7 +76,7 @@ def main() -> None:
         print("(none found)")
 
     print("\n## Checkpoints (.bench_progress*.json)\n")
-    print("| file | age | device | measured keys | last part |")
+    print("| file | written | device | measured keys | last part |")
     print("|---|---|---|---|---|")
     for path in sorted(glob.glob(os.path.join(ROOT, ".bench_progress*.json"))):
         try:
@@ -82,9 +85,10 @@ def main() -> None:
         except (OSError, ValueError):
             continue
         e = d.get("extras", {})
-        age_s = int(time.time() - float(d.get("ts", 0)))
-        print(f"| {os.path.basename(path)} | {age_s // 3600}h"
-              f"{(age_s % 3600) // 60:02d}m | {e.get('device_kind', '?')} | "
+        ts = time.strftime("%Y-%m-%d %H:%M:%S",
+                           time.localtime(float(d.get("ts", 0))))
+        print(f"| {os.path.basename(path)} | {ts} | "
+              f"{e.get('device_kind', '?')} | "
               f"{len(_measured(e))} | {d.get('last_done', '?')} |")
 
     print("\n## Smoke logs (tpu_smoke_r5*.log)\n")

@@ -1,0 +1,63 @@
+"""Commit whatever chip evidence exists right now (host-side only).
+
+Called by scripts/hw_watch.py after EVERY completed queue step (and
+once more when the queue drains), so evidence is committed
+incrementally — a tunnel window that opens and closes while nobody is
+watching still leaves committed results even if a later step wedges
+the tunnel again. Never touches the tunnel itself.
+
+Committed set: the rendered CHIP_EVIDENCE_r5.md (best-effort — a
+renderer failure must not block the raw data), every tpu_smoke_r5*.log
+and hw_*.out capture, and the .bench_progress_watcher*.json
+checkpoints (the durable bench evidence; gitignored by pattern, hence
+``git add -f``).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    paths = []
+    report = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "chip_report.py")],
+        capture_output=True, text=True, cwd=ROOT)
+    if report.returncode == 0:
+        out_path = os.path.join(ROOT, "CHIP_EVIDENCE_r5.md")
+        with open(out_path, "w") as f:
+            f.write(report.stdout)
+        paths.append(out_path)
+    else:
+        # The raw captures below still get committed.
+        print("chip_report failed:", report.stderr[-500:], file=sys.stderr)
+
+    for name in sorted(os.listdir(ROOT)):
+        if (name.startswith(("tpu_smoke_r5", "hw_")) and
+                name.endswith((".log", ".out")) and
+                name not in ("hw_watch.out", "hw_watch.log")):
+            paths.append(os.path.join(ROOT, name))
+    paths.extend(sorted(glob.glob(
+        os.path.join(ROOT, ".bench_progress_watcher*.json"))))
+
+    subprocess.run(["git", "add", "-f", *paths], cwd=ROOT, check=True)
+    r = subprocess.run(
+        ["git", "commit", "-m",
+         "Hardware evidence: watcher step output (auto-committed)\n\n"
+         "No-Verification-Needed: evidence logs only"],
+        cwd=ROOT, capture_output=True, text=True)
+    out = (r.stdout or "") + (r.stderr or "")
+    print(out.strip())
+    if r.returncode != 0 and "nothing to commit" not in out \
+            and "no changes added to commit" not in out \
+            and "nothing added to commit" not in out:
+        sys.exit(1)  # real failure (hooks, identity, lock) — surface it
+
+
+if __name__ == "__main__":
+    main()

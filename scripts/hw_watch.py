@@ -78,6 +78,25 @@ QUEUE = [
 ]
 
 
+def commit_evidence() -> None:
+    """Commit the evidence produced so far (host-side only — no tunnel
+    contact, no probe gate). Runs after EVERY completed step so an
+    unattended window leaves committed results even if a later step
+    wedges the tunnel again (review r5h-1/2: a tail-of-queue commit
+    step never runs in exactly that scenario, and retries appended
+    behind it would produce evidence after the only commit)."""
+    try:
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(ROOT, "scripts", "hw_evidence_commit.py")],
+            capture_output=True, text=True, timeout=300.0, cwd=ROOT)
+        tail = (r.stdout or r.stderr or "").strip().splitlines()
+        log(f"evidence commit rc={r.returncode}"
+            + (f" ({tail[-1][:100]})" if tail else ""))
+    except (subprocess.TimeoutExpired, OSError) as e:
+        log(f"evidence commit failed: {e!r}")
+
+
 def log(msg: str) -> None:
     line = f"{time.strftime('%H:%M:%S')} {msg}"
     print(line, flush=True)
@@ -134,6 +153,7 @@ def main() -> None:
         name, argv, deadline, env_extra = queue[i]
         status = run_step(name, argv, deadline, env_extra)
         i += 1
+        commit_evidence()
         if status == "abandoned":
             # The abandoned child may still own the (single) TPU client
             # slot — do NOT race it. But a later probe SUCCEEDING means
@@ -150,6 +170,7 @@ def main() -> None:
                 log(f"step {name}: re-queued once at the back")
             time.sleep(300.0)
     log("queue drained; watcher exiting")
+    commit_evidence()
     with open(os.path.join(ROOT, ".hw_watch_done"), "w") as f:
         f.write(time.strftime("%Y-%m-%d %H:%M:%S") + "\n")
 
