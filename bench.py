@@ -156,7 +156,13 @@ _PART_ORDER = ("ag_gemm", "gemm_rs", "gemm_ar", "flash_decode", "tp_mlp",
                "layer_8b", "layer_32b", "overlap", "moe_ag_gg", "mega",
                "sp_attn", "train")
 
-_PART_DEADLINE_S = {"train": 480.0, "mega": 480.0}
+#: Sweep-heavy parts get longer deadlines: ag_gemm/gemm_rs autotune
+#: 6-8 candidates at ~25 s Mosaic compile each on a COLD cache (the
+#: r5 headline-first queue hits exactly that), and a legitimate sweep
+#: must not be mistaken for a wedge and stop the run.
+_PART_DEADLINE_S = {"train": 480.0, "mega": 480.0, "ag_gemm": 600.0,
+                    "gemm_rs": 600.0, "tp_mlp": 480.0,
+                    "flash_decode": 480.0}
 _PART_DEADLINE_DEFAULT_S = 360.0
 
 

@@ -33,13 +33,17 @@ QUEUE = [
     #
     # Position 1: the contract metrics alone — ag_gemm, gemm_rs,
     # gemm_ar, flash_decode, tp_mlp at the 2048x4096x4096 class.
-    # ~10 min warm, <=20 min cold. Dedicated checkpoint file so a
-    # later wedged run can never erase it (bench.py's probe-failure
-    # fallback scans all checkpoint paths; newest WITH measured
-    # metrics wins, so an empty init checkpoint can't mask this).
+    # ~10 min warm; up to ~32 min cold (the ag_gemm/gemm_rs autotune
+    # sweeps are 7 Mosaic compiles each — budget sized so a cold sweep
+    # is never mistaken for a wedge; on a shorter window the completed
+    # parts still checkpoint incrementally). Dedicated checkpoint file
+    # so a later wedged run can never erase it (bench.py's
+    # probe-failure fallback scans all checkpoint paths; newest WITH
+    # measured metrics wins, so an empty init checkpoint can't mask
+    # this).
     ("bench_headline",
-     [sys.executable, "bench.py"], 1500.0,
-     {"TDT_BENCH_BUDGET_S": "1300",
+     [sys.executable, "bench.py"], 2100.0,
+     {"TDT_BENCH_BUDGET_S": "1900",
       "TDT_BENCH_PARTS": "ag_gemm,gemm_rs,gemm_ar,flash_decode,tp_mlp",
       "TDT_BENCH_PROGRESS":
           os.path.join(ROOT, ".bench_progress_watcher_headline.json")}),
