@@ -1,0 +1,70 @@
+"""Tutorial 12: model presets and the parallelism planner.
+
+The reference's benchmark menu (Qwen3-8B/32B, Qwen3-MoE — every
+published number in e2e_dense.md / mega_triton_kernel.md) as named
+configs, fed through `tdt-plan`'s engine to pick a mesh, then built
+via AutoLLM at a scaled-down size and run for one decode step.
+
+Run:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/12_model_presets.py
+"""
+
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+import jax
+
+if not os.environ.get("TDT_EXAMPLES_ON_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from triton_dist_tpu.models import AutoLLM, presets  # noqa: E402
+from triton_dist_tpu.models.kv_cache import KVCacheManager  # noqa: E402
+from triton_dist_tpu.parallel.plan import plan_parallelism  # noqa: E402
+from triton_dist_tpu.runtime.dist import initialize_distributed  # noqa: E402
+
+
+def main():
+    # 1. The menu, with parameter counts from the shared accounting.
+    print("presets:")
+    for name, fn in presets.PRESETS.items():
+        cfg = fn()
+        kind = f"moe({cfg.num_experts}x top{cfg.num_experts_per_tok})" \
+            if cfg.is_moe else "dense"
+        print(f"  {name:14s} {presets.param_count(cfg) / 1e9:6.2f}B {kind}")
+
+    # 2. Plan a mesh for each on 8 chips (v5p-class HBM).
+    for name in ("qwen3-8b", "qwen3-32b", "qwen3-30b-a3b"):
+        p = plan_parallelism(presets.PRESETS[name](), n_chips=8)
+        mesh = {n: getattr(p, n) for n in p.axis_names}
+        print(f"plan[{name} @8]: mesh={mesh} decode={p.decode_mode}"
+              f" moe={p.moe_parallel}")
+
+    # 3. Build a width/depth-scaled 30B-A3B through AutoLLM and decode
+    #    one step on the 8-device mesh (full size needs a pod).
+    ctx = initialize_distributed()
+    cfg = dataclasses.replace(
+        presets.qwen3_30b_a3b(), hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=8, num_key_value_heads=8, head_dim=8,
+        moe_intermediate_size=32, num_experts=8, num_experts_per_tok=2,
+        vocab_size=128, max_position_embeddings=32, dtype=jnp.float32)
+    model = AutoLLM.build(cfg, mesh=ctx.mesh, axis="tp", impl="xla")
+    params = model.init(jax.random.PRNGKey(0))
+    kv = KVCacheManager(cfg.num_hidden_layers, 1, 16,
+                        cfg.num_key_value_heads, cfg.head_dim,
+                        mesh=ctx.mesh, axis="tp", dtype=cfg.dtype)
+    logits, _ = model.forward(params, jnp.ones((1, 4), jnp.int32),
+                              kv.init(), 0, mode="xla_ar")
+    print(f"scaled {type(model).__name__} decode ok: logits {logits.shape}")
+
+
+if __name__ == "__main__":
+    main()
