@@ -1,0 +1,149 @@
+"""Tests for the load-bearing hardware-window machinery: bench.py's
+part orchestrator (abandon-don't-kill, stop-after-timeout, reason
+labeling) and scripts/hw_watch.py's queue logic (retry-once,
+evidence-commit cadence). These paths decide whether a rare tunnel
+window yields evidence; they must not be exercised for the first time
+ON the window."""
+
+import importlib.util
+import json
+import pathlib
+
+_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- bench orchestrator ------------------------------------------------------
+
+def _run_children(monkeypatch, tmp_path, parts, deadlines, child_behavior):
+    """Drive _run_parts_in_children with a stubbed child process."""
+    # bench.py's import-time env defaults (compile cache dir, traceback
+    # filtering) must not leak past this test (review r5j-3).
+    for key in ("JAX_COMPILATION_CACHE_DIR", "JAX_TRACEBACK_FILTERING",
+                "TDT_AUTOTUNE_CACHE"):
+        monkeypatch.setenv(key, __import__("os").environ.get(key) or "")
+    bench = _load("bench_t", _ROOT / "bench.py")
+    monkeypatch.setenv("TDT_BENCH_PARTS", ",".join(parts))
+    monkeypatch.setenv("TDT_BENCH_PROGRESS", str(tmp_path / "p.json"))
+    monkeypatch.setattr(bench, "_PART_DEADLINE_S", deadlines)
+    monkeypatch.setattr(bench, "_PART_DEADLINE_DEFAULT_S", 0.5)
+    # Generous wall budget so only per-part deadlines matter.
+    monkeypatch.setenv("TDT_BENCH_BUDGET_S", "600")
+    bench._T0 = __import__("time").monotonic()
+
+    class FakeChild:
+        def __init__(self, name, tmp_progress):
+            self.behavior = child_behavior(name)
+            self.returncode = None
+            if self.behavior == "ok":
+                # A real child checkpoints metrics; emulate that.
+                with open(tmp_progress, "w") as f:
+                    json.dump({"ts": 1.0, "extras":
+                               {f"{name}_pallas_ms": 1.0}}, f)
+
+        def poll(self):
+            if self.behavior == "ok":
+                self.returncode = 0
+                return 0
+            return None  # hung forever
+
+    import subprocess as sp
+
+    def fake_popen(argv, env=None, **kw):
+        name = env["TDT_BENCH_ONLY"]
+        return FakeChild(name, env["TDT_BENCH_PROGRESS"])
+    # bench imports subprocess inside the function, so patching the
+    # global module object covers it; monkeypatch undoes on teardown.
+    monkeypatch.setattr(sp, "Popen", fake_popen)
+    extras = {}
+    bench._run_parts_in_children(extras)
+    return extras
+
+
+def test_orchestrator_abandons_and_stops_with_reason(monkeypatch, tmp_path):
+    """A part that blows its deadline is ABANDONED (never killed), the
+    run stops there, and the reason says possible_wedge — while
+    already-completed parts keep their metrics."""
+    extras = _run_children(
+        monkeypatch, tmp_path,
+        parts=["ag_gemm", "gemm_rs", "gemm_ar"],
+        deadlines={"gemm_rs": 0.5},
+        child_behavior=lambda n: "ok" if n == "ag_gemm" else "hang")
+    assert "ag_gemm_pallas_ms" in extras            # completed part kept
+    assert extras["gemm_rs_timeout_s"] == 0         # round(0.5)
+    assert extras["aborted_after"] == "gemm_rs"
+    assert extras["aborted_reason"] == "possible_wedge"
+    assert "gemm_ar_pallas_ms" not in extras        # never spawned
+
+
+def test_orchestrator_completes_all_when_children_finish(monkeypatch,
+                                                         tmp_path):
+    extras = _run_children(
+        monkeypatch, tmp_path,
+        parts=["ag_gemm", "gemm_rs"],
+        deadlines={},
+        child_behavior=lambda n: "ok")
+    assert "ag_gemm_pallas_ms" in extras and "gemm_rs_pallas_ms" in extras
+    assert "aborted_after" not in extras
+
+
+# -- watcher queue -----------------------------------------------------------
+
+def _load_watch():
+    return _load("hw_watch_t", _ROOT / "scripts" / "hw_watch.py")
+
+
+def test_watcher_retries_abandoned_step_once(monkeypatch, tmp_path):
+    """An abandoned step is re-queued exactly once at the back; the
+    queue still drains; evidence is committed after every step."""
+    w = _load_watch()
+    monkeypatch.setattr(w, "LOG", str(tmp_path / "log"))
+    events = []
+    monkeypatch.setattr(w, "probe", lambda *a, **k: True)
+    monkeypatch.setattr(w, "commit_evidence",
+                        lambda: events.append("commit"))
+    monkeypatch.setattr(w.time, "sleep", lambda s: None)
+
+    fail_once = {"s2": 1}
+
+    def fake_run_step(name, argv, deadline, env):
+        events.append(name)
+        if fail_once.get(name, 0):
+            fail_once[name] -= 1
+            return "abandoned"
+        return "done"
+    monkeypatch.setattr(w, "run_step", fake_run_step)
+    monkeypatch.setattr(
+        w, "QUEUE", [("s1", [], 1.0, {}), ("s2", [], 1.0, {}),
+                     ("s3", [], 1.0, {})])
+    monkeypatch.setattr(w, "ROOT", str(tmp_path))
+    w.main()
+    steps = [e for e in events if e != "commit"]
+    assert steps == ["s1", "s2", "s3", "s2"]        # retried once, at back
+    # evidence committed after every step + once at drain
+    assert events.count("commit") == len(steps) + 1
+
+
+def test_watcher_waits_out_wedge_between_steps(monkeypatch, tmp_path):
+    """A wedged probe never consumes a queue step."""
+    w = _load_watch()
+    monkeypatch.setattr(w, "LOG", str(tmp_path / "log"))
+    probes = iter([False, False, True, True])
+    monkeypatch.setattr(w, "probe", lambda *a, **k: next(probes))
+    sleeps = []
+    monkeypatch.setattr(w.time, "sleep", lambda s: sleeps.append(s))
+    ran = []
+    monkeypatch.setattr(w, "run_step",
+                        lambda n, *a: (ran.append(n), "done")[1])
+    monkeypatch.setattr(w, "commit_evidence", lambda: None)
+    monkeypatch.setattr(w, "QUEUE", [("a", [], 1.0, {}), ("b", [], 1.0, {})])
+    monkeypatch.setattr(w, "ROOT", str(tmp_path))
+    w.main()
+    assert ran == ["a", "b"]
+    assert sleeps.count(300.0) == 2                 # two wedged probes
