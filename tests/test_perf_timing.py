@@ -15,6 +15,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from triton_dist_tpu.runtime.utils import perf_func_chained
@@ -61,6 +62,110 @@ def test_window_escalation_reaches_signal_floor():
     assert 0.0 < ms < 5.0
 
 
+def test_chain_tie_is_exactly_zero_even_for_inf_nan_carry():
+    """The tie term must be EXACTLY zero whatever the previous output
+    held — 0*inf = nan would otherwise poison every later iteration of
+    the sweep."""
+    from triton_dist_tpu.runtime.utils import _chain_tie
+
+    x = jnp.concatenate([jnp.arange(10, dtype=jnp.bfloat16),
+                         jnp.asarray([-0.0, jnp.inf], jnp.bfloat16)]
+                        ).reshape(3, 4)
+    for bad in (jnp.float32(jnp.inf), jnp.float32(jnp.nan),
+                jnp.float32(-jnp.inf), jnp.bfloat16(3.5)):
+        tied = _chain_tie((x, jnp.arange(3)), bad)
+        got, want = np.asarray(tied[0]), np.asarray(x)
+        # bitwise equality, so -0.0 vs +0.0 is caught
+        assert (got.view(np.uint16) == want.view(np.uint16)).all(), bad
+        assert tied[1].dtype == jnp.int32  # non-float leaves untouched
+
+
+def test_perturbed_runner_single_readback_per_window(monkeypatch):
+    """On a tunneled device, a chained runner must cost ONE readback per
+    timing window, not one per iteration — per-read roundtrip jitter is
+    what made the round-5 on-chip autotune sweep rank a 0.89 ms ag_gemm
+    config above the 0.52 ms default."""
+    from triton_dist_tpu.runtime import utils
+
+    reads = [0]
+    real_mat = utils._materialize_small
+
+    def counting_mat(tree):
+        reads[0] += 1
+        real_mat(tree)
+
+    monkeypatch.setattr(utils, "_tunneled_device", lambda: True)
+    monkeypatch.setattr(utils, "_materialize_small", counting_mat)
+
+    calls = [0]
+    x = jnp.ones((16, 16), jnp.float32)
+
+    @jax.jit
+    def op(v):
+        return v * 2.0
+
+    def fn(v):
+        calls[0] += 1
+        return op(v)
+
+    runner = utils.make_perturbed_runner(fn, x)
+    assert runner.chained
+    _, ms = utils.perf_func(runner, iters=4, warmup_iters=1,
+                            return_output=False)
+    assert ms > 0.0
+    # warmup read (1) + one read per run() window; every fn call would
+    # have been read under the old per-iteration behavior. Worst case:
+    # 5 escalation stages x (5 slope samples x 2 runs) reads.
+    assert calls[0] > reads[0], (calls[0], reads[0])
+    assert reads[0] <= 1 + 10 * 5, reads[0]
+
+
+def test_perturbed_runner_downgrades_without_float_leaves(monkeypatch):
+    """Integer-only inputs/outputs cannot form a chain — the runner must
+    NOT advertise chained=True (perf_func would then skip the
+    per-iteration readbacks that force lazy-tunnel execution), and
+    perf_func(iters=1) must not divide by zero on the chained path."""
+    from triton_dist_tpu.runtime import utils
+
+    ints = jnp.arange(8)
+    r_int = utils.make_perturbed_runner(lambda v: v + 1, ints)
+    assert not r_int.chained
+
+    # Float input but int output: first call downgrades, before
+    # perf_func (which reads .chained after warmup) consults it.
+    r_mixed = utils.make_perturbed_runner(
+        lambda v: jnp.argsort(v), jnp.ones((8,), jnp.float32))
+    assert r_mixed.chained
+    r_mixed()
+    assert not r_mixed.chained
+
+    # iters=1 on the chained tunnel path: n1 == n2 would divide by zero.
+    monkeypatch.setattr(utils, "_tunneled_device", lambda: True)
+    r = utils.make_perturbed_runner(lambda v: v * 2.0,
+                                    jnp.ones((4,), jnp.float32))
+    _, ms = utils.perf_func(r, iters=1, warmup_iters=1,
+                            return_output=False)
+    assert ms > 0.0
+
+
+def test_perturbed_runner_values_match_unchained(monkeypatch):
+    """Chaining must not change computed values: iteration i's output
+    equals fn(perturb_input(x, i)) bit-for-bit (the tie adds exact
+    zero)."""
+    from triton_dist_tpu.runtime import utils
+
+    x = jnp.linspace(-2.0, 7.0, 64, dtype=jnp.bfloat16).reshape(8, 8)
+
+    def fn(v):
+        return (v @ v).astype(jnp.bfloat16)
+
+    runner = utils.make_perturbed_runner(fn, x)
+    for i in range(1, 4):
+        got = runner()
+        want = fn(utils.perturb_input(x, i))
+        assert (np.asarray(got) == np.asarray(want)).all(), i
+
+
 @pytest.mark.slow
 def test_world1_xla_baseline_pair_agreement():
     """The bench's two world=1 XLA baselines are the same matmul behind
@@ -71,7 +176,6 @@ def test_world1_xla_baseline_pair_agreement():
     import importlib.util
     import pathlib
 
-    import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from triton_dist_tpu.ops.allgather_gemm import (

@@ -55,6 +55,31 @@ def _materialize_small(tree) -> None:
             return
 
 
+def _escalating_median_slope(run, n1: int, n2: int, *, n1_cap: int,
+                             n2_cap: int, samples: int = 5,
+                             floor_ms: float = 12.0) -> float:
+    """Median of repeated ``(run(n2) - run(n1)) / (n2 - n1)`` slopes,
+    escalating the window x4 until the raw delta carries at least
+    ``floor_ms`` of signal. The shared tunnel-timing estimator behind
+    perf_func_chained and the chained-runner path of perf_func: the
+    fixed readback roundtrip cancels in the slope, and the floor keeps
+    per-read jitter (several ms) from dominating sub-0.1 ms steps (a
+    4 ms floor once let a selfcheck imply 264 TFLOPS on a 197-TFLOPS
+    chip)."""
+    while True:
+        slopes = []
+        for _ in range(samples):
+            t1 = run(n1)
+            t2 = run(n2)
+            slopes.append(max(t2 - t1, 1e-9) / (n2 - n1) * 1e3)
+        med = float(np.median(slopes))
+        if med * (n2 - n1) >= floor_ms or n2 >= n2_cap:
+            # Below-noise steps return the cap-length median; callers'
+            # plausibility gates (timing_selfcheck) are the backstop.
+            return med
+        n1, n2 = min(n1 * 4, n1_cap), min(n2 * 4, n2_cap)
+
+
 def perf_func(
     func: Callable,
     iters: int = 50,
@@ -78,24 +103,40 @@ def perf_func(
 
     if _tunneled_device():
         _materialize_small(out)
+        chained = bool(getattr(func, "chained", False))
 
         def run(n: int) -> float:
             nonlocal out
             t0 = time.perf_counter()
             for _ in range(n):
                 out = func()
-                # The tunnel executes lazily and dedupes unread results:
-                # every iteration must be read or the slope measures
-                # dispatch overhead only. The per-read roundtrip does NOT
-                # cancel, so this is an upper bound — prefer
-                # perf_func_chained for absolute numbers; the constant
-                # overhead still preserves config *ranking* (autotuner).
+                # The tunnel executes lazily and dedupes unread results.
+                # An UNCHAINED func must read every iteration or the
+                # slope measures dispatch overhead only — and that
+                # per-read roundtrip does NOT cancel, so its jitter
+                # (several ms per read, times n reads) swamps sub-ms
+                # kernels: the round-5 on-chip sweep ranked a 0.89 ms
+                # ag_gemm config above the 0.52 ms default this way. A
+                # runner from make_perturbed_runner chains each call on
+                # the previous output, so ONE read forces the whole
+                # window and the fixed cost cancels in the slope.
+                if not chained:
+                    _materialize_small(out)
+            if chained:
                 _materialize_small(out)
             return time.perf_counter() - t0
 
-        t1 = run(iters)
-        t2 = run(2 * iters)
-        avg_ms = max(t2 - t1, 1e-9) / iters * 1e3
+        if chained:
+            # Same estimator as perf_func_chained's tunnel path (shared
+            # helper); smaller caps because every chained-runner
+            # iteration also pays the eager perturb+tie dispatches.
+            n1 = max(iters // 2, 1)
+            avg_ms = _escalating_median_slope(
+                run, n1, max(iters, n1 + 1), n1_cap=128, n2_cap=512)
+        else:
+            t1 = run(iters)
+            t2 = run(2 * iters)
+            avg_ms = max(t2 - t1, 1e-9) / iters * 1e3
     else:
         t0 = time.perf_counter()
         for _ in range(iters):
@@ -153,26 +194,13 @@ def perf_func_chained(step: Callable, x0, iters: tuple[int, int] = (20, 60)):
 
     n1, n2 = iters
     if _tunneled_device():
-        # Median of repeated slopes: the fixed readback cost jitters by
-        # several ms, so one slope sample is not enough. For sub-0.1ms
-        # steps the requested iters may put the whole t2-t1 delta below
-        # that jitter (gemm_ar's decode GEMM measured "0.0 ms" XLA
-        # baseline this way) — escalate the chain length until the raw
-        # delta carries at least ~12 ms of signal (readback jitter is
-        # several ms; a 4 ms floor still let a selfcheck imply 264
-        # TFLOPS on a 197-TFLOPS chip), then take a 5-sample median.
-        while True:  # bounded: n2 quadruples until the 2000-step cap
-            slopes = []
-            for _ in range(5):
-                t1 = run(n1)
-                t2 = run(n2)
-                slopes.append(max(t2 - t1, 1e-9) / (n2 - n1) * 1e3)
-            med = float(np.median(slopes))
-            if med * (n2 - n1) >= 12.0 or n2 >= 2000:
-                # Below-noise steps return the cap-length median; the
-                # bench-level timing_selfcheck is the plausibility gate.
-                return med
-            n1, n2 = min(n1 * 4, 500), min(n2 * 4, 2000)
+        # Median of repeated slopes via the shared estimator: the fixed
+        # readback cost jitters by several ms, so one slope sample is
+        # not enough, and sub-0.1ms steps need their chain escalated
+        # (gemm_ar's decode GEMM once measured a "0.0 ms" XLA baseline
+        # from a too-short delta).
+        return _escalating_median_slope(run, n1, n2,
+                                        n1_cap=500, n2_cap=2000)
     # Non-tunneled backends: min of 5 chained windows, escalating the
     # chain until one window carries >= ~20 ms of signal. A SINGLE
     # sub-ms window (the pre-r5 behavior) on a loaded 1-core host
@@ -196,17 +224,76 @@ def perf_func_chained(step: Callable, x0, iters: tuple[int, int] = (20, 60)):
     return min(samples) * 1e3
 
 
+def _chain_tie(tree, carry):
+    """Scale the first floating leaf of ``tree`` by a one-valued factor
+    derived from ``carry`` (a scalar from the previous call's output).
+    The values are bitwise unchanged — ``x * 1.0`` is exact for every
+    input including -0.0/inf/nan, and ``nan_to_num`` keeps the factor
+    exactly one even for inf/nan carries — but the runtime now sees a
+    data dependency on the previous output, so a lazy tunneled backend
+    must execute every link of the chain to serve the final read."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = []
+    tied = False
+    for leaf in leaves:
+        if (not tied and isinstance(leaf, jax.Array)
+                and jnp.issubdtype(leaf.dtype, jnp.floating)):
+            one = 1.0 + jnp.nan_to_num(carry.astype(jnp.float32)) * 0.0
+            leaf = leaf * one.astype(leaf.dtype)
+            tied = True
+        out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _carry_scalar(tree):
+    """First element of the first floating leaf of ``tree`` (a device
+    scalar, NOT read back), or None when there is no floating leaf."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array) and jnp.issubdtype(
+                leaf.dtype, jnp.floating):
+            return jnp.ravel(leaf)[0]
+    return None
+
+
 def make_perturbed_runner(fn, x, *rest):
     """Closure that calls ``fn(perturb_input(x, i), *rest)`` with a fresh
-    counter per call and blocks on the result — the shared shape of every
-    autotune/bench run loop on the tunneled device (which dedupes
-    repeated identical computations)."""
+    counter per call — the shared shape of every autotune/bench run loop
+    on the tunneled device (which dedupes repeated identical
+    computations). Consecutive calls are CHAINED: each input carries a
+    zero-valued tie to the previous output (:func:`_chain_tie`), so a
+    timing loop needs only one readback per window instead of one per
+    iteration — per-read roundtrip jitter over the tunnel is what made
+    the round-5 on-chip autotune sweeps rank configs by noise. The
+    ``chained`` attribute tells :func:`perf_func` to use the
+    single-readback slope estimator."""
     counter = [0]
+    carry = [None]
 
     def run():
         counter[0] += 1
-        return jax.block_until_ready(fn(perturb_input(x, counter[0]),
-                                        *rest))
+        xi = perturb_input(x, counter[0])
+        if carry[0] is not None:
+            xi = _chain_tie(xi, carry[0])
+        out = fn(xi, *rest)
+        c = _carry_scalar(out)
+        if c is not None:
+            carry[0] = c
+        elif run.chained:
+            # No floating leaf in the output to tie through: the chain
+            # cannot form, and advertising one would let perf_func skip
+            # the per-iteration readbacks that force execution — the
+            # silent version of the exact bug this runner exists to fix.
+            # perf_func reads .chained after warmup, so a first-call
+            # downgrade here is always seen.
+            run.chained = False
+        return out
+
+    # A tie needs a floating leaf on the input side too (dtype check
+    # only — no device op at construction).
+    run.chained = any(
+        isinstance(leaf, jax.Array) and jnp.issubdtype(leaf.dtype,
+                                                       jnp.floating)
+        for leaf in jax.tree_util.tree_leaves(x))
     return run
 
 
