@@ -241,6 +241,80 @@ def test_gemm_rs_configs_table():
     assert small[0]["variant"] == "vmem"
 
 
+def test_aggressive_blocks_reach_kernel_unclamped(mesh8, key, monkeypatch):
+    """Blocks with a footprint between the soft vmem_budget and
+    HARD_FOOTPRINT_CAP must be HONORED — this is how the config table's
+    aggressive tier reaches Mosaic at all (review r5i finding 1: a
+    soft-budget clamp silently rewrote every swept aggressive config
+    back to the budget kernel, so the tier benchmarked duplicates).
+    Blocks beyond the hard cap must still be clamped to an in-budget
+    config (BENCH_r02: an uncompilable config never reaches the
+    compiler). Budgets are shrunk so 'aggressive' stays tiny in
+    interpret mode."""
+    import triton_dist_tpu.ops.allgather_gemm as agm
+
+    seen = []
+    seen_kt = []
+    orig = agm._ag_gemm_hbm_nb_kernel
+    orig_kt = agm._ag_gemm_hbm_kernel
+
+    def spy(*a, **kw):
+        seen.append((kw["m_blk"], kw["n_blk"]))
+        return orig(*a, **kw)
+
+    def spy_kt(*a, **kw):
+        seen_kt.append((kw["m_blk"], kw["k_blk"]))
+        return orig_kt(*a, **kw)
+
+    monkeypatch.setattr(agm, "_ag_gemm_hbm_nb_kernel", spy)
+    monkeypatch.setattr(agm, "_ag_gemm_hbm_kernel", spy_kt)
+
+    m, k, n = 64, 32, 256
+    a = (jax.random.normal(key, (m, k)) / 4).astype(jnp.float32)
+    b = (jax.random.normal(jax.random.PRNGKey(1), (k, n)) / 4
+         ).astype(jnp.float32)
+    a_s = jax.device_put(a, NamedSharding(mesh8, P("tp")))
+    b_s = jax.device_put(b, NamedSharding(mesh8, P(None, "tp")))
+    golden = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
+
+    # rows=8, n_loc=32, fp(8, 32) = 4*(2*8*32 + 2*32*32 + 2*8*32) = 12 KB
+    ctx = create_ag_gemm_context(mesh8)
+    ctx.variant = "hbm"
+    ctx.block_m, ctx.block_n = 8, 32
+    ctx.vmem_budget = 8 * 1024          # over-budget...
+    assert agm._hbm_footprint(8, 32, k, 4) > ctx.vmem_budget
+
+    # Without trust_blocks (default path), the soft-budget clamp holds:
+    # no in-budget hbm config exists, so the entry degrades to hbm_kt.
+    out = agm.ag_gemm(a_s, b_s, ctx, impl="pallas")
+    np.testing.assert_allclose(np.asarray(out), golden, rtol=1e-3,
+                               atol=1e-3)
+    assert not seen and seen_kt, "default path honored over-budget blocks"
+
+    # With trust_blocks (how the sweep and tuned winners run), blocks up
+    # to HARD_FOOTPRINT_CAP are honored.
+    ctx.trust_blocks = True
+    out = agm.ag_gemm(a_s, b_s, ctx, impl="pallas")
+    np.testing.assert_allclose(np.asarray(out), golden, rtol=1e-3,
+                               atol=1e-3)
+    assert seen and seen[-1] == (8, 32), "aggressive blocks were clamped"
+
+    # ...but over the hard cap: no in-budget NB config exists at this
+    # shrunken budget, so the entry degrades to the k-tiled kernel with
+    # SHAPE-CLAMPED blocks (the unclamped 128/256 table fallback used
+    # to reach the kernel with block_k > K here: k_tiles = 0 ->
+    # ZeroDivisionError in the ring schedule).
+    monkeypatch.setattr(agm, "HARD_FOOTPRINT_CAP", 10 * 1024)
+    n_nb = len(seen)
+    out = agm.ag_gemm(a_s, b_s, ctx, impl="pallas")
+    np.testing.assert_allclose(np.asarray(out), golden, rtol=1e-3,
+                               atol=1e-3)
+    assert len(seen) == n_nb, "over-cap blocks still ran the NB kernel"
+    rows = m // 8
+    assert seen_kt and seen_kt[-1][0] <= rows and seen_kt[-1][1] <= k, \
+        seen_kt
+
+
 def test_gemm_ar_infeasible_config_degrades(mesh8, key):
     """When no resident-B-panel config fits the VMEM budget, GEMM-AR must
     degrade to the XLA path rather than fall through to the

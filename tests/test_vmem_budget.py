@@ -1,12 +1,18 @@
 """Bench-shape VMEM-budget checks (VERDICT r2 next 10).
 
-BENCH_r02's crash class — a default config whose VMEM scratch exceeds
-the v5e's 16 MB limit — must fail HERE, in CI on any host, not on the
-chip. ``check_entry_vmem`` traces each op's ``impl="pallas"`` entry at
-the exact bench.py shapes with ``jax.eval_shape`` (no execution) and
-asserts the static footprint of every ``pallas_call`` it contains.
-World=1 (the bench environment) and world=8 are both checked: round 2's
-failure was world=1-specific (n_loc = N, the largest B panel).
+BENCH_r02's crash class — a default config whose declared VMEM scratch
+cannot compile on the chip — must fail HERE, in CI on any host, not on
+the chip. The gate asserts against HARD_FOOTPRINT_CAP (26 MB declared):
+the library's comm kernels request a 64 MB Mosaic scoped-VMEM limit via
+``comm_params`` and Mosaic's scoped accounting carries ~2.2x overhead
+over declared buffers (measured round 5; constants in ops/common.py).
+A kernel built WITHOUT ``comm_params`` keeps Mosaic's 16 MB default and
+needs the tighter ``limit=`` argument. ``check_entry_vmem`` traces each
+op's ``impl="pallas"`` entry at the exact bench.py shapes with
+``jax.eval_shape`` (no execution) and asserts the static footprint of
+every ``pallas_call`` it contains. World=1 (the bench environment) and
+world=8 are both checked: round 2's failure was world=1-specific
+(n_loc = N, the largest B panel).
 """
 
 import jax

@@ -74,6 +74,26 @@ def sync_interpret(out, interpret) -> object:
     return jax.block_until_ready(out)
 
 
+#: Mosaic scoped-VMEM limit requested for every comm kernel. Mosaic's
+#: default cap is 16 MB, but a v5e core has 128 MB of physical VMEM
+#: (public TPU flash kernels run with vmem_limit_bytes up to 128 MB);
+#: the round-5 on-chip compile of the fused SP kernel was rejected at
+#: 16.14 MB scoped for ~7.4 MB of declared scratch. 64 MB absorbs that
+#: overhead for every budget-sized shape while leaving headroom for
+#: XLA's own scoped uses.
+VMEM_LIMIT_BYTES = 64 * 1024 * 1024
+
+#: Ceiling on a kernel's DECLARED scratch footprint. Mosaic's scoped
+#: accounting carries roughly 2.2x of window/staging overhead on top of
+#: the declared buffers (measured round-5: 16.14 MB scoped for ~7.4 MB
+#: declared), so declared footprints up to ~26 MB compile under
+#: :data:`VMEM_LIMIT_BYTES`. Config tables list over-soft-budget
+#: "aggressive tier" entries up to this cap for the autotuner; the
+#: per-op clamps reject anything beyond it so an uncompilable config
+#: never reaches Mosaic (BENCH_r02).
+HARD_FOOTPRINT_CAP = 26 * 1024 * 1024
+
+
 def comm_params(collective_id: int | None = 0,
                 vmem_limit_bytes: int | None = None,
                 world: int | None = None) -> pltpu.CompilerParams:
@@ -83,12 +103,16 @@ def comm_params(collective_id: int | None = 0,
 
     At ``world == 1`` kernels skip ``dl.barrier_all`` so no barrier semaphore
     exists — Mosaic then rejects a ``collective_id`` ("has to be unspecified
-    ... when not using a custom barrier")."""
+    ... when not using a custom barrier").
+
+    ``vmem_limit_bytes`` defaults to :data:`VMEM_LIMIT_BYTES`; pass an
+    explicit value only to tighten it for a specific kernel."""
     kwargs = dict(has_side_effects=True)
     if world != 1 and collective_id is not None:
         kwargs["collective_id"] = collective_id
-    if vmem_limit_bytes is not None:
-        kwargs["vmem_limit_bytes"] = vmem_limit_bytes
+    kwargs["vmem_limit_bytes"] = (VMEM_LIMIT_BYTES
+                                  if vmem_limit_bytes is None
+                                  else vmem_limit_bytes)
     return pltpu.CompilerParams(**kwargs)
 
 

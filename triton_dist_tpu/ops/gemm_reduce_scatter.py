@@ -33,6 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
+    HARD_FOOTPRINT_CAP,
     any_spec,
     comm_params,
     nestable_shard_map,
@@ -70,10 +71,11 @@ def gemm_rs_configs(m: int, rows: int, k_loc: int, n: int, itemsize: int,
     if vmem_fp <= vmem_budget:
         cfgs.append({"variant": "vmem"})
     # N-blocked resident-B kernel (B read once per chunk, full-K dots).
-    for bn in (1024, 512, 256, 128):
+    # Large tiles appear in both tiers — see ag_gemm_configs.
+    for bn in (2048, 1024, 512, 256, 128):
         if bn > n or n % bn:
             continue
-        for bm in (256, 128):
+        for bm in (1024, 512, 256, 128):
             if bm > rows or rows % bm:
                 continue
             if _hbm_nb_footprint(bm, bn, k_loc, itemsize) <= vmem_budget:
@@ -92,20 +94,27 @@ def gemm_rs_configs(m: int, rows: int, k_loc: int, n: int, itemsize: int,
                 cfgs.append({"variant": "hbm_kt", "block_m": bm,
                              "block_k": bk})
     # Aggressive tier — LAST so defaults never pick them; the autotuner
-    # sweeps them under per-config failure isolation (larger m-tiles
-    # halve A re-reads; may compile past the soft budget).
-    hard_cap = 15 * 1024 * 1024
-    for bn in (1024, 512):
+    # sweeps them under per-config failure isolation. Larger tiles cut
+    # A re-reads and amortize MXU issue overhead (round-5 chip: budget
+    # tier ran 159 TFLOPS vs XLA's ~200). Cap sized to the measured
+    # ~2.2x Mosaic scoped-overhead under the kernels' 64 MB
+    # vmem_limit_bytes — see ag_gemm_configs.
+    hard_cap = HARD_FOOTPRINT_CAP
+    for bn in (2048, 1024, 512):
         if bn > n or n % bn:
             continue
-        for bm in (512, 256):
+        for bm in (1024, 512, 256):
             if bm > rows or rows % bm:
                 continue
             fp = _hbm_nb_footprint(bm, bn, k_loc, itemsize)
             if vmem_budget < fp <= hard_cap:
                 cfgs.append({"variant": "hbm", "block_m": bm,
                              "block_n": bn})
-    return cfgs or [{"variant": "hbm_kt", "block_m": 128, "block_k": 256}]
+    # Last resort: shape-CLAMPED k-tiled blocks (see ag_gemm_configs —
+    # an unclamped literal yields k_tiles = 0 on tiny shards).
+    return cfgs or [{"variant": "hbm_kt",
+                     "block_m": _pick_block(rows, 128),
+                     "block_k": _pick_block(k_loc, 256)}]
 
 
 def _autotune_gemm_rs(a, b, ctx, key, all_gather_epilogue):
@@ -129,7 +138,8 @@ def _autotune_gemm_rs(a, b, ctx, key, all_gather_epilogue):
     entry = gemm_ar if all_gather_epilogue else gemm_rs
 
     def make_fn(**cfg):
-        ctx2 = dataclasses.replace(ctx, autotune=False, **cfg)
+        ctx2 = dataclasses.replace(ctx, autotune=False,
+                                   trust_blocks=True, **cfg)
         fn = jax.jit(lambda x, w: entry(x, w, ctx2, impl="pallas"))
         # Unique input per call: the tunneled device dedupes identical
         # computations, which would void the ranking.
@@ -164,6 +174,10 @@ class GEMMReduceScatterContext:
     # (reference ContextualAutoTuner + get_auto_triton_config,
     # moe_reduce_rs.py:553).
     autotune: bool = False
+    # Honor block hints past the soft budget (up to HARD_FOOTPRINT_CAP);
+    # set by the sweep / tuned-winner application — see
+    # AllGatherGEMMContext.trust_blocks.
+    trust_blocks: bool = False
 
     @property
     def world_size(self) -> int:
@@ -597,7 +611,8 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
             tuned = _autotune_gemm_rs(a, b, ctx, tune_key,
                                       all_gather_epilogue)
         if tuned is not None:
-            ctx = dataclasses.replace(ctx, autotune=False, **tuned)
+            ctx = dataclasses.replace(ctx, autotune=False,
+                                      trust_blocks=True, **tuned)
 
     variant = ctx.resolve_variant(m, k_loc, n, a.dtype.itemsize)
     item = a.dtype.itemsize
@@ -608,10 +623,13 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
         # infeasible default must never reach Mosaic (BENCH_r02).
         m_blk = _pick_block(rows, ctx.block_m)
         n_blk = _pick_block(n, ctx.block_n)
-        if _hbm_nb_footprint(m_blk, n_blk, k_loc, item) > ctx.vmem_budget:
-            # Re-filter by footprint: the table's aggressive tier
-            # (over-budget, autotune-only) must never become the
-            # default (code-review r3d finding 3).
+        clamp_at = (HARD_FOOTPRINT_CAP if ctx.trust_blocks
+                    else ctx.vmem_budget)
+        if _hbm_nb_footprint(m_blk, n_blk, k_loc, item) > clamp_at:
+            # Re-filter to a conservative in-budget config. With
+            # trust_blocks (sweep / tuned winner) the ceiling is the
+            # hard COMPILE cap so the aggressive tier reaches Mosaic
+            # (review r5i finding 1); defaults keep the soft budget.
             cand = [c for c in gemm_rs_configs(m, rows, k_loc, n, item,
                                                world, ctx.vmem_budget)
                     if c["variant"] == "hbm"

@@ -442,6 +442,11 @@ def sp_ag_attention_fused(q: jax.Array, k: jax.Array, v: jax.Array,
                 pltpu.SemaphoreType.DMA((world, 2)),
                 pltpu.SemaphoreType.DMA((world, 2)),
             ],
+            # comm_params raises Mosaic's scoped-VMEM limit to
+            # common.VMEM_LIMIT_BYTES: the default 16 MB cap rejected
+            # this kernel's round-5 on-chip compile at 16.14 MB scoped
+            # for ~7.4 MB of declared scratch (see the constants in
+            # ops/common.py for the measured overhead factor).
             compiler_params=comm_params(collective_id=6, world=world),
             interpret=interpret,
         )(qp, ks, vs)
