@@ -35,6 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
+    DEFAULT_VMEM_BUDGET,
     HARD_FOOTPRINT_CAP,
     any_spec,
     comm_params,
@@ -72,8 +73,9 @@ class AllGatherGEMMContext:
     block_k: int = 512
     block_m: int = 256
     block_n: int = 512
-    # VMEM budget for the auto choice (bytes; ~16 MB/core minus slack).
-    vmem_budget: int = 12 * 1024 * 1024
+    # Soft VMEM budget for the auto choice and the default-path block
+    # clamp (bytes) — sizing rationale on the shared constant.
+    vmem_budget: int = DEFAULT_VMEM_BUDGET
     # Honor block hints past the soft budget (up to HARD_FOOTPRINT_CAP).
     # Set by the autotune sweep and tuned-winner application so the
     # config table's aggressive tier reaches Mosaic (review r5i finding
@@ -469,7 +471,7 @@ _TUNED: dict[tuple, dict] = {}
 
 def ag_gemm_configs(m: int, rows: int, k: int, n_tot_loc: int,
                     itemsize: int,
-                    vmem_budget: int = 12 * 1024 * 1024) -> list[dict]:
+                    vmem_budget: int = DEFAULT_VMEM_BUDGET) -> list[dict]:
     """Candidate config table for the fused AG-GEMM (reference
     ``matmul_get_configs`` allgather_gemm.py:396, pruned to shapes that
     fit the hardware constraints). Ordered best-first: every entry point

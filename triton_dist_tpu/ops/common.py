@@ -93,6 +93,16 @@ VMEM_LIMIT_BYTES = 64 * 1024 * 1024
 #: never reaches Mosaic (BENCH_r02).
 HARD_FOOTPRINT_CAP = 26 * 1024 * 1024
 
+#: Soft VMEM budget the fused ops' "auto" tile choice and default-path
+#: clamps target. Sized against :data:`VMEM_LIMIT_BYTES`: 24 MB
+#: declared x the measured ~2.2x scoped overhead ~= 53 MB, under the
+#: 64 MB limit with margin; must stay below
+#: :data:`HARD_FOOTPRINT_CAP`. Was 12 MB while Mosaic's default 16 MB
+#: cap governed — the round-5 chip run showed the small tiles that
+#: budget forced cost ~30% of MXU throughput vs XLA's matmul.
+DEFAULT_VMEM_BUDGET = 24 * 1024 * 1024
+assert DEFAULT_VMEM_BUDGET < HARD_FOOTPRINT_CAP
+
 
 def comm_params(collective_id: int | None = 0,
                 vmem_limit_bytes: int | None = None,

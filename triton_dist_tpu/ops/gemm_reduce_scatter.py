@@ -33,6 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
+    DEFAULT_VMEM_BUDGET,
     HARD_FOOTPRINT_CAP,
     any_spec,
     comm_params,
@@ -61,7 +62,7 @@ def _hbm_nb_footprint(bm: int, bn: int, k_loc: int, itemsize: int) -> int:
 
 def gemm_rs_configs(m: int, rows: int, k_loc: int, n: int, itemsize: int,
                     world: int,
-                    vmem_budget: int = 12 * 1024 * 1024) -> list[dict]:
+                    vmem_budget: int = DEFAULT_VMEM_BUDGET) -> list[dict]:
     """Candidate config table for the fused GEMM-RS, ordered best-first.
     Every entry point (default, autotune) consults this table so an
     infeasible default can never reach the compiler (BENCH_r02)."""
@@ -169,7 +170,9 @@ class GEMMReduceScatterContext:
     block_k: int = 512
     block_m: int = 256
     block_n: int = 512
-    vmem_budget: int = 12 * 1024 * 1024
+    # Soft budget for the auto choice / default clamp — sizing
+    # rationale on the shared constant (ops/common.py).
+    vmem_budget: int = DEFAULT_VMEM_BUDGET
     # Autotune (variant, blocks) on first eager call per shape
     # (reference ContextualAutoTuner + get_auto_triton_config,
     # moe_reduce_rs.py:553).

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
+    DEFAULT_VMEM_BUDGET,
     any_spec,
     comm_params,
     nestable_shard_map,
@@ -280,7 +281,7 @@ class AGGroupGEMMContext:
     # Tile sizes for the fused Pallas kernel (impl="fused").
     block_m: int = 128
     block_n: int = 512
-    vmem_budget: int = 12 * 1024 * 1024
+    vmem_budget: int = DEFAULT_VMEM_BUDGET
 
     @property
     def world_size(self) -> int:

@@ -57,6 +57,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
+    DEFAULT_VMEM_BUDGET,
     any_spec,
     comm_params,
     nestable_shard_map,
@@ -237,7 +238,7 @@ class MoEReduceRSContext:
     # Tile sizes for the fused Pallas kernel (impl="fused").
     block_m: int = 128
     block_h: int = 512
-    vmem_budget: int = 12 * 1024 * 1024
+    vmem_budget: int = DEFAULT_VMEM_BUDGET
 
     @property
     def world_size(self) -> int:
