@@ -25,60 +25,56 @@ LOG = os.path.join(ROOT, "hw_watch.log")
 # step wedges (probe after each step to know).
 
 QUEUE = [
-    # Round-5 evidence queue, PERF-FIRST (VERDICT r4 next-1: "on any
-    # tunnel window >=20 min, BENCH-quality numbers exist before
-    # anything else runs"). Four rounds have produced zero
-    # machine-captured TPU perf because smoke always ran first and the
-    # window closed before the bench's turn.
+    # Round-5 SECOND queue (after first chip contact, 2026-08-01
+    # morning: headline + full bench + train PASS captured; smoke
+    # cases 1-27 PASS; run stopped at the flash_decode/paged compile
+    # hang). Perf-first again; the wedge-risky paged case is LAST.
     #
-    # Position 1: the contract metrics alone — ag_gemm, gemm_rs,
-    # gemm_ar, flash_decode, tp_mlp at the 2048x4096x4096 class.
-    # ~10 min warm; up to ~32 min cold (the ag_gemm/gemm_rs autotune
-    # sweeps are 7 Mosaic compiles each — budget sized so a cold sweep
-    # is never mistaken for a wedge; on a shorter window the completed
-    # parts still checkpoint incrementally). Dedicated checkpoint file
-    # so a later wedged run can never erase it (bench.py's
-    # probe-failure fallback scans all checkpoint paths; newest WITH
-    # measured metrics wins, so an empty init checkpoint can't mask
-    # this).
-    ("bench_headline",
-     [sys.executable, "bench.py"], 2100.0,
-     {"TDT_BENCH_BUDGET_S": "1900",
+    # Position 1: the parts the aborted full bench never reached
+    # (sp_attn, train) plus the mega deep retry — all three now run
+    # under the 64 MB scoped-VMEM limit that fixed the SP kernel's
+    # 16.14 MB-vs-16 MB compile rejection.
+    ("bench_gapfill",
+     [sys.executable, "bench.py"], 2400.0,
+     {"TDT_BENCH_BUDGET_S": "2100",
+      "TDT_BENCH_PARTS": "sp_attn,mega,train",
+      "TDT_BENCH_PROGRESS":
+          os.path.join(ROOT, ".bench_progress_gapfill.json")}),
+    # Position 2: headline re-run with the round-5 kernel changes
+    # (24 MB default tile budget, large-tile sweep space, chained
+    # sweep timing). Sweeps are now ~15 Mosaic compiles per GEMM op
+    # (~8 min each cold) — budget sized for two cold sweeps; winners
+    # disk-cache for the driver's end-of-round run.
+    ("bench_headline2",
+     [sys.executable, "bench.py"], 3300.0,
+     {"TDT_BENCH_BUDGET_S": "3000",
       "TDT_BENCH_PARTS": "ag_gemm,gemm_rs,gemm_ar,flash_decode,tp_mlp",
       "TDT_BENCH_PROGRESS":
-          os.path.join(ROOT, ".bench_progress_watcher_headline.json")}),
-    # Position 2: the fused SP kernel's first-ever on-chip compile
-    # (VERDICT r4 missing-2; three rounds export-lint-only).
-    ("sp_pallas",
-     [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "600",
-      "--only", "=sp_ag_attention/pallas",
-      "--log", "tpu_smoke_r5_sp.log"],
-     900.0, {}),
-    # Position 3: the full 12-part bench (adds layer_8b/layer_32b
-    # real-dim e2e, overlap, mega, moe, sp, train). Headline parts
-    # recompile warm from position 1's cache.
-    ("bench_full",
-     [sys.executable, "bench.py"], 2700.0,
-     {"TDT_BENCH_BUDGET_S": "2400",
-      "TDT_BENCH_PROGRESS":
-          os.path.join(ROOT, ".bench_progress_watcher.json")}),
-    # Position 4: the train-step compile (observed 35 min once cold).
-    ("train_step",
-     [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "900",
-      "--only", "=train/fused_step",
-      "--log", "tpu_smoke_r5_train.log"],
-     1200.0, {}),
-    # Positions 5-6: the smoke bulk, LAST (it is correctness evidence,
-    # not the contract deliverable; ~2 h cold).
-    ("smoke_bulk",
+          os.path.join(ROOT, ".bench_progress_headline2.json")}),
+    # Position 3: smoke cases after the hang point (29-43: serving
+    # shape, SP attention incl. the fixed fused kernel, ep/pp/models,
+    # fp8 a2a, train) — never covered this round.
+    ("smoke_resume",
      [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "420",
-      "--skip", "train/fused_step,sp_ag_attention/pallas",
-      "--log", "tpu_smoke_r5_bulk.log"],
+      "--start-after", "flash_decode/paged",
+      "--log", "tpu_smoke_r5_resume.log"],
      7200.0, {}),
-    ("smoke_full",
+    # Position 4: re-validate cases 1-27 under the round-5 kernel
+    # changes (these passed pre-change; the 24 MB budget alters
+    # default tiles).
+    ("smoke_revalidate",
      [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "420",
-      "--log", "tpu_smoke_r5.log"],
+      "--skip", "flash_decode/paged",
+      "--log", "tpu_smoke_r5_reval.log"],
      7200.0, {}),
+    # Position 5, LAST because it is the known wedge trigger: the
+    # paged-KV compile with a 40-min case budget (r3's train compile
+    # needed 35 min; this may be the same class of slow Mosaic pass).
+    ("smoke_paged",
+     [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "2400",
+      "--only", "=flash_decode/paged",
+      "--log", "tpu_smoke_r5_paged.log"],
+     2700.0, {}),
 ]
 
 
