@@ -868,6 +868,23 @@ def _bench_tp_mlp(mesh, n, on_tpu, extras):
             return (y * scale).astype(jnp.bfloat16)
         return _args_step(f, params)
 
+    def tune_mlp(layer, p, tag):
+        """Sweep the layer's swiglu + gemm_rs kernels eagerly BEFORE
+        timing (winners disk-cache for the driver's run); the timed
+        path then rides the tuned configs through the ctx autotune
+        cache consult."""
+        import dataclasses
+        try:
+            layer.ag_ctx = dataclasses.replace(layer.ag_ctx,
+                                               autotune=True)
+            layer.rs_ctx = dataclasses.replace(layer.rs_ctx,
+                                               autotune=True)
+            jax.block_until_ready(layer(p, x0, mode="ag_rs"))
+        except Exception as e:  # noqa: BLE001
+            extras[f"{tag}_tune_error"] = _err(e)
+
+    if on_tpu:
+        tune_mlp(mlp, params, "tp_mlp")
     t_fused = perf_func_chained(make_step("ag_rs"), x0, iters)
     t_base = perf_func_chained(make_step("xla"), x0, iters)
     extras["tp_mlp_fused_ms"] = round(t_fused, 4)
@@ -881,6 +898,7 @@ def _bench_tp_mlp(mesh, n, on_tpu, extras):
         mlp_big = TPMLP(hidden, 3072 * max(n, 1), mesh=mesh, axis="tp",
                         dtype=jnp.bfloat16)
         params_b = mlp_big.init(jax.random.PRNGKey(2))
+        tune_mlp(mlp_big, params_b, "tp_mlp_big")
 
         def make_step_big(mode):
             def f(x, p):

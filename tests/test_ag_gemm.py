@@ -396,3 +396,30 @@ class TestAgSwiglu:
         gc = jax.grad(composed, argnums=(0, 1, 2))(a, wg, wu)
         for x, y, name in zip(gf, gc, ("da", "dwg", "dwu")):
             assert_allclose(x, y, rtol=2e-3, atol=2e-3)
+
+
+def test_ag_swiglu_autotune_sweep(mesh8, key):
+    """Eager sweep + winner application end-to-end in interpret mode:
+    numerics must match the XLA golden and a winner must be cached."""
+    import dataclasses as dc
+    from triton_dist_tpu.ops import allgather_gemm as agm
+
+    m, k, n = 1024, 128, 2048
+    ka, kg, ku = jax.random.split(key, 3)
+    a = jax.device_put((jax.random.normal(ka, (m, k)) / 4
+                        ).astype(jnp.bfloat16),
+                       NamedSharding(mesh8, P("tp")))
+    wg = jax.device_put((jax.random.normal(kg, (k, n)) / 4
+                         ).astype(jnp.bfloat16),
+                        NamedSharding(mesh8, P(None, "tp")))
+    wu = jax.device_put((jax.random.normal(ku, (k, n)) / 4
+                         ).astype(jnp.bfloat16),
+                        NamedSharding(mesh8, P(None, "tp")))
+    ctx = dc.replace(agm.create_ag_gemm_context(mesh8), autotune=True)
+    got = agm.ag_swiglu(a, wg, wu, ctx, impl="pallas")
+    ref = agm.ag_swiglu(a, wg, wu, dc.replace(ctx, autotune=False),
+                        impl="xla")
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+    assert any(kk[-1] == "swiglu" for kk in agm._TUNED), agm._TUNED

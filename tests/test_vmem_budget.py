@@ -286,3 +286,32 @@ def test_layer_bench_dims_fit(world, dims):
             f, jax.ShapeDtypeStruct((m, h), bf16), pa, pm,
             jax.ShapeDtypeStruct((b, 512, nkv, d), bf16),
             jax.ShapeDtypeStruct((b, 512, nkv, d), bf16))
+
+
+def test_ag_swiglu_configs_table():
+    from triton_dist_tpu.ops.allgather_gemm import (
+        ag_swiglu_configs, _swiglu_footprint)
+    from triton_dist_tpu.ops.common import (DEFAULT_VMEM_BUDGET,
+                                            HARD_FOOTPRINT_CAP)
+    # Bench tp_mlp_big shape class: m=2048, w=1, k=4096, n_loc=3072.
+    cfgs = ag_swiglu_configs(2048, 4096, 3072, 2)
+    assert cfgs, "no swiglu configs at the bench shape"
+    seen = set()
+    budget_tier_ended = False
+    for c in cfgs:
+        bm, bn = c["block_m"], c["block_n"]
+        assert 2048 % bm == 0 and 3072 % bn == 0, c
+        fp = _swiglu_footprint(bm, bn, 4096, 2)
+        assert fp <= HARD_FOOTPRINT_CAP, c
+        if fp > DEFAULT_VMEM_BUDGET:
+            budget_tier_ended = True
+        else:
+            # budget-tier entries must all precede aggressive ones
+            assert not budget_tier_ended, cfgs
+        assert (bm, bn) not in seen
+        seen.add((bm, bn))
+    # the sweep must have aggressive candidates to explore here
+    assert budget_tier_ended, cfgs
+    # tiny shard: no feasible kernel tiling -> empty table (entry then
+    # composes from ag_gemm_multi), never an invalid config
+    assert ag_swiglu_configs(8, 32, 32, 4) == []

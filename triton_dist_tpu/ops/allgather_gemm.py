@@ -791,6 +791,62 @@ def _swiglu_footprint(bm: int, bn: int, k: int, itemsize: int) -> int:
     return itemsize * (2 * bm * k + 4 * k * bn + 2 * bm * bn)
 
 
+def ag_swiglu_configs(rows: int, k: int, n_loc: int,
+                      itemsize: int,
+                      vmem_budget: int = DEFAULT_VMEM_BUDGET) -> list[dict]:
+    """Candidate (block_m, block_n) table for the fused SwiGLU kernel,
+    ordered best-first; same two-tier structure as
+    :func:`ag_gemm_configs` (budget tier, then an aggressive tier up to
+    HARD_FOOTPRINT_CAP for the autotuner — the dual gate+up panel
+    doubles B residency, so feasible tiles are smaller than the plain
+    AG-GEMM's at equal budget)."""
+    cfgs: list[dict] = []
+    for aggressive in (False, True):
+        for bn in (2048, 1024, 512, 256, 128):
+            if bn > n_loc or n_loc % bn:
+                continue
+            for bm in (1024, 512, 256, 128):
+                if bm > rows or rows % bm:
+                    continue
+                fp = _swiglu_footprint(bm, bn, k, itemsize)
+                ok = (vmem_budget < fp <= HARD_FOOTPRINT_CAP
+                      if aggressive else fp <= vmem_budget)
+                if ok:
+                    cfgs.append({"block_m": bm, "block_n": bn})
+    return cfgs
+
+
+def _autotune_ag_swiglu(a, w_gate, w_up, ctx, key):
+    """Eager sweep over :func:`ag_swiglu_configs`; winner cached by
+    shape alongside the ag_gemm winners (same _TUNED map, distinct
+    key tag)."""
+    from triton_dist_tpu.tools.autotuner import autotune
+
+    m, k = a.shape
+    rows = m // ctx.world_size
+    n_loc = w_gate.shape[1] // ctx.world_size
+    cfgs = ag_swiglu_configs(rows, k, n_loc, a.dtype.itemsize,
+                             ctx.vmem_budget)
+    if not cfgs:
+        return None
+    if len(cfgs) == 1:
+        _TUNED[key] = cfgs[0]
+        return cfgs[0]
+
+    def make_fn(**cfg):
+        ctx2 = dataclasses.replace(ctx, autotune=False,
+                                   trust_blocks=True, **cfg)
+        fn = jax.jit(lambda x, wg, wu: ag_swiglu(x, wg, wu, ctx2,
+                                                 impl="pallas"))
+        from triton_dist_tpu.runtime.utils import make_perturbed_runner
+        return make_perturbed_runner(fn, a, w_gate, w_up)
+
+    result = autotune(make_fn, cfgs, key=f"ag_swiglu:{key}", iters=8,
+                      warmup_iters=2)
+    _TUNED[key] = result.config
+    return result.config
+
+
 def _ag_swiglu_hbm_kernel(x_hbm, wg_hbm, wu_hbm, ag_hbm, act_hbm, a_tile,
                           b_panel, c_stage, copy_sem, a_sem, b_sem, c_sem,
                           send_sem, recv_sem, *, axis: str, world: int,
@@ -968,20 +1024,44 @@ def ag_swiglu(a: jax.Array, w_gate: jax.Array, w_up: jax.Array,
     interpret = resolve_interpret(ctx.interpret)
     item = a.dtype.itemsize
 
-    # First feasible (m_blk, n_blk) under the VMEM budget; the gate+up
-    # dual panel doubles B residency vs the plain hbm kernel.
+    if ctx.autotune:
+        tune_key = (m, k, n_loc, str(a.dtype), world, "swiglu")
+        tuned = _TUNED.get(tune_key)
+        if tuned is None and not isinstance(a, jax.core.Tracer):
+            tuned = _autotune_ag_swiglu(a, w_gate, w_up, ctx, tune_key)
+        if tuned is not None:
+            ctx = dataclasses.replace(ctx, autotune=False,
+                                      trust_blocks=True, **tuned)
+
+    # trust_blocks (sweep / tuned winner) honors the HINT blocks up to
+    # the hard compile cap — only the hint: the descending fallbacks
+    # below stay under the soft budget, so an infeasible trusted hint
+    # degrades to a conservative config rather than to an unswept
+    # aggressive one (review r5k finding 1; same contract as the
+    # ag_gemm entry's re-filter).
     choice = None
-    for bn in (_pick_block_k(n_loc, ctx.block_n), 512, 256, 128):
-        if bn > n_loc or n_loc % bn:
-            continue
-        for bm in (_pick_block_k(rows, ctx.block_m), 256, 128):
-            if bm > rows or rows % bm:
+    if ctx.trust_blocks:
+        bm_h = _pick_block_k(rows, ctx.block_m)
+        bn_h = _pick_block_k(n_loc, ctx.block_n)
+        if (bn_h <= n_loc and n_loc % bn_h == 0 and bm_h <= rows
+                and rows % bm_h == 0
+                and _swiglu_footprint(bm_h, bn_h, k,
+                                      item) <= HARD_FOOTPRINT_CAP):
+            choice = (bm_h, bn_h)
+    # First feasible (m_blk, n_blk) under the soft budget; the gate+up
+    # dual panel doubles B residency vs the plain hbm kernel.
+    if choice is None:
+        for bn in (_pick_block_k(n_loc, ctx.block_n), 512, 256, 128):
+            if bn > n_loc or n_loc % bn:
                 continue
-            if _swiglu_footprint(bm, bn, k, item) <= ctx.vmem_budget:
-                choice = (bm, bn)
+            for bm in (_pick_block_k(rows, ctx.block_m), 256, 128):
+                if bm > rows or rows % bm:
+                    continue
+                if _swiglu_footprint(bm, bn, k, item) <= ctx.vmem_budget:
+                    choice = (bm, bn)
+                    break
+            if choice:
                 break
-        if choice:
-            break
     if choice is None or rows % 128 or n_loc % 128:
         # No feasible single-kernel tiling (huge K or tiny shards):
         # compose from the proven pieces — still fused AG, unfused act.
