@@ -110,10 +110,14 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
             dt = time.perf_counter() - t0
             results.append((name, "PASS" if ok else "NONFINITE",
                             f"{dt:.1f}s"))
-        except Exception:  # noqa: BLE001 — record and continue
+        except Exception as e:  # noqa: BLE001 — record and continue
             dt = time.perf_counter() - t0
             tb = traceback.format_exc().strip().splitlines()
-            results.append((name, "FAIL", f"{dt:.1f}s " + tb[-1][:120]))
+            # The exception repr, not tb[-1]: JAX appends its
+            # traceback-filter notice as the last line, which is what
+            # the round-5 SP failure summary consisted of entirely.
+            head = f"{type(e).__name__}: {e}".replace("\n", " ")
+            results.append((name, "FAIL", f"{dt:.1f}s " + head[:160]))
             if log_path:
                 with open(log_path, "a") as f:
                     f.write(f"\n=== {name} ===\n")

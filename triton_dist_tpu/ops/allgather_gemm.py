@@ -38,6 +38,7 @@ from triton_dist_tpu.ops.common import (
     DEFAULT_VMEM_BUDGET,
     HARD_FOOTPRINT_CAP,
     any_spec,
+    cap_config_tiers,
     comm_params,
     maybe_noise,
     maybe_straggle,
@@ -477,27 +478,39 @@ def ag_gemm_configs(m: int, rows: int, k: int, n_tot_loc: int,
     fit the hardware constraints). Ordered best-first: every entry point
     (default, autotune) consults this table, so an infeasible default can
     never reach the compiler (BENCH_r02's 16.5 MB-scratch crash)."""
-    cfgs: list[dict] = []
+    vmem_cfgs: list[dict] = []
     vmem_fp = itemsize * (m * k + k * n_tot_loc + m * n_tot_loc + rows * k)
     if vmem_fp <= vmem_budget:
-        cfgs.append({"variant": "vmem"})
+        vmem_cfgs.append({"variant": "vmem"})
     # N-blocked resident-B kernel: larger block_n first (A is re-read
     # n_tot_loc/block_n times; B exactly once). Large tiles are listed
-    # in BOTH tiers: here when they fit the soft budget (making them
-    # the default where they are free), in the aggressive tier when
-    # only the raised compile cap admits them (review r5j finding 1: a
-    # budget-tier list capped at bm=256/bn=1024 left soft-budget-sized
-    # large tiles in neither tier).
+    # in BOTH tiers: the budget tier when they fit (making them the
+    # default where they are free), the aggressive tier when only the
+    # raised compile cap admits them (review r5j finding 1).
+    hbm_budget: list[dict] = []
+    aggressive: list[dict] = []
     for bn in (2048, 1024, 512, 256, 128):
         if bn > n_tot_loc or n_tot_loc % bn:
             continue
         for bm in (1024, 512, 256, 128):
             if bm > rows or rows % bm:
                 continue
-            if _hbm_footprint(bm, bn, k, itemsize) <= vmem_budget:
-                cfgs.append({"variant": "hbm", "block_m": bm,
-                             "block_n": bn})
-    # k-tiled fallback (huge K: no resident panel fits).
+            fp = _hbm_footprint(bm, bn, k, itemsize)
+            if fp <= vmem_budget:
+                hbm_budget.append({"variant": "hbm", "block_m": bm,
+                                   "block_n": bn})
+            elif fp <= HARD_FOOTPRINT_CAP:
+                # Aggressive tier — concatenated LAST so the default
+                # path (first feasible) never picks these; the
+                # autotuner sweeps them under per-config failure
+                # isolation (see HARD_FOOTPRINT_CAP in ops/common.py).
+                aggressive.append({"variant": "hbm", "block_m": bm,
+                                   "block_n": bn})
+    # k-tiled fallback (huge K: no resident panel fits). Kept OUTSIDE
+    # the tier cap: the entry-point clamps re-filter to these when a
+    # hinted config is infeasible, so pruning must never drop them
+    # (review r5l finding 1).
+    kt_cfgs: list[dict] = []
     for bm in (128, 256, 512):
         if bm > rows:
             continue
@@ -508,33 +521,12 @@ def ag_gemm_configs(m: int, rows: int, k: int, n_tot_loc: int,
             fp = (2 * bm * bk + 2 * bk * n_tot_loc) * itemsize \
                 + bm * n_tot_loc * (4 + 2 * itemsize)
             if fp <= vmem_budget:
-                cfgs.append({"variant": "hbm_kt", "block_m": bm,
-                             "block_k": bk})
-    # Aggressive tier — listed LAST so the default path (first feasible)
-    # never picks them; the autotuner sweeps them under per-config
-    # failure isolation. Larger tiles cut A re-reads (n/bn passes over
-    # the full gathered A) and amortize MXU issue overhead — the round-5
-    # chip run measured the budget-tier kernel at 135 TFLOPS vs XLA's
-    # ~200 on the same matmul. The cap reflects the measured Mosaic
-    # scoped-VMEM behavior: declared scratch carries ~2.2x of
-    # window/staging overhead, and the kernels now compile with
-    # vmem_limit_bytes=64 MB (v5e has 128 MB physical VMEM), so declared
-    # footprints up to ~26 MB are compilable; per-config isolation in
-    # the sweep absorbs any shape that still overflows.
-    hard_cap = HARD_FOOTPRINT_CAP
-    for bn in (2048, 1024, 512):
-        if bn > n_tot_loc or n_tot_loc % bn:
-            continue
-        for bm in (1024, 512, 256):
-            if bm > rows or rows % bm:
-                continue
-            fp = _hbm_footprint(bm, bn, k, itemsize)
-            if vmem_budget < fp <= hard_cap:
-                cfgs.append({"variant": "hbm", "block_m": bm,
-                             "block_n": bn})
-    # Last resort: shape-CLAMPED k-tiled blocks. An unclamped literal
-    # here once reached the kernel with block_k > K on a tiny shard
-    # (k_tiles = 0 -> ZeroDivisionError in the ring schedule).
+                kt_cfgs.append({"variant": "hbm_kt", "block_m": bm,
+                                "block_k": bk})
+    cfgs = (vmem_cfgs
+            + cap_config_tiers(hbm_budget, [], n_budget=4)
+            + kt_cfgs[:2]
+            + cap_config_tiers([], aggressive))
     return cfgs or [{"variant": "hbm_kt",
                      "block_m": _pick_block_k(rows, 128),
                      "block_k": _pick_block_k(k, 256)}]
@@ -800,20 +792,20 @@ def ag_swiglu_configs(rows: int, k: int, n_loc: int,
     HARD_FOOTPRINT_CAP for the autotuner — the dual gate+up panel
     doubles B residency, so feasible tiles are smaller than the plain
     AG-GEMM's at equal budget)."""
-    cfgs: list[dict] = []
-    for aggressive in (False, True):
-        for bn in (2048, 1024, 512, 256, 128):
-            if bn > n_loc or n_loc % bn:
+    budget: list[dict] = []
+    aggressive: list[dict] = []
+    for bn in (2048, 1024, 512, 256, 128):
+        if bn > n_loc or n_loc % bn:
+            continue
+        for bm in (1024, 512, 256, 128):
+            if bm > rows or rows % bm:
                 continue
-            for bm in (1024, 512, 256, 128):
-                if bm > rows or rows % bm:
-                    continue
-                fp = _swiglu_footprint(bm, bn, k, itemsize)
-                ok = (vmem_budget < fp <= HARD_FOOTPRINT_CAP
-                      if aggressive else fp <= vmem_budget)
-                if ok:
-                    cfgs.append({"block_m": bm, "block_n": bn})
-    return cfgs
+            fp = _swiglu_footprint(bm, bn, k, itemsize)
+            if fp <= vmem_budget:
+                budget.append({"block_m": bm, "block_n": bn})
+            elif fp <= HARD_FOOTPRINT_CAP:
+                aggressive.append({"block_m": bm, "block_n": bn})
+    return cap_config_tiers(budget, aggressive)
 
 
 def _autotune_ag_swiglu(a, w_gate, w_up, ctx, key):

@@ -104,6 +104,22 @@ DEFAULT_VMEM_BUDGET = 24 * 1024 * 1024
 assert DEFAULT_VMEM_BUDGET < HARD_FOOTPRINT_CAP
 
 
+def cap_config_tiers(budget_cfgs, aggressive_cfgs, n_budget: int = 5,
+                     n_aggressive: int = 4):
+    """Prune an autotune config table for sweep tractability: each
+    entry costs a ~30 s cold Mosaic compile on chip, so keep the
+    ``n_budget`` best in-budget entries and ``n_aggressive`` best
+    aggressive (over-soft-budget) entries. Both lists are generated
+    best-first (larger block_n = fewer A re-reads, then larger
+    block_m), so a prefix of each preserves the heuristic ranking.
+    Callers pass the tiers as separate lists — tier membership is
+    decided once, at generation (review r5l finding 2: re-deriving it
+    in a closure invited drift), and fallback variants a downstream
+    clamp depends on (hbm_kt) must be appended by the caller OUTSIDE
+    the cap so pruning can never remove them (r5l finding 1)."""
+    return budget_cfgs[:n_budget] + aggressive_cfgs[:n_aggressive]
+
+
 def comm_params(collective_id: int | None = 0,
                 vmem_limit_bytes: int | None = None,
                 world: int | None = None) -> pltpu.CompilerParams:

@@ -36,6 +36,7 @@ from triton_dist_tpu.ops.common import (
     DEFAULT_VMEM_BUDGET,
     HARD_FOOTPRINT_CAP,
     any_spec,
+    cap_config_tiers,
     comm_params,
     nestable_shard_map,
     resolve_interpret,
@@ -66,23 +67,34 @@ def gemm_rs_configs(m: int, rows: int, k_loc: int, n: int, itemsize: int,
     """Candidate config table for the fused GEMM-RS, ordered best-first.
     Every entry point (default, autotune) consults this table so an
     infeasible default can never reach the compiler (BENCH_r02)."""
-    cfgs: list[dict] = []
+    vmem_cfgs: list[dict] = []
     vmem_fp = itemsize * (m * k_loc + k_loc * n + rows * n
                           + 2 * max(world - 1, 1) * rows * n)
     if vmem_fp <= vmem_budget:
-        cfgs.append({"variant": "vmem"})
+        vmem_cfgs.append({"variant": "vmem"})
     # N-blocked resident-B kernel (B read once per chunk, full-K dots).
-    # Large tiles appear in both tiers — see ag_gemm_configs.
+    # Large tiles appear in both tiers; the aggressive tier is
+    # concatenated LAST so defaults never pick it — see ag_gemm_configs
+    # for the tier rationale and HARD_FOOTPRINT_CAP sizing.
+    hbm_budget: list[dict] = []
+    aggressive: list[dict] = []
     for bn in (2048, 1024, 512, 256, 128):
         if bn > n or n % bn:
             continue
         for bm in (1024, 512, 256, 128):
             if bm > rows or rows % bm:
                 continue
-            if _hbm_nb_footprint(bm, bn, k_loc, itemsize) <= vmem_budget:
-                cfgs.append({"variant": "hbm", "block_m": bm,
-                             "block_n": bn})
-    # k-tiled fallback (huge K_loc).
+            fp = _hbm_nb_footprint(bm, bn, k_loc, itemsize)
+            if fp <= vmem_budget:
+                hbm_budget.append({"variant": "hbm", "block_m": bm,
+                                   "block_n": bn})
+            elif fp <= HARD_FOOTPRINT_CAP:
+                aggressive.append({"variant": "hbm", "block_m": bm,
+                                   "block_n": bn})
+    # k-tiled fallback (huge K_loc) — OUTSIDE the tier cap: entry-point
+    # clamps re-filter to these, so pruning must never drop them
+    # (review r5l finding 1).
+    kt_cfgs: list[dict] = []
     for bm in (128, 256, 512):
         if bm > rows:
             continue
@@ -92,25 +104,12 @@ def gemm_rs_configs(m: int, rows: int, k_loc: int, n: int, itemsize: int,
             fp = (2 * bm * bk + 2 * bk * n) * itemsize \
                 + bm * n * (4 + 3 * itemsize)
             if fp <= vmem_budget:
-                cfgs.append({"variant": "hbm_kt", "block_m": bm,
-                             "block_k": bk})
-    # Aggressive tier — LAST so defaults never pick them; the autotuner
-    # sweeps them under per-config failure isolation. Larger tiles cut
-    # A re-reads and amortize MXU issue overhead (round-5 chip: budget
-    # tier ran 159 TFLOPS vs XLA's ~200). Cap sized to the measured
-    # ~2.2x Mosaic scoped-overhead under the kernels' 64 MB
-    # vmem_limit_bytes — see ag_gemm_configs.
-    hard_cap = HARD_FOOTPRINT_CAP
-    for bn in (2048, 1024, 512):
-        if bn > n or n % bn:
-            continue
-        for bm in (1024, 512, 256):
-            if bm > rows or rows % bm:
-                continue
-            fp = _hbm_nb_footprint(bm, bn, k_loc, itemsize)
-            if vmem_budget < fp <= hard_cap:
-                cfgs.append({"variant": "hbm", "block_m": bm,
-                             "block_n": bn})
+                kt_cfgs.append({"variant": "hbm_kt", "block_m": bm,
+                                "block_k": bk})
+    cfgs = (vmem_cfgs
+            + cap_config_tiers(hbm_budget, [], n_budget=4)
+            + kt_cfgs[:2]
+            + cap_config_tiers([], aggressive))
     # Last resort: shape-CLAMPED k-tiled blocks (see ag_gemm_configs —
     # an unclamped literal yields k_tiles = 0 on tiny shards).
     return cfgs or [{"variant": "hbm_kt",
