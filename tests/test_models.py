@@ -318,7 +318,7 @@ def test_kv_cache_incremental_decode_matches_full(dense, key):
                                np.asarray(full), rtol=2e-4, atol=2e-4)
 
 
-def test_paged_kv_cache_matches_contiguous(mesh8, key):
+def test_paged_kv_cache_matches_contiguous(mesh8, key, monkeypatch):
     """PagedKVCacheManager writes + paged decode == contiguous-cache
     decode, including slot reuse after free (vLLM-style paging over the
     SP flash-decode kernel)."""
@@ -367,6 +367,29 @@ def test_paged_kv_cache_matches_contiguous(mesh8, key):
                                          mgr.block_table(), kv_len, ctx,
                                          impl="xla")
     np.testing.assert_allclose(np.asarray(got_xla), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+    # paged_variant="gathered": table-gather view + the dense tiled
+    # Pallas kernel (the insurance path for the direct kernel's
+    # round-5 on-chip Mosaic compile hang) must match too.
+    import dataclasses as dc
+    got_g = gqa_fwd_batch_decode_paged(
+        q, pools[0][0], pools[0][1], mgr.block_table(), kv_len,
+        dc.replace(ctx, paged_variant="gathered"))
+    np.testing.assert_allclose(np.asarray(got_g), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+    # env override wins over the field: with an INVALID field value the
+    # call only succeeds if the env value actually replaces it (the
+    # validator rejects the resolved value otherwise), so this cannot
+    # pass vacuously through the direct path.
+    import pytest
+    bad_ctx = dc.replace(ctx, paged_variant="bogus")
+    with pytest.raises(ValueError, match="paged_variant"):
+        gqa_fwd_batch_decode_paged(q, pools[0][0], pools[0][1],
+                                   mgr.block_table(), kv_len, bad_ctx)
+    monkeypatch.setenv("TDT_PAGED_VARIANT", "gathered")
+    got_env = gqa_fwd_batch_decode_paged(
+        q, pools[0][0], pools[0][1], mgr.block_table(), kv_len, bad_ctx)
+    np.testing.assert_allclose(np.asarray(got_env), np.asarray(ref),
                                rtol=2e-3, atol=2e-3)
 
 

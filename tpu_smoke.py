@@ -324,6 +324,15 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
              q, pool_k, pool_v, table,
              jnp.int32(world * n_pages * page // 2), fd_paged))
 
+    # Insurance path for the direct paged kernel's round-5 Mosaic
+    # compile hang: table-gather view + the proven dense tiled kernel.
+    import dataclasses as _dc
+    fd_paged_g = _dc.replace(fd_paged, paged_variant="gathered")
+    case("flash_decode/paged_gathered",
+         lambda: gqa_fwd_batch_decode_paged(
+             q, pool_k, pool_v, table,
+             jnp.int32(world * n_pages * page // 2), fd_paged_g))
+
     # Serving shape (bench.py flash_decode line: B=8, 32 heads, t=8k).
     def fd_serving():
         bs, hqs, hkvs, ds, ts = 8, 32, 8, 128, 8192

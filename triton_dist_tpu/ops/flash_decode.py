@@ -83,6 +83,15 @@ class FlashDecodeContext:
     vmem_budget: int = 10 * 1024 * 1024
     # Byte threshold for auto: einsum below (shard fits VMEM comfortably).
     einsum_max_bytes: int = 4 * 1024 * 1024
+    # Paged-KV kernel path: "direct" streams pages into the tiled
+    # kernel via block-table indirection (one DMA per batch row per
+    # tile); "gathered" reconstructs the contiguous per-device KV view
+    # with an XLA gather and runs the PROVEN dense tiled kernel — the
+    # insurance path while the direct kernel's round-5 Mosaic compile
+    # hang (tpu_smoke_r5_bulk.log: flash_decode/paged, >40 min) is
+    # open. The TDT_PAGED_VARIANT env var overrides the field so a
+    # deployment can flip paths without code changes.
+    paged_variant: str = "direct"
 
     @property
     def world_size(self) -> int:
@@ -530,12 +539,24 @@ def gqa_fwd_batch_decode_paged(q: jax.Array, pool_k: jax.Array,
     t_loc = n_pages * page_size
     kv_len = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
 
-    if impl == "xla":
-        # Golden: reconstruct the contiguous (B, T, Hkv, D) view via
-        # table gathers (position → slot is the allocator's map), then
-        # run the contiguous xla decode. One big gather per step — the
-        # measuring stick and the fast CPU-mesh path, like the other
-        # ops' xla impls.
+    import os
+    paged_variant = os.environ.get("TDT_PAGED_VARIANT",
+                                   ctx.paged_variant)
+    if paged_variant not in ("direct", "gathered"):
+        # A typo here would silently run the direct path — the exact
+        # compile-hang the override exists to dodge.
+        raise ValueError(
+            f"paged_variant {paged_variant!r} (field or "
+            "TDT_PAGED_VARIANT) must be 'direct' or 'gathered'")
+    if impl == "xla" or paged_variant == "gathered":
+        # Reconstruct the contiguous (B, T, Hkv, D) view via table
+        # gathers (position → slot is the allocator's map), then run
+        # the contiguous decode. For impl="xla" this is the golden /
+        # fast CPU-mesh path, like the other ops' xla impls; for
+        # paged_variant="gathered" the dense TILED Pallas kernel
+        # consumes the gathered view — the proven-on-chip path that
+        # sidesteps the direct kernel's block-table indirection (see
+        # FlashDecodeContext.paged_variant).
         from triton_dist_tpu.models.kv_cache import PagedKVCacheManager
         spd = pool_k.shape[0] // world
         posn = jnp.arange(world * t_loc)
@@ -547,7 +568,7 @@ def gqa_fwd_batch_decode_paged(q: jax.Array, pool_k: jax.Array,
         return gqa_fwd_batch_decode(
             q, jax.lax.with_sharding_constraint(ck, sh),
             jax.lax.with_sharding_constraint(cv, sh), kv_len, ctx,
-            impl="xla")
+            impl=impl)
 
     interpret = resolve_interpret(ctx.interpret)
 

@@ -51,9 +51,19 @@ def _disk_cache_path() -> str | None:
     return os.path.expanduser(val)
 
 
+#: Bump when the sweep's TIMING methodology changes materially: every
+#: persisted winner under an older version must miss (a fresh sweep is
+#: cheaper than serving a winner ranked by a measurement now known to
+#: be wrong). v2: the round-5 chained-runner fix — pre-fix on-chip
+#: sweeps paid one readback roundtrip per iteration and ranked sub-ms
+#: kernels by tunnel jitter (cached "winners" carried avg_ms of
+#: 136-297 ms for a 0.5 ms kernel).
+_CACHE_VERSION = "v2"
+
+
 def _disk_key(key: str) -> str:
     kind = getattr(jax.devices()[0], "device_kind", "cpu")
-    return f"{kind}::{key}"
+    return f"{_CACHE_VERSION}::{kind}::{key}"
 
 
 def _disk_load(key: str) -> TuneResult | None:
@@ -95,6 +105,11 @@ def _disk_store(key: str, result: TuneResult) -> None:
                     data = json.load(f)
             except (OSError, ValueError):
                 data = {}
+        # Evict entries from older cache versions on rewrite: a version
+        # bump means their timing methodology is known-wrong, and dead
+        # winners would otherwise accumulate one generation per bump.
+        data = {k: v for k, v in data.items()
+                if k.startswith(_CACHE_VERSION + "::")}
         data[_disk_key(key)] = {
             "config": result.config, "avg_ms": result.avg_ms,
             "all_ms": [t if np.isfinite(t) else None
