@@ -99,3 +99,68 @@ def test_perf_func_chained_measures_real_work():
     ms = perf_func_chained(counted, jnp.ones((8, 8)), iters=(2, 6))
     assert ms > 0
     assert calls[0] >= 7   # warmup + n2 chain
+
+
+class TestTopology:
+    def test_describe_topology_mocked_coords(self):
+        from triton_dist_tpu.runtime.topology import describe_topology
+
+        class FakeDev:
+            def __init__(self, coords, proc):
+                self.platform = "tpu"
+                self.device_kind = "TPU v5 lite"
+                self.coords = coords
+                self.process_index = proc
+
+        devs = [FakeDev((x, y, 0), x // 2) for x in range(4)
+                for y in range(2)]
+        info = describe_topology(devs)
+        assert info["n_devices"] == 8
+        assert info["torus_extent"] == (4, 2, 1)
+        assert info["coords_contiguous"] is True
+        assert info["n_hosts"] == 2
+
+    def test_describe_topology_cpu_no_coords(self):
+        from triton_dist_tpu.runtime.topology import describe_topology
+        info = describe_topology()
+        assert info["platform"] == "cpu"
+        assert "torus_extent" not in info
+
+    def test_grid_cpu_falls_back_to_reshape(self):
+        import numpy as np
+        from triton_dist_tpu.runtime.topology import topology_aware_grid
+        devs = np.array(jax.devices())
+        grid = topology_aware_grid(devs, (2, 4))
+        assert grid.shape == (2, 4)
+        assert list(grid.ravel()) == list(devs)   # order preserved
+
+    def test_grid_tpu_routes_through_mesh_utils(self, monkeypatch):
+        """TPU device grids must go through mesh_utils (torus-aware
+        placement); a mesh_utils failure must fall back, not raise."""
+        import numpy as np
+        from triton_dist_tpu.runtime import topology
+        from jax.experimental import mesh_utils
+
+        calls = []
+
+        def spy(shape, devices=None):
+            calls.append(shape)
+            return np.array(devices).reshape(shape)
+
+        monkeypatch.setattr(mesh_utils, "create_device_mesh", spy)
+
+        class FakeTpu:
+            platform = "tpu"
+
+        # len must match jax.devices() for the TPU path to engage
+        devs = np.array([FakeTpu() for _ in jax.devices()])
+        grid = topology.topology_aware_grid(devs, (len(devs),))
+        assert calls == [(len(devs),)]
+        assert grid.shape == (len(devs),)
+
+        def boom(shape, devices=None):
+            raise RuntimeError("no topology info")
+
+        monkeypatch.setattr(mesh_utils, "create_device_mesh", boom)
+        grid = topology.topology_aware_grid(devs, (len(devs),))
+        assert grid.shape == (len(devs),)   # reshape fallback

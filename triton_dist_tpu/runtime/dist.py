@@ -140,7 +140,8 @@ def initialize_distributed(
         raise ValueError(
             f"mesh shape {shape} does not cover {devices.size} devices")
 
-    mesh = Mesh(devices.reshape(shape), names)
+    from triton_dist_tpu.runtime.topology import topology_aware_grid
+    mesh = Mesh(topology_aware_grid(devices, shape), names)
     _CONTEXT = DistContext(mesh=mesh, seed=seed)
     return _CONTEXT
 
