@@ -315,3 +315,37 @@ def test_ag_swiglu_configs_table():
     # tiny shard: no feasible kernel tiling -> empty table (entry then
     # composes from ag_gemm_multi), never an invalid config
     assert ag_swiglu_configs(8, 32, 32, 4) == []
+
+
+@pytest.mark.slow
+def test_deep_mega_bench_config_fits():
+    """The 32-layer fused mega step at bench.py's deep TPU config: every
+    pallas_call within the declared cap. Run offline after the round-5
+    on-chip mega MosaicError (HTTP 500 during the deep compile): the
+    static footprint is clean, so the failure class was Mosaic's old
+    16 MB scoped limit (~2.2x overhead over declared — the same class
+    that rejected the SP kernel), which comm_params' 64 MB request now
+    covers."""
+    from triton_dist_tpu.mega import MegaQwen3
+    from triton_dist_tpu.models import DenseLLM, ModelConfig
+    from triton_dist_tpu.models.kv_cache import KVCacheManager
+    mesh = _mesh(1)
+    cfg = ModelConfig(hidden_size=4096, intermediate_size=1536,
+                      num_hidden_layers=32, num_attention_heads=4,
+                      num_key_value_heads=1, head_dim=128,
+                      vocab_size=32768, max_position_embeddings=512,
+                      dtype=bf16)
+    model = DenseLLM(cfg, mesh=mesh, axis="tp", impl="pallas")
+    for layer in (model.attn, model.mlp):
+        layer.ag_ctx.interpret = True
+        layer.rs_ctx.interpret = True
+    kv = KVCacheManager(cfg.num_hidden_layers, 1,
+                        cfg.max_position_embeddings,
+                        cfg.num_key_value_heads, cfg.head_dim, mesh=mesh,
+                        axis="tp", dtype=cfg.dtype)
+    mega = MegaQwen3(model, decode_mode="gemm_ar")
+    params = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
+    caches = jax.eval_shape(kv.init)
+    token = jax.ShapeDtypeStruct((1, 1), jnp.int32)
+    check_entry_vmem(lambda p, t, c: mega.step(p, t, c, 4)[0],
+                     params, token, caches)
