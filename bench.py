@@ -1203,11 +1203,14 @@ def main():
                 and not (_probe_backend_subprocess(75.0)
                          or _probe_backend_subprocess(75.0)):
             extras["probe_failed"] = True
-            # Carry the NEWEST prior checkpoint, clearly labeled as
-            # such (a wedged tunnel at round end must not zero out
-            # knowledge of the last good run — but its metrics stay OUT
-            # of the headline fields). The watcher's bench writes to a
-            # dedicated path, so scan both.
+            # Carry the NEWEST prior checkpoint (a wedged tunnel at
+            # round end must not zero out knowledge of the last good
+            # run). Its headline metric IS promoted to the top-level
+            # fields — a None value reads as "never measured" when a
+            # full on-chip table exists — but only with the explicit
+            # from_prior_run label carrying age + source, so the line
+            # can never pass off old numbers as a fresh run. The
+            # watcher's bench writes to a dedicated path, so scan both.
             # Among candidates the NEWEST one that carries at least one
             # measured metric wins: plain newest-wins lets a wedged
             # run's near-empty "init" checkpoint mask the good run it
@@ -1231,6 +1234,15 @@ def main():
                         extras["prior_run_n_measured"] = n_measured
                 except (OSError, ValueError):
                     pass
+            if extras.get("prior_run_n_measured"):
+                sel = _select_result(extras["prior_run"])
+                if sel["value"] is not None:
+                    result.update(
+                        metric=sel["metric"], value=sel["value"],
+                        unit=sel["unit"], vs_baseline=sel["vs_baseline"])
+                    result["from_prior_run"] = {
+                        "age_s": extras["prior_run_age_s"],
+                        "path": extras["prior_run_path"]}
             print(json.dumps(result))
             return
         # Fresh run: clear any stale checkpoint so a run that dies
