@@ -65,10 +65,21 @@ class Engine:
                  top_p: float = 1.0, seed: int = 0,
                  profile_dir: str | None = None, profile_steps: int = 64,
                  paged: bool = False, page_size: int = 16,
-                 prefill_chunk: int | None = None):
+                 prefill_chunk: int | None = None,
+                 use_mega: bool = False):
         self.model = model
         c = model.config
         self.paged = paged
+        # use_mega: decode through the MegaQwen3 fused one-program step
+        # (the task-graph megakernel analog) — measured 1.49x the plain
+        # jitted decode step on chip (docs/perf.md "First chip
+        # contact"). Uniform-offset decode only: no paged pools, no
+        # per-row kv_start (serve_ragged) — those routes raise.
+        self.use_mega = use_mega
+        if use_mega:
+            assert not paged and "sp" not in (prefill_mode, decode_mode), (
+                "use_mega serves the dense uniform-offset engine")
+        self._mega = None
         if "sp" in (prefill_mode, decode_mode):
             # Sequence-parallel serving (long context): both phases must
             # share the sequence-sharded cache layout.
@@ -126,15 +137,47 @@ class Engine:
         self._admit = None
 
     # -- decode step (jit once = graph capture, engine.py:75-105) ----------
-    def _build_decode_step(self):
+    def _get_mega(self):
+        if self._mega is None:
+            from triton_dist_tpu.mega import MegaQwen3
+            self._mega = MegaQwen3(self.model,
+                                   decode_mode=self.decode_mode)
+        return self._mega
+
+    def _mega_forward(self, params, caches, token, offset, kv_start,
+                      table):
+        """The mega program as a forward: uniform-offset decode only.
+        ``kv_start`` is ignored — serve()'s uniform path passes all
+        zeros and the ragged/paged routes are rejected at entry (the
+        array is a tracer here, so value checks cannot live in the
+        step)."""
+        if table is not None:
+            raise ValueError("use_mega does not serve paged tables")
+        return self._get_mega().step(params, token[:, None], caches,
+                                     offset)
+
+    def _decode_forward(self):
+        """The decode-step forward: the mega one-program step under
+        use_mega, model.forward otherwise — one place, so the sampling
+        and stop bookkeeping below exist once per builder."""
+        if self.use_mega:
+            return self._mega_forward
         model, mode = self.model, self.decode_mode
 
-        @jax.jit
-        def step(params, caches, token, offset, key, kv_start, table):
-            logits, caches = model.forward(
+        def fwd(params, caches, token, offset, kv_start, table):
+            return model.forward(
                 params, token[:, None], caches, offset, mode=mode,
                 kv_start=None if mode == "sp" else kv_start,
                 **({"block_table": table} if table is not None else {}))
+        return fwd
+
+    def _build_decode_step(self):
+        fwd = self._decode_forward()
+
+        @jax.jit
+        def step(params, caches, token, offset, key, kv_start, table):
+            logits, caches = fwd(params, caches, token, offset,
+                                 kv_start, table)
             nxt = sample_token(logits[:, -1], key, self.temperature,
                                self.top_k, self.top_p)
             return nxt, caches
@@ -144,15 +187,13 @@ class Engine:
         """Decode step with in-graph stop bookkeeping: still ONE compiled
         program per token (jit caches per stop-set shape); stopped rows
         keep emitting their stop token."""
-        model, mode = self.model, self.decode_mode
+        fwd = self._decode_forward()
 
         @jax.jit
         def step(params, caches, token, offset, key, done, stop, kv_start,
                  table):
-            logits, caches = model.forward(
-                params, token[:, None], caches, offset, mode=mode,
-                kv_start=None if mode == "sp" else kv_start,
-                **({"block_table": table} if table is not None else {}))
+            logits, caches = fwd(params, caches, token, offset,
+                                 kv_start, table)
             nxt = sample_token(logits[:, -1], key, self.temperature,
                                self.top_k, self.top_p)
             nxt = jnp.where(done, token, nxt)
@@ -180,6 +221,15 @@ class Engine:
         stop_tokens = tuple(stop_tokens)
         has_stop = bool(stop_tokens)
         stop = jnp.asarray(list(stop_tokens) or [-1], jnp.int32)
+        if self.use_mega and kv_start is not None \
+                and np.any(np.asarray(kv_start)):
+            # All-zero kv_start IS the uniform batch (serve() itself
+            # passes zeros when the caller gave None), so equal-length
+            # ragged batches stay servable under mega.
+            raise ValueError(
+                "use_mega decodes uniform-offset batches only — "
+                "nonzero per-row kv_start (ragged serving) needs "
+                "use_mega=False")
         kv_start = (jnp.zeros((b,), jnp.int32) if kv_start is None
                     else jnp.asarray(kv_start, jnp.int32))
         self.kv.reset()
@@ -376,6 +426,11 @@ class Engine:
             always land in pages the row owns and can never corrupt
             another sequence.
         """
+        if self.use_mega:
+            raise ValueError(
+                "use_mega decodes uniform-offset batches only — "
+                "continuous batching runs every row at its own "
+                "cache offset; serve_stream needs use_mega=False")
         paged = self.paged
         b = self.kv.batch
         if stop_tokens is None:
