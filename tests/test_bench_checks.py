@@ -83,11 +83,12 @@ def test_chain_fold_shapes():
 
 def test_probe_failure_exits_zero_with_prior(tmp_path, monkeypatch):
     """A wedged tunnel must yield rc=0 + a JSON line carrying the prior
-    checkpoint: full table under extras.prior_run, and the headline
-    metric PROMOTED to the top-level fields but only with the explicit
-    from_prior_run label (a None value reads as "never measured" when a
-    real on-chip table exists — observed after the round-5 first
-    contact)."""
+    checkpoint: full table under extras.prior_run, the prior headline
+    surfaced under the DISTINCT prior_value field + a "(prior)"-labeled
+    metric, and the top-level value staying null — a label-blind
+    consumer reading metric/value must never mistake a stale number for
+    a fresh run (ADVICE r5 low re-tightened the old promote-into-value
+    contract)."""
     prior = tmp_path / "progress.json"
     prior.write_text(json.dumps(
         {"last_done": "ag_gemm", "ts": 0,
@@ -106,8 +107,10 @@ def test_probe_failure_exits_zero_with_prior(tmp_path, monkeypatch):
     with contextlib.redirect_stdout(buf):
         mod.main()
     out = json.loads(buf.getvalue().strip().splitlines()[-1])
-    assert out["value"] == 123.0                 # promoted...
-    assert out["from_prior_run"]["path"] == "progress.json"  # ...labeled
+    assert out["value"] is None                  # this run measured 0
+    assert out["prior_value"] == 123.0           # prior, labeled as such
+    assert out["metric"] == "ag_gemm_tflops (prior)"
+    assert out["from_prior_run"]["path"] == "progress.json"
     assert out["extras"]["probe_failed"] is True
     assert out["extras"]["prior_run"]["ag_gemm_tflops"] == 123.0
     assert "prior_run_age_s" in out["extras"]
@@ -142,7 +145,8 @@ def test_probe_failure_prior_ranking(tmp_path, monkeypatch):
     # despite being newest overall.
     assert out["extras"]["prior_run"] == {"tp_mlp_fused_ms": 3.0}
     assert out["extras"]["prior_run_n_measured"] == 1
-    assert out["value"] == 3.0 and out["metric"] == "tp_mlp_fused_ms"
+    assert out["value"] is None and out["prior_value"] == 3.0
+    assert out["metric"] == "tp_mlp_fused_ms (prior)"
     assert "from_prior_run" in out
 
 

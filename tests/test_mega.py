@@ -377,7 +377,9 @@ def test_engine_use_mega_guards(mesh8, key):
                       num_key_value_heads=8, head_dim=8, vocab_size=128,
                       max_position_embeddings=32, dtype=jnp.float32)
     model = DenseLLM(cfg, mesh=mesh8, axis="tp", impl="xla")
-    with pytest.raises(AssertionError, match="use_mega"):
+    # ValueError, not assert: the guard must survive ``python -O``
+    # (ADVICE r5 low).
+    with pytest.raises(ValueError, match="use_mega"):
         Engine(model, batch=2, max_seq=16, prefill_mode="sp",
                decode_mode="sp", use_mega=True)
     params = model.init(key)

@@ -318,15 +318,21 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         jnp.tile(jnp.arange(bq * n_pages, dtype=jnp.int32
                             ).reshape(1, bq, n_pages), (world, 1, 1)),
         P("tp"))
-    fd_paged = create_flash_decode_context(mesh, "tp", interpret=interpret)
+    # Pin "direct" explicitly: the context default is now "gathered"
+    # (production must not wedge on the un-root-caused direct compile
+    # hang), but THIS case exists precisely to keep monitoring that
+    # hang — it must stay on the direct block-table kernel.
+    import dataclasses as _dc
+    fd_paged = _dc.replace(
+        create_flash_decode_context(mesh, "tp", interpret=interpret),
+        paged_variant="direct")
     case("flash_decode/paged",
          lambda: gqa_fwd_batch_decode_paged(
              q, pool_k, pool_v, table,
              jnp.int32(world * n_pages * page // 2), fd_paged))
 
-    # Insurance path for the direct paged kernel's round-5 Mosaic
-    # compile hang: table-gather view + the proven dense tiled kernel.
-    import dataclasses as _dc
+    # The default path: table-gather view + the proven dense tiled
+    # kernel (the production paged route).
     fd_paged_g = _dc.replace(fd_paged, paged_variant="gathered")
     case("flash_decode/paged_gathered",
          lambda: gqa_fwd_batch_decode_paged(

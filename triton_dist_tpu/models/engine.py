@@ -16,11 +16,13 @@ triton_dist_gemm_ar (replicated small-batch decode).
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from triton_dist_tpu import obs
 from triton_dist_tpu.models.kv_cache import KVCacheManager
 
 
@@ -76,9 +78,13 @@ class Engine:
         # contact"). Uniform-offset decode only: no paged pools, no
         # per-row kv_start (serve_ragged) — those routes raise.
         self.use_mega = use_mega
-        if use_mega:
-            assert not paged and "sp" not in (prefill_mode, decode_mode), (
-                "use_mega serves the dense uniform-offset engine")
+        if use_mega and (paged or "sp" in (prefill_mode, decode_mode)):
+            # ValueError, not assert: user-facing configuration
+            # validation must survive ``python -O`` (ADVICE r5 low;
+            # matches the serve()/serve_stream() guards).
+            raise ValueError(
+                "use_mega serves the dense uniform-offset engine — "
+                "not paged/sp configurations")
         self._mega = None
         if "sp" in (prefill_mode, decode_mode):
             # Sequence-parallel serving (long context): both phases must
@@ -215,6 +221,16 @@ class Engine:
         b, s = input_ids.shape
         if gen_len <= 0:
             return input_ids
+        # Telemetry (docs/observability.md). ``tel`` gates every clock
+        # read and block_until_ready: with the default no-op registry
+        # the serve path pays a handful of no-op calls per CALL (not
+        # per token) and the decode loop's span is a shared null
+        # context manager.
+        tel = obs.enabled()
+        t_serve0 = time.perf_counter() if tel else 0.0
+        obs.counter("engine.serve_calls").inc()
+        obs.counter("engine.decode_path.mega" if self.use_mega
+                    else "engine.decode_path.plain").inc()
         if stop_tokens is None:
             eos = getattr(self.model.config, "eos_token_id", -1)
             stop_tokens = (eos,) if eos >= 0 else ()
@@ -247,6 +263,7 @@ class Engine:
         if self.prefill_mode == "sp":
             # SP serving has no ragged support (forward_sp's contract).
             assert not bool(kv_start.any()), "sp serving is non-ragged"
+        t_pre0 = time.perf_counter() if tel else 0.0
         chunk = self.prefill_chunk
         if chunk and self.prefill_mode == "sp" and s > chunk:
             # Cache-aware chunked prefill: activation memory is bounded
@@ -266,6 +283,15 @@ class Engine:
         self.kv.inc_offset(s)
         token = sample_token(logits[:, -1], self.key, self.temperature,
                              self.top_k, self.top_p)
+        if tel:
+            # Block so prefill/TTFT measure completed device work, not
+            # async dispatch — the observer cost of enabling telemetry.
+            jax.block_until_ready(token)
+            now = time.perf_counter()
+            obs.histogram("engine.prefill_ms").observe(
+                (now - t_pre0) * 1e3)
+            obs.histogram("engine.ttft_ms").observe(
+                (now - t_serve0) * 1e3)
 
         if self._decode_step is None:
             self._decode_step = self._build_decode_step()
@@ -278,21 +304,30 @@ class Engine:
         out = [input_ids, token[:, None]]
 
         def run_steps(n):
-            nonlocal token, caches, done, stopped
+            nonlocal token, caches, done, stopped, steps_run
             for i in range(n):
                 if stopped:
                     out.append(jnp.broadcast_to(
                         token[:, None], (b, n - i)).astype(token.dtype))
                     return
-                self.key, sub = jax.random.split(self.key)
-                off = jnp.int32(self.kv.offset)
-                if has_stop:
-                    token, caches, done = self._decode_step_stop(
-                        params, caches, token, off, sub, done, stop,
-                        kv_start, table)
-                else:
-                    token, caches = self._decode_step(
-                        params, caches, token, off, sub, kv_start, table)
+                with obs.span("engine.decode_step"):
+                    self.key, sub = jax.random.split(self.key)
+                    off = jnp.int32(self.kv.offset)
+                    if has_stop:
+                        token, caches, done = self._decode_step_stop(
+                            params, caches, token, off, sub, done, stop,
+                            kv_start, table)
+                    else:
+                        token, caches = self._decode_step(
+                            params, caches, token, off, sub, kv_start,
+                            table)
+                    if tel:
+                        # Block INSIDE the span so the histogram holds
+                        # real per-token device latency, not the ~µs
+                        # async enqueue — the per-step observer cost of
+                        # enabling telemetry (docs/observability.md).
+                        jax.block_until_ready(token)
+                steps_run += 1
                 self.kv.inc_offset(1)
                 out.append(token[:, None])
                 # the all-done check is a host sync; amortize it
@@ -300,6 +335,8 @@ class Engine:
                     stopped = True
 
         n_total = gen_len - 1
+        steps_run = 0
+        t_dec0 = time.perf_counter() if tel else 0.0
         if self.profile_dir and n_total > 1:
             from triton_dist_tpu.tools.profiler import group_profile
             # One REAL warm-up step before the window: it populates the
@@ -317,6 +354,18 @@ class Engine:
             run_steps(n_total - 1 - n_prof)
         else:
             run_steps(n_total)
+        if tel:
+            jax.block_until_ready(token)
+            dt = time.perf_counter() - t_dec0
+            # Real computed tokens only (first token + executed decode
+            # steps) — early-stopped rows' broadcast padding is NOT
+            # generation and must not inflate throughput.
+            obs.counter("engine.tokens_generated").inc(
+                b * (steps_run + 1))
+            if steps_run > 0 and dt > 0:
+                # Decode-loop throughput (excludes prefill + TTFT,
+                # which have their own histograms above).
+                obs.gauge("engine.tokens_per_s").set(b * steps_run / dt)
         return jnp.concatenate(out, axis=1)
 
 
@@ -431,6 +480,7 @@ class Engine:
                 "use_mega decodes uniform-offset batches only — "
                 "continuous batching runs every row at its own "
                 "cache offset; serve_stream needs use_mega=False")
+        obs.counter("engine.serve_stream_calls").inc()
         paged = self.paged
         b = self.kv.batch
         if stop_tokens is None:
@@ -538,6 +588,7 @@ class Engine:
                         first, caches = self._admit(
                             params, caches, ids, jnp.int32(len(prompt)),
                             jnp.int32(r), sub)
+                    obs.counter("engine.stream_admissions").inc()
                     row_req[r] = rid
                     row_budget[r] = gen_len
                     generated[rid] = []
@@ -551,9 +602,14 @@ class Engine:
         admit_free_rows()
         while any(rid is not None for rid in row_req):
             done = jnp.asarray([row_req[r] is None for r in range(b)])
-            self.key, sub = jax.random.split(self.key)
-            token, caches, offsets = self._stream_step(
-                params, caches, token, offsets, sub, done, cur_table)
+            with obs.span("engine.stream_step"):
+                self.key, sub = jax.random.split(self.key)
+                token, caches, offsets = self._stream_step(
+                    params, caches, token, offsets, sub, done, cur_table)
+                if obs.enabled():
+                    # Real step latency, not the async enqueue (same
+                    # observer cost as the serve() decode span).
+                    jax.block_until_ready(token)
             toks = np.asarray(token)
             for r in range(b):
                 if row_req[r] is not None:

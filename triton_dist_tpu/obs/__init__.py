@@ -1,0 +1,39 @@
+"""Telemetry subsystem: metrics registry + spans + exposition.
+
+The missing fourth observability leg next to ``tools/profiler``'s
+traces: process-local counters / gauges / fixed-bucket histograms
+(``obs.registry``), wall-clock spans that land in both a histogram and
+the xprof trace (``obs.span``), per-host snapshot merge mirroring the
+reference's rank-0 ``gather_object`` trace merge, and a Prometheus
+text exposition path served over the ModelServer protocol
+(``obs.exposition``). Disabled by default at zero hot-path cost; flip
+on with ``obs.enable()`` (the ModelServer does this at construction).
+
+See docs/observability.md for the metric name catalog.
+"""
+
+from triton_dist_tpu.obs.registry import (  # noqa: F401
+    DEFAULT_MS_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    NullRegistry,
+    Registry,
+    counter,
+    disable,
+    enable,
+    enabled,
+    gauge,
+    get_registry,
+    histogram,
+    record_comm,
+    reset,
+    set_registry,
+    snapshot,
+    span,
+)
+from triton_dist_tpu.obs.exposition import (  # noqa: F401
+    aggregate_across_hosts,
+    merge_snapshots,
+    render_prometheus,
+)

@@ -1,0 +1,142 @@
+"""Snapshot merging and metrics exposition.
+
+``merge_snapshots`` is the cross-host aggregation primitive: the
+reference gathers per-rank chrome traces with ``gather_object`` and
+merges JSON on rank 0 (utils.py:505-592); here the artifact is a plain
+metrics dict, so the merge is arithmetic — counters and histogram
+buckets sum, gauges take the max (they are point-in-time readings; max
+answers the capacity questions gauges exist for, e.g. peak in-flight).
+
+``render_prometheus`` turns a snapshot into Prometheus text exposition
+format (v0.0.4) so any scraper pointed at the serving host — via the
+server's ``{"cmd": "metrics", "format": "prometheus"}`` request — can
+ingest the numbers without a client library.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+from triton_dist_tpu.obs import registry as _registry
+
+__all__ = ["merge_snapshots", "render_prometheus",
+           "aggregate_across_hosts"]
+
+
+def merge_snapshots(snaps) -> dict:
+    """Merge per-host snapshot dicts into one (rank-0 aggregation).
+
+    Counters and histogram (counts, sum, count) add; gauges take the
+    max across hosts; histogram min/max combine. Histograms must share
+    bucket layouts (they do by construction — layouts are fixed at
+    metric creation); a mismatch raises ``ValueError``.
+    """
+    snaps = [s for s in snaps if s]
+    out = {"counters": {}, "gauges": {}, "histograms": {}}
+    for s in snaps:
+        for k, v in s.get("counters", {}).items():
+            out["counters"][k] = out["counters"].get(k, 0.0) + v
+        for k, v in s.get("gauges", {}).items():
+            out["gauges"][k] = (v if k not in out["gauges"]
+                                else max(out["gauges"][k], v))
+        for k, h in s.get("histograms", {}).items():
+            if k not in out["histograms"]:
+                out["histograms"][k] = {
+                    "buckets": list(h["buckets"]),
+                    "counts": list(h["counts"]),
+                    "sum": h["sum"], "count": h["count"],
+                    "min": h.get("min"), "max": h.get("max")}
+                continue
+            acc = out["histograms"][k]
+            if list(h["buckets"]) != acc["buckets"]:
+                raise ValueError(
+                    f"histogram {k!r}: bucket layouts differ across "
+                    f"hosts — {acc['buckets']} vs {list(h['buckets'])}")
+            acc["counts"] = [a + b
+                             for a, b in zip(acc["counts"], h["counts"])]
+            acc["sum"] += h["sum"]
+            acc["count"] += h["count"]
+            for key, pick in (("min", min), ("max", max)):
+                vals = [v for v in (acc.get(key), h.get(key))
+                        if v is not None]
+                acc[key] = pick(vals) if vals else None
+    return out
+
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    n = _NAME_RE.sub("_", name)
+    if prefix:
+        n = f"{prefix}_{n}"
+    if n[:1].isdigit():
+        n = "_" + n
+    return n
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def render_prometheus(snap: dict | None = None,
+                      prefix: str = "tdt") -> str:
+    """Render a snapshot (default: the active registry's) as Prometheus
+    text exposition. Counters get the ``_total`` suffix; histogram
+    buckets are emitted CUMULATIVE with ``le`` labels plus the
+    ``_sum`` / ``_count`` series, per the format spec."""
+    if snap is None:
+        snap = _registry.snapshot()
+    lines = []
+    for name in sorted(snap.get("counters", {})):
+        pn = _prom_name(name, prefix) + "_total"
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {_fmt(snap['counters'][name])}")
+    for name in sorted(snap.get("gauges", {})):
+        pn = _prom_name(name, prefix)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_fmt(snap['gauges'][name])}")
+    for name in sorted(snap.get("histograms", {})):
+        h = snap["histograms"][name]
+        pn = _prom_name(name, prefix)
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        for ub, c in zip(h["buckets"], h["counts"]):
+            cum += c
+            lines.append(f'{pn}_bucket{{le="{_fmt(ub)}"}} {cum}')
+        cum += h["counts"][len(h["buckets"])]
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{pn}_sum {_fmt(h['sum'])}")
+        lines.append(f"{pn}_count {h['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def aggregate_across_hosts(snap: dict | None = None) -> dict:
+    """Gather every host's snapshot and return the merged dict
+    (meaningful on rank 0; every rank returns the same merge).
+
+    The multi-host transport mirrors the reference's ``gather_object``:
+    each host contributes its JSON-encoded snapshot as a padded uint8
+    array through ``process_allgather``, rank 0's merge being plain
+    ``merge_snapshots``. Single-process (the CPU tier-1 mesh) returns
+    the local snapshot unchanged.
+    """
+    if snap is None:
+        snap = _registry.snapshot()
+    import jax
+    if jax.process_count() == 1:
+        return merge_snapshots([snap])
+    import numpy as np
+    from jax.experimental import multihost_utils
+    data = np.frombuffer(json.dumps(snap).encode(), np.uint8)
+    sizes = np.asarray(multihost_utils.process_allgather(
+        np.array([data.size], np.int64))).reshape(-1)
+    padded = np.zeros(int(sizes.max()), np.uint8)
+    padded[:data.size] = data
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    gathered = gathered.reshape(len(sizes), -1)
+    snaps = [json.loads(bytes(gathered[i, :int(sizes[i])]).decode())
+             for i in range(len(sizes))]
+    return merge_snapshots(snaps)

@@ -1,0 +1,400 @@
+"""Process-local metrics registry: counters, gauges, histograms, spans.
+
+The reference ships tracing as its only observability surface
+(``group_profile`` per-rank chrome traces, ``launch_metadata`` kernel
+annotations — python/triton_dist/utils.py:505-592); answering "what is
+the engine doing right now" requires attaching a profiler. This module
+adds the counting substrate underneath: a process-local registry of
+counters / gauges / fixed-bucket latency histograms that the engine,
+server, and collective wrappers record into, snapshot-able to a plain
+JSON-able dict (``snapshot``) and mergeable across hosts
+(``obs.exposition.merge_snapshots`` — the rank-0 ``gather_object``
+merge of the reference, collapsed to dict arithmetic).
+
+Zero overhead by default: the module-level registry starts as the
+:class:`NullRegistry`, whose metrics are shared no-op singletons and
+whose spans skip the clock entirely — instrumented hot paths (the
+engine decode loop) pay a couple of attribute lookups per *serve call*,
+not per token, until :func:`enable` swaps in a real :class:`Registry`.
+
+Semantics under ``jax.jit``: instrumentation runs in PYTHON, so a
+counter inside a jitted function increments at trace time — once per
+compilation, not per execution. Collective wrappers therefore count
+*dispatched program builds* (like the reference's per-launch
+``launch_metadata``), while the engine counts real wall-clock events
+because its loop drives the jitted step from Python.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+
+__all__ = [
+    "DEFAULT_MS_BUCKETS", "Counter", "Gauge", "Histogram", "Registry",
+    "NullRegistry", "enable", "disable", "enabled", "get_registry",
+    "set_registry", "counter", "gauge", "histogram", "snapshot",
+    "reset", "span", "record_comm",
+]
+
+#: Default latency buckets (milliseconds): sub-ms jit dispatch up to
+#: multi-second prefills. Upper bounds; an implicit +Inf bucket catches
+#: the tail.
+DEFAULT_MS_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                      100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+                      10000.0)
+
+
+class Counter:
+    """Monotonically increasing count (Prometheus counter semantics)."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name}: inc({amount}) < 0")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value (can go up and down)."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with sum/count/min/max.
+
+    ``buckets`` are inclusive upper bounds; observations above the last
+    bound land in the implicit +Inf bucket (``counts`` has
+    ``len(buckets) + 1`` entries). Bucket *layout is fixed at creation*
+    so per-host snapshots merge by plain elementwise addition.
+    """
+
+    __slots__ = ("name", "buckets", "_counts", "_sum", "_count", "_min",
+                 "_max", "_lock")
+
+    def __init__(self, name: str, lock: threading.Lock,
+                 buckets=DEFAULT_MS_BUCKETS):
+        if not buckets or list(buckets) != sorted(buckets):
+            raise ValueError(
+                f"histogram {name}: buckets must be ascending, non-empty")
+        self.name = name
+        self.buckets = tuple(float(b) for b in buckets)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._min = None
+        self._max = None
+        self._lock = lock
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+            if self._min is None or value < self._min:
+                self._min = value
+            if self._max is None or value > self._max:
+                self._max = value
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def to_dict(self) -> dict:
+        return {"buckets": list(self.buckets),
+                "counts": list(self._counts),
+                "sum": self._sum, "count": self._count,
+                "min": self._min, "max": self._max}
+
+
+class Registry:
+    """Thread-safe store of named metrics.
+
+    One lock serves both metric creation and updates: telemetry is
+    opt-in and its hot operations (a float add under the GIL + lock)
+    cost tens of nanoseconds — far below the jit-dispatch floor of the
+    paths it instruments.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def _check_free(self, name: str, kind: dict) -> None:
+        for store in (self._counters, self._gauges, self._histograms):
+            if store is not kind and name in store:
+                raise ValueError(
+                    f"metric {name!r} already registered as a different "
+                    f"type")
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            m = self._counters.get(name)
+            if m is None:
+                self._check_free(name, self._counters)
+                m = self._counters[name] = Counter(name, self._lock)
+        return m
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            m = self._gauges.get(name)
+            if m is None:
+                self._check_free(name, self._gauges)
+                m = self._gauges[name] = Gauge(name, self._lock)
+        return m
+
+    def histogram(self, name: str,
+                  buckets=DEFAULT_MS_BUCKETS) -> Histogram:
+        with self._lock:
+            m = self._histograms.get(name)
+            if m is None:
+                self._check_free(name, self._histograms)
+                m = self._histograms[name] = Histogram(
+                    name, self._lock, buckets)
+        return m
+
+    def snapshot(self) -> dict:
+        """Plain JSON-able dict of every metric's current value."""
+        with self._lock:
+            return {
+                "counters": {k: c._value
+                             for k, c in self._counters.items()},
+                "gauges": {k: g._value for k, g in self._gauges.items()},
+                "histograms": {k: h.to_dict()
+                               for k, h in self._histograms.items()},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+class _NullMetric:
+    """Shared no-op stand-in for every metric type."""
+
+    __slots__ = ()
+    name = "<null>"
+    value = 0.0
+    count = 0
+    sum = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        pass
+
+    def dec(self, amount: float = 1.0) -> None:
+        pass
+
+    def set(self, value: float) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+    def to_dict(self) -> dict:
+        return {}
+
+
+_NULL_METRIC = _NullMetric()
+
+
+class NullRegistry:
+    """The disabled-telemetry registry: every lookup returns the shared
+    no-op metric, snapshots are empty. This is the DEFAULT — hot paths
+    instrumented against it pay attribute lookups only."""
+
+    def counter(self, name: str) -> _NullMetric:
+        return _NULL_METRIC
+
+    def gauge(self, name: str) -> _NullMetric:
+        return _NULL_METRIC
+
+    def histogram(self, name: str, buckets=None) -> _NullMetric:
+        return _NULL_METRIC
+
+    def snapshot(self) -> dict:
+        return {"counters": {}, "gauges": {}, "histograms": {}}
+
+    def reset(self) -> None:
+        pass
+
+
+_NULL_REGISTRY = NullRegistry()
+_REGISTRY = _NULL_REGISTRY
+
+
+def get_registry():
+    return _REGISTRY
+
+
+def set_registry(registry) -> None:
+    global _REGISTRY
+    _REGISTRY = registry
+
+
+def enable(registry: Registry | None = None) -> Registry:
+    """Switch telemetry on. Idempotent: an already-active real registry
+    is kept (so a second subsystem enabling telemetry does not wipe the
+    first's counts); pass ``registry`` to replace it explicitly."""
+    global _REGISTRY
+    if registry is not None:
+        _REGISTRY = registry
+    elif _REGISTRY is _NULL_REGISTRY:
+        _REGISTRY = Registry()
+    return _REGISTRY
+
+
+def disable() -> None:
+    """Back to the zero-overhead no-op registry (counts are dropped)."""
+    global _REGISTRY
+    _REGISTRY = _NULL_REGISTRY
+
+
+def enabled() -> bool:
+    return _REGISTRY is not _NULL_REGISTRY
+
+
+def counter(name: str):
+    return _REGISTRY.counter(name)
+
+
+def gauge(name: str):
+    return _REGISTRY.gauge(name)
+
+
+def histogram(name: str, buckets=DEFAULT_MS_BUCKETS):
+    return _REGISTRY.histogram(name, buckets)
+
+
+def snapshot() -> dict:
+    return _REGISTRY.snapshot()
+
+
+def reset() -> None:
+    _REGISTRY.reset()
+
+
+# ---------------------------------------------------------------------------
+# Spans: wall-clock regions that land in a histogram AND in xprof.
+# ---------------------------------------------------------------------------
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """Times the enclosed region into ``<name>_ms`` and wraps it in
+    ``tools.profiler.annotate(name)`` so the SAME label shows up as a
+    named region in an xprof trace when one is being collected."""
+
+    __slots__ = ("_hist", "_name", "_t0", "_ann")
+
+    def __init__(self, hist, name: str):
+        self._hist = hist
+        self._name = name
+        self._ann = None
+
+    def __enter__(self):
+        from triton_dist_tpu.tools.profiler import annotate
+        self._ann = annotate(self._name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt_ms = (time.perf_counter() - self._t0) * 1e3
+        ann, self._ann = self._ann, None
+        try:
+            return ann.__exit__(*exc) if ann is not None else False
+        finally:
+            self._hist.observe(dt_ms)
+
+
+def span(name: str, buckets=DEFAULT_MS_BUCKETS):
+    """Context manager timing a region into histogram ``<name>_ms``.
+
+    Disabled telemetry returns a shared no-op (no clock read, no
+    annotation) — the form the engine decode loop relies on for its
+    zero-overhead-when-disabled contract."""
+    reg = _REGISTRY
+    if reg is _NULL_REGISTRY:
+        return _NULL_SPAN
+    return _Span(reg.histogram(name + "_ms", buckets), name)
+
+
+def record_comm(op: str, *arrays) -> None:
+    """Count one collective-wrapper invocation: ``comms.<op>.calls`` +=
+    1 and ``comms.<op>.bytes`` += the summed byte size of ``arrays``
+    (the global payload handed to the op).
+
+    Called from the ops-layer functional entries (all_gather,
+    reduce_scatter, all_reduce, fast_all_to_all, ag_gemm, gemm_rs,
+    gemm_ar). Under ``jax.jit`` these run at trace time, so the counts
+    are per program BUILD, not per device launch — see the module
+    docstring. Shapes are static, so tracers report sizes fine."""
+    reg = _REGISTRY
+    if reg is _NULL_REGISTRY:
+        return
+    nbytes = 0
+    for a in arrays:
+        size = getattr(a, "size", None)
+        dtype = getattr(a, "dtype", None)
+        if size is not None and dtype is not None:
+            try:
+                nbytes += int(size) * dtype.itemsize
+            except (TypeError, AttributeError):
+                pass
+    reg.counter(f"comms.{op}.calls").inc()
+    reg.counter(f"comms.{op}.bytes").inc(nbytes)

@@ -47,6 +47,7 @@ from triton_dist_tpu.ops.common import (
     maybe_noise,
     maybe_straggle,
     nestable_shard_map,
+    record_comm,
     resolve_interpret,
     sync_interpret)
 
@@ -215,6 +216,7 @@ def fast_all_to_all(send_buf: jax.Array, send_counts: jax.Array,
     """
     ctx = ctx or create_all_to_all_context()
     mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    record_comm("all_to_all", send_buf)
     capacity = ctx.capacity
     chunk = ctx.resolve_chunk(send_buf.dtype.itemsize)
     assert capacity % chunk == 0

@@ -42,6 +42,7 @@ from triton_dist_tpu.ops.common import (
     maybe_noise,
     maybe_straggle,
     nestable_shard_map,
+    record_comm,
     resolve_interpret,
     sync_interpret)
 
@@ -311,6 +312,7 @@ def all_gather(x: jax.Array, ctx: AllGatherContext | None = None,
     """
     ctx = ctx or create_allgather_context()
     mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    record_comm("allgather", x)
     assert x.shape[0] % world == 0, (x.shape, world)
     rows = x.shape[0] // world
     method = ctx.resolve_method(
@@ -379,6 +381,7 @@ def broadcast(x: jax.Array, root: int = 0,
     """
     ctx = ctx or create_allgather_context()
     mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    record_comm("broadcast", x)
     assert x.shape[0] % world == 0
     if not 0 <= root < world:
         raise ValueError(f"root {root} out of range for world {world}")

@@ -43,6 +43,7 @@ from triton_dist_tpu.ops.common import (
     maybe_noise,
     maybe_straggle,
     nestable_shard_map,
+    record_comm,
     resolve_interpret,
     sync_interpret)
 
@@ -575,6 +576,7 @@ def ag_gemm_multi(a: jax.Array, bs,
     """
     ctx = ctx or create_ag_gemm_context()
     mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    record_comm("ag_gemm", a)   # the gathered operand is the payload
     bs = list(bs)
     n_b = len(bs)
     m, k = a.shape
@@ -995,6 +997,7 @@ def ag_swiglu(a: jax.Array, w_gate: jax.Array, w_up: jax.Array,
         raise ValueError("ag_swiglu does not support return_gathered "
                          "(the gathered A is a workspace, not an output)")
     mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    record_comm("ag_swiglu", a)
     m, k = a.shape
     assert w_gate.shape == w_up.shape and w_gate.shape[0] == k
     assert w_gate.shape[1] % world == 0 and m % world == 0

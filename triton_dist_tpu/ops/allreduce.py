@@ -40,6 +40,7 @@ import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
     comm_params,
     nestable_shard_map,
+    record_comm,
     resolve_interpret,
     sync_interpret)
 
@@ -262,6 +263,7 @@ def all_reduce(x: jax.Array, ctx: AllReduceContext | None = None,
     """
     ctx = ctx or create_allreduce_context()
     mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    record_comm("allreduce", x)
     assert x.shape[0] == world, (x.shape, world)
     m, n = x.shape[1], x.shape[2]
     method = ctx.method

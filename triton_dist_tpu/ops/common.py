@@ -10,7 +10,45 @@ import jax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_dist_tpu import obs
+from triton_dist_tpu.obs import record_comm  # noqa: F401  (op entries)
 from triton_dist_tpu.runtime.platform import default_interpret
+
+
+# -- jax version compat -----------------------------------------------------
+# The library targets the current jax API
+# (jax.sharding.get_abstract_mesh/AxisType, pltpu.CompilerParams /
+# InterpretParams); jax 0.4.x has no abstract-mesh tracking and spells
+# the params pltpu.TPUCompilerParams. These helpers keep one compat
+# site per concept instead of hasattr checks at each use. (The
+# jax.shard_map check_vma→check_rep alias lives in the package
+# __init__ — tests and examples call it directly too.)
+
+def _abstract_mesh():
+    """Current thread's AbstractMesh, or None when this jax either has
+    no tracking (0.4.x) or reports an empty context."""
+    gam = getattr(jax.sharding, "get_abstract_mesh", None)
+    if gam is None:
+        return None
+    am = gam()
+    if am is None or getattr(am, "empty", True):
+        return None
+    return am
+
+
+def _manual_axis_flags(am) -> list[bool]:
+    """Per-axis is-Manual flags of an AbstractMesh; [] when this jax
+    does not expose axis types."""
+    axis_types = getattr(am, "axis_types", None)
+    manual = getattr(jax.sharding, "AxisType", None)
+    if axis_types is None or manual is None:
+        return []
+    return [t == manual.Manual for t in axis_types]
+
+
+# NOTE: jax.shard_map itself always exists here — the package __init__
+# installs a check_vma→check_rep translating alias on jax 0.4.x before
+# this module can load — so call sites use jax.shard_map directly.
 
 
 def resolve_interpret(interpret: bool | None):
@@ -36,10 +74,9 @@ def resolve_interpret(interpret: bool | None):
         # sharding-validation CHECK). All-Manual (called from inside a
         # kernel-level shard_map body) and empty (host) contexts are the
         # normal working paths.
-        am = jax.sharding.get_abstract_mesh()
-        if am is not None and not am.empty:
-            manual = [t == jax.sharding.AxisType.Manual
-                      for t in am.axis_types]
+        am = _abstract_mesh()
+        if am is not None:
+            manual = _manual_axis_flags(am)
             if any(manual) and not all(manual):
                 raise NotImplementedError(
                     "interpret-mode Pallas cannot run nested inside an "
@@ -49,7 +86,12 @@ def resolve_interpret(interpret: bool | None):
         from triton_dist_tpu.runtime.interpret_compat import (
             patch_interpreter_spin)
         patch_interpreter_spin()
-        return pltpu.InterpretParams(
+        interpret_params = getattr(pltpu, "InterpretParams", None)
+        if interpret_params is None:
+            # jax 0.4.x: no TPU-interpret parameter object (and no race
+            # detector) — plain interpret mode is the best available.
+            return True
+        return interpret_params(
             detect_races=bool(os.environ.get("TDT_DETECT_RACES")))
     return False
 
@@ -136,10 +178,27 @@ def comm_params(collective_id: int | None = 0,
     kwargs = dict(has_side_effects=True)
     if world != 1 and collective_id is not None:
         kwargs["collective_id"] = collective_id
-    kwargs["vmem_limit_bytes"] = (VMEM_LIMIT_BYTES
-                                  if vmem_limit_bytes is None
-                                  else vmem_limit_bytes)
-    return pltpu.CompilerParams(**kwargs)
+    limit = (VMEM_LIMIT_BYTES if vmem_limit_bytes is None
+             else vmem_limit_bytes)
+    kwargs["vmem_limit_bytes"] = limit
+    if obs.enabled():
+        # Requested-vs-declared VMEM gauges (docs/observability.md):
+        # the scoped limit each comm kernel asks Mosaic for, next to
+        # the declared-footprint budget/cap the tile choosers target —
+        # the pair whose confusion ADVICE r5 flagged.
+        obs.gauge("vmem.scoped_limit_bytes").set(limit)
+        obs.gauge("vmem.declared_budget_bytes").set(DEFAULT_VMEM_BUDGET)
+        obs.gauge("vmem.declared_cap_bytes").set(HARD_FOOTPRINT_CAP)
+    params_cls = getattr(pltpu, "CompilerParams", None)
+    if params_cls is None:
+        # jax 0.4.x name; it also lacks some fields (has_side_effects)
+        # — drop what it cannot carry rather than TypeError the whole
+        # kernel build.
+        import dataclasses
+        params_cls = pltpu.TPUCompilerParams
+        known = {f.name for f in dataclasses.fields(params_cls)}
+        kwargs = {k: v for k, v in kwargs.items() if k in known}
+    return params_cls(**kwargs)
 
 
 def maybe_straggle(straggler_option, axis: str, interpret=False) -> None:
@@ -220,9 +279,8 @@ def nestable_shard_map(fn, *, mesh=None, in_specs, out_specs,
     parallelism the reference delegates to torchrun replication
     (SURVEY.md §2.9 "DP: not a subsystem").
     """
-    am = jax.sharding.get_abstract_mesh()
-    if am is not None and not am.empty and any(
-            t == jax.sharding.AxisType.Manual for t in am.axis_types):
+    am = _abstract_mesh()
+    if am is not None and any(_manual_axis_flags(am)):
         mesh = am
     return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs, check_vma=check_vma)

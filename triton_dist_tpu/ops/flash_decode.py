@@ -86,12 +86,15 @@ class FlashDecodeContext:
     # Paged-KV kernel path: "direct" streams pages into the tiled
     # kernel via block-table indirection (one DMA per batch row per
     # tile); "gathered" reconstructs the contiguous per-device KV view
-    # with an XLA gather and runs the PROVEN dense tiled kernel — the
-    # insurance path while the direct kernel's round-5 Mosaic compile
-    # hang (tpu_smoke_r5_bulk.log: flash_decode/paged, >40 min) is
-    # open. The TDT_PAGED_VARIANT env var overrides the field so a
-    # deployment can flip paths without code changes.
-    paged_variant: str = "direct"
+    # with an XLA gather and runs the PROVEN dense tiled kernel.
+    # DEFAULT is "gathered" (ADVICE r5 medium): the direct kernel's
+    # round-5 on-chip Mosaic compile hang (tpu_smoke_r5_bulk.log:
+    # flash_decode/paged, >40 min) is still un-root-caused, and a
+    # production paged server must not wedge by default. "direct" is
+    # the opt-in — via this field or the TDT_PAGED_VARIANT env var,
+    # which overrides the field so a deployment can flip paths without
+    # code changes — until the hang is fixed.
+    paged_variant: str = "gathered"
 
     @property
     def world_size(self) -> int:

@@ -39,6 +39,7 @@ from triton_dist_tpu.ops.common import (
     cap_config_tiers,
     comm_params,
     nestable_shard_map,
+    record_comm,
     resolve_interpret,
     sync_interpret)
 
@@ -786,6 +787,7 @@ def gemm_rs(a: jax.Array, b: jax.Array,
     a: (M, K) column-sharded; b: (K, N) row-sharded. Returns (M, N)
     row-sharded (device i holds rows [i*M/w, (i+1)*M/w))."""
     ctx = ctx or create_gemm_rs_context()
+    record_comm("gemm_rs", a)   # the scattered partials' source operand
     return _entry(a, b, ctx, impl, all_gather_epilogue=False)
 
 
@@ -800,6 +802,7 @@ def gemm_ar(a: jax.Array, b: jax.Array,
     zero-padded to a ring-chunkable M and sliced back — the analog of the
     reference's tile-padded GEMM grids."""
     ctx = ctx or create_gemm_rs_context()
+    record_comm("gemm_ar", a)
     m = a.shape[0]
     world = ctx.world_size
     if m % world != 0:

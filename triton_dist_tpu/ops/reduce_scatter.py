@@ -36,6 +36,7 @@ import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
     comm_params,
     nestable_shard_map,
+    record_comm,
     resolve_interpret,
     sync_interpret)
 
@@ -205,6 +206,7 @@ def reduce_scatter(x: jax.Array, ctx: ReduceScatterContext | None = None,
     """
     ctx = ctx or create_reduce_scatter_context()
     mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
+    record_comm("reduce_scatter", x)
     assert x.shape[0] == world, (x.shape, world)
     m, n = x.shape[1], x.shape[2]
     assert m % world == 0

@@ -10,6 +10,16 @@ Protocol: newline-delimited JSON over TCP —
 
 ``stop_tokens`` is optional (default: the model config's eos).
 
+Telemetry (docs/observability.md): a metrics request on the same
+protocol returns the process-local registry snapshot —
+
+    → {"cmd": "metrics"}
+    ← {"metrics": {"counters": ..., "gauges": ..., "histograms": ...}}
+
+with ``"format": "prometheus"`` adding a ``prometheus`` text-exposition
+field for scrapers. Constructing a ModelServer enables the telemetry
+registry (``telemetry=False`` opts out).
+
 Text in/out (tokenizer round trip) is the client's job when a HF
 tokenizer is available; the server moves token ids only, like the
 reference's server.
@@ -26,6 +36,8 @@ import time
 import jax.numpy as jnp
 import numpy as np
 
+from triton_dist_tpu import obs
+
 
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
@@ -37,6 +49,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 req = json.loads(line)
                 resp = self.server.model_server._serve_request(req)
             except Exception as e:  # report, keep serving
+                obs.counter("server.errors").inc()
                 resp = {"error": repr(e)}
             self.wfile.write((json.dumps(resp) + "\n").encode())
             self.wfile.flush()
@@ -51,9 +64,13 @@ class ModelServer:
     """Wraps an Engine behind a TCP JSON-lines protocol."""
 
     def __init__(self, engine, params, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, telemetry: bool = True):
         self.engine = engine
         self.params = params
+        if telemetry:
+            # A serving process wants its numbers scrapeable; direct
+            # Engine users keep the zero-overhead no-op default.
+            obs.enable()
         self._lock = threading.Lock()  # one generation at a time
         self._srv = _TCPServer((host, port), _Handler)
         self._srv.model_server = self
@@ -61,6 +78,36 @@ class ModelServer:
         self._thread: threading.Thread | None = None
 
     def _serve_request(self, req: dict) -> dict:
+        if "cmd" in req:
+            return self._serve_command(req)
+        obs.counter("server.requests").inc()
+        obs.gauge("server.inflight").inc()
+        try:
+            return self._serve_generate(req)
+        finally:
+            obs.gauge("server.inflight").dec()
+
+    def _serve_command(self, req: dict) -> dict:
+        """Control-plane requests on the same JSON-lines protocol."""
+        cmd = req["cmd"]
+        if cmd == "metrics":
+            # Snapshot under the generation lock is NOT needed: the
+            # registry is internally locked, and a scraper must not
+            # queue behind a multi-second generation.
+            snap = obs.snapshot()
+            resp = {"metrics": snap}
+            if req.get("format") == "prometheus":
+                resp["prometheus"] = obs.render_prometheus(snap)
+            return resp
+        obs.counter("server.errors").inc()
+        return {"error": f"unknown cmd {cmd!r} (known: metrics)"}
+
+    def _serve_generate(self, req: dict) -> dict:
+        # Request clock starts BEFORE the generation lock: under load,
+        # queue wait is the dominant latency component and
+        # server.request_ms must show it (client-facing latency_ms
+        # keeps its original generation-only meaning).
+        t_req0 = time.perf_counter()
         prompts = req["prompt_ids"]
         gen_len = max(0, min(int(req.get("gen_len", 16)), 4096))
         stop = req.get("stop_tokens")  # None → engine default (eos)
@@ -106,6 +153,8 @@ class ModelServer:
                     stop_tokens=stop))
                 tokens = out[:, ids.shape[1]:].tolist()
             ms = (time.perf_counter() - t0) * 1e3
+        obs.histogram("server.request_ms").observe(
+            (time.perf_counter() - t_req0) * 1e3)
         return {"tokens": [trim(r) for r in tokens],
                 "latency_ms": round(ms, 3)}
 

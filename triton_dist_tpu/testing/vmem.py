@@ -41,16 +41,33 @@ from jax.experimental.pallas import tpu as pltpu
 # 128 MB physical VMEM) and Mosaic's scoped accounting carries ~2.2x of
 # window/staging overhead over the declared buffers (measured round-5:
 # 16.14 MB scoped for ~7.4 MB declared) — see the constants in
-# ops/common.py. Kept as this module's VMEM_LIMIT_BYTES name for
-# existing callers.
+# ops/common.py.
 from triton_dist_tpu.ops.common import HARD_FOOTPRINT_CAP
 
-__all__ = ["HARD_FOOTPRINT_CAP", "VmemBudgetError", "assert_vmem_within",
-           "check_entry_vmem"]
+__all__ = ["DECLARED_FOOTPRINT_CAP", "HARD_FOOTPRINT_CAP",
+           "VmemBudgetError", "assert_vmem_within", "check_entry_vmem"]
 
-#: Deprecated alias — prefer HARD_FOOTPRINT_CAP (ops/common.py holds the
-#: 64 MB Mosaic scoped limit under the unrelated name VMEM_LIMIT_BYTES).
-VMEM_LIMIT_BYTES = HARD_FOOTPRINT_CAP
+#: This module's name for the 26 MB declared-footprint cap. The old
+#: alias ``VMEM_LIMIT_BYTES`` collided with ``ops.common``'s UNRELATED
+#: 64 MB Mosaic scoped limit of the same name (2.5x apart — ADVICE r5);
+#: it survives only as a deprecation shim below.
+DECLARED_FOOTPRINT_CAP = HARD_FOOTPRINT_CAP
+
+
+def __getattr__(name):
+    if name == "VMEM_LIMIT_BYTES":
+        import warnings
+        warnings.warn(
+            "triton_dist_tpu.testing.vmem.VMEM_LIMIT_BYTES is "
+            "deprecated: it is the 26 MB DECLARED-footprint cap, NOT "
+            "ops.common.VMEM_LIMIT_BYTES (the 64 MB Mosaic scoped "
+            "limit). Use testing.vmem.DECLARED_FOOTPRINT_CAP (or "
+            "HARD_FOOTPRINT_CAP) for the former, ops.common."
+            "VMEM_LIMIT_BYTES for the latter.",
+            DeprecationWarning, stacklevel=2)
+        return DECLARED_FOOTPRINT_CAP
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
 
 
 class VmemBudgetError(AssertionError):

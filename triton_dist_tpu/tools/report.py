@@ -18,10 +18,23 @@ import sys
 
 
 def render_bench(d: dict) -> str:
-    ex = d.get("extras", {})
-    lines = [f"**{d.get('metric')}** = {d.get('value')} "
-             f"{d.get('unit', '')} (vs_baseline {d.get('vs_baseline')})",
-             ""]
+    ex = dict(d.get("extras", {}))
+    telemetry = ex.pop("telemetry", None)
+    if d.get("prior_value") is not None:
+        # Probe-failure lines keep value null and carry the last good
+        # run under explicitly-prior fields — render those, not a
+        # "None ... (vs_baseline None)" head.
+        prov = d.get("from_prior_run", {})
+        head = (f"**{d.get('metric')}** = (this run measured nothing) "
+                f"— prior_value {d['prior_value']} {d.get('unit', '')} "
+                f"(prior_vs_baseline {d.get('prior_vs_baseline')}, "
+                f"age {prov.get('age_s', '?')}s, "
+                f"{prov.get('path', '?')})")
+    else:
+        head = (f"**{d.get('metric')}** = {d.get('value')} "
+                f"{d.get('unit', '')} "
+                f"(vs_baseline {d.get('vs_baseline')})")
+    lines = [head, ""]
     groups: dict[str, dict] = {}
     for k, v in ex.items():
         op = k.split("_")[0] if "_" in k else k
@@ -31,6 +44,38 @@ def render_bench(d: dict) -> str:
     for op in sorted(groups):
         for k in sorted(groups[op]):
             lines.append(f"| {k} | {groups[op][k]} |")
+    if telemetry:
+        lines += ["", render_telemetry(telemetry)]
+    return "\n".join(lines)
+
+
+def render_telemetry(snap: dict) -> str:
+    """Render an obs snapshot (bench ``extras.telemetry`` / server
+    ``{"cmd": "metrics"}`` payload — docs/observability.md) as
+    markdown: one counters/gauges table, one histogram summary table."""
+    lines = ["### telemetry"]
+    scalars = [("counter", k, v)
+               for k, v in sorted(snap.get("counters", {}).items())]
+    scalars += [("gauge", k, v)
+                for k, v in sorted(snap.get("gauges", {}).items())]
+    if scalars:
+        lines += ["| metric | type | value |", "|---|---|---|"]
+        for kind, k, v in scalars:
+            vv = int(v) if float(v) == int(v) else round(float(v), 4)
+            lines.append(f"| {k} | {kind} | {vv} |")
+    hists = snap.get("histograms", {})
+    if hists:
+        lines += ["", "| histogram | count | mean | min | max |",
+                  "|---|---|---|---|---|"]
+        for k in sorted(hists):
+            h = hists[k]
+            n = h.get("count", 0)
+            mean = round(h["sum"] / n, 4) if n else None
+            lines.append(
+                f"| {k} | {n} | {mean} | {h.get('min')} | "
+                f"{h.get('max')} |")
+    if len(lines) == 1:
+        lines.append("(empty)")
     return "\n".join(lines)
 
 
