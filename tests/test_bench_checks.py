@@ -150,6 +150,146 @@ def test_probe_failure_prior_ranking(tmp_path, monkeypatch):
     assert "from_prior_run" in out
 
 
+def test_probe_failure_prior_ranking_prefers_tpu(tmp_path, monkeypatch):
+    """Device-kind-aware fallback (VERDICT r5 fact 1): a NEWER CPU
+    checkpoint must not outrank the same morning's TPU run —
+    BENCH_r05.json shipped a CPU checkpoint while TPU evidence existed
+    because the score was (has_measured, ts) only."""
+    tpu_run = tmp_path / "tpu_run.json"
+    tpu_run.write_text(json.dumps(
+        {"ts": 1000.0, "extras": {"device_kind": "TPU v5 lite",
+                                  "ag_gemm_tflops": 133.0}}))
+    cpu_newer = tmp_path / "cpu_newer.json"
+    cpu_newer.write_text(json.dumps(
+        {"ts": 2000.0, "extras": {"device_kind": "cpu",
+                                  "ag_gemm_tflops": 0.01}}))
+    mod = _load_bench()
+    mod._probe_backend_subprocess = lambda *_a, **_k: False
+    mod._fallback_scan_paths = lambda: [str(tpu_run), str(cpu_newer)]
+    monkeypatch.delenv("TDT_BENCH_CPU", raising=False)
+    monkeypatch.delenv("TDT_BENCH_ONLY", raising=False)
+    monkeypatch.delenv("TDT_BENCH_PARTS", raising=False)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        mod.main()
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["prior_value"] == 133.0          # the TPU run won
+    assert out["extras"]["prior_run_device_kind"] == "TPU v5 lite"
+    assert out["from_prior_run"]["path"] == "tpu_run.json"
+    # among same-kind checkpoints recency still wins
+    tpu_newer = tmp_path / "tpu_newer.json"
+    tpu_newer.write_text(json.dumps(
+        {"ts": 3000.0, "extras": {"device_kind": "TPU v5 lite",
+                                  "ag_gemm_tflops": 140.0}}))
+    mod._fallback_scan_paths = lambda: [str(tpu_run), str(cpu_newer),
+                                        str(tpu_newer)]
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        mod.main()
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["prior_value"] == 140.0
+
+
+# -- tools/bench_ops.py --regress (the quick-tier CI smoke) ----------------
+
+def _floors_file(tmp_path):
+    path = tmp_path / "BASELINE.json"
+    path.write_text(json.dumps({"regression_floors": {
+        "tpu": {"ag_gemm_vs_xla": 0.7, "gemm_rs_vs_xla": 0.78},
+        "cpu": {"ag_gemm_vs_xla": 0.001}}}))
+    return str(path)
+
+
+def test_regress_passes_and_fails(tmp_path):
+    from triton_dist_tpu.tools.bench_ops import (check_regression,
+                                                 load_floors)
+    floors = load_floors(_floors_file(tmp_path), "tpu")
+    ok = {"ag_gemm_vs_xla": 1.5, "gemm_rs_vs_xla": 0.78,
+          "baseline_anomaly": None}
+    assert check_regression(ok, floors) == []
+    bad = dict(ok, ag_gemm_vs_xla=0.5)
+    fails = check_regression(bad, floors)
+    assert any("ag_gemm_vs_xla" in f for f in fails)
+    # a missing metric fails too — the end-to-end assertion
+    missing = {"ag_gemm_vs_xla": 1.5}
+    assert any("missing" in f for f in check_regression(missing, floors))
+
+
+def test_regress_flags_baseline_anomaly(tmp_path):
+    """baseline_anomaly is machine-checked: when the same-matmul XLA
+    baselines disagree, every vs_xla ratio is untrustworthy and the
+    gate must fail regardless of the ratios themselves."""
+    from triton_dist_tpu.tools.bench_ops import (check_regression,
+                                                 load_floors)
+    floors = load_floors(_floors_file(tmp_path), "tpu")
+    ex = {"ag_gemm_vs_xla": 1.5, "gemm_rs_vs_xla": 1.0,
+          "baseline_anomaly": ["ag vs rs: 2.37x apart"]}
+    fails = check_regression(ex, floors)
+    assert any("anomaly" in f for f in fails)
+
+
+def test_regress_cli_end_to_end(tmp_path, capsys):
+    """The harness runs end to end from a bench checkpoint file — the
+    CPU-only smoke wiring (relaxed cpu floors, exit code contract)."""
+    from triton_dist_tpu.tools import bench_ops
+    baseline = _floors_file(tmp_path)
+    ckpt = tmp_path / "ckpt.json"
+    ckpt.write_text(json.dumps(
+        {"ts": 1, "extras": {"device_kind": "cpu",
+                             "ag_gemm_vs_xla": 0.4,
+                             "baseline_anomaly": None}}))
+    rc = bench_ops.main(["--regress", "--from", str(ckpt),
+                         "--baseline", baseline])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0 and report["tier"] == "cpu"
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(
+        {"extras": {"device_kind": "TPU v5 lite",
+                    "ag_gemm_vs_xla": 0.2, "gemm_rs_vs_xla": 0.9,
+                    "baseline_anomaly": None}}))
+    rc = bench_ops.main(["--regress", "--from", str(bad),
+                        "--baseline", baseline])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["tier"] == "tpu" and report["failures"]
+
+
+def test_regress_live_sweep_filters_unswept_floors(tmp_path, monkeypatch,
+                                                   capsys):
+    """Live-sweep mode checks only the floors its sweeps can produce
+    (bench.py-only metrics like tp_mlp_vs_xla apply to --from
+    checkpoints) — otherwise the missing-key-fails contract would make
+    the live TPU gate structurally unpassable (review finding)."""
+    from triton_dist_tpu.tools import bench_ops
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(json.dumps({"regression_floors": {
+        "tpu": {"ag_gemm_vs_xla": 0.7, "tp_mlp_vs_xla": 0.45}}}))
+    monkeypatch.setattr(bench_ops, "_init_mesh", lambda: (None, 1))
+    monkeypatch.setattr(bench_ops, "_is_tpu", lambda: True)
+    monkeypatch.setattr(
+        bench_ops, "_extras_from_sweep",
+        lambda *a: {"ag_gemm_vs_xla": 1.5, "gemm_rs_vs_xla": 1.0,
+                    "flash_decode_vs_xla": 1.0, "baseline_anomaly": None})
+    rc = bench_ops.main(["--regress", "--baseline", str(baseline)])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["floors_skipped_not_swept"] == ["tp_mlp_vs_xla"]
+    assert "tp_mlp_vs_xla" not in report["floors"]
+
+
+def test_repo_baseline_floors_wellformed():
+    """The checked-in BASELINE.json floor file parses and carries both
+    tiers with the keys the bench actually emits."""
+    from triton_dist_tpu.tools.bench_ops import load_floors
+    path = str(_ROOT / "BASELINE.json")
+    tpu = load_floors(path, "tpu")
+    cpu = load_floors(path, "cpu")
+    assert {"ag_gemm_vs_xla", "gemm_rs_vs_xla"} <= set(tpu)
+    assert all(isinstance(v, (int, float)) for v in tpu.values())
+    # cpu floors are the end-to-end smoke: near-zero by design
+    assert all(v <= 0.01 for v in cpu.values())
+
+
 def test_bench_parts_typo_fails_before_checkpoint(tmp_path, monkeypatch):
     """A typo'd TDT_BENCH_PARTS must SystemExit before the checkpoint
     clear — prior evidence survives (review r5a-2)."""

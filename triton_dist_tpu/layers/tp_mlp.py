@@ -33,12 +33,21 @@ from triton_dist_tpu.ops.autodiff import (ag_swiglu, gemm_rs, gemm_ar)
 
 
 class TPMLP:
-    """SwiGLU MLP: ``down( silu(x@gate) * (x@up) )`` under TP."""
+    """SwiGLU MLP: ``down( silu(x@gate + bg) * (x@up + bu) + bd )``
+    under TP.
+
+    ``use_bias=True`` adds gate/up/down biases; on the fused paths the
+    gate/up biases ride INSIDE the AG-SwiGLU kernel's epilogue (the
+    whole bias + activation epilogue fused into the consumer tile loop
+    — no extra HBM round trip) and the down bias is one cheap add after
+    the reduce. The biased fused forward goes through the raw Pallas op
+    (inference path); training with biases uses the differentiable
+    ``xla``/``xla_ar`` modes."""
 
     def __init__(self, hidden_size: int, intermediate_size: int,
                  mesh: Mesh | None = None, axis: str = "tp",
                  dtype=jnp.bfloat16, fwd_mode: str = "ag_rs",
-                 impl: str = "pallas"):
+                 impl: str = "pallas", use_bias: bool = False):
         if mesh is None:
             from triton_dist_tpu.runtime.dist import get_mesh
             mesh = get_mesh()
@@ -48,6 +57,7 @@ class TPMLP:
         self.dtype = dtype
         self.fwd_mode = fwd_mode
         self.impl = impl
+        self.use_bias = use_bias
         world = mesh.shape[axis]
         assert intermediate_size % world == 0
         assert hidden_size % world == 0
@@ -69,15 +79,24 @@ class TPMLP:
             "w_up": jax.random.normal(ku, (h, i), self.dtype) * scale,
             "w_down": jax.random.normal(kd, (i, h), self.dtype) * (i ** -0.5),
         }
+        if self.use_bias:
+            params["b_gate"] = jnp.zeros((i,), self.dtype)
+            params["b_up"] = jnp.zeros((i,), self.dtype)
+            params["b_down"] = jnp.zeros((h,), self.dtype)
         return self.shard_params(params)
 
     def shard_params(self, params: dict) -> dict:
         m, ax = self.mesh, self.axis
-        return {
+        out = {
             "w_gate": shard_param(params["w_gate"], m, P(None, ax)),
             "w_up": shard_param(params["w_up"], m, P(None, ax)),
             "w_down": shard_param(params["w_down"], m, P(ax, None)),
         }
+        if "b_gate" in params:
+            out["b_gate"] = shard_param(params["b_gate"], m, P(ax))
+            out["b_up"] = shard_param(params["b_up"], m, P(ax))
+            out["b_down"] = shard_param(params["b_down"], m, P())
+        return out
 
     # -- forwards ----------------------------------------------------------
     def __call__(self, params: dict, x: jax.Array,
@@ -95,45 +114,89 @@ class TPMLP:
             return self._xla_ar_fwd(params, x)
         raise ValueError(f"unknown fwd mode {mode!r}")
 
+    def _has_bias(self, params) -> bool:
+        return self.use_bias and "b_gate" in params
+
+    def _add_down_bias(self, y, params):
+        if not self._has_bias(params):
+            return y
+        return (y.astype(jnp.float32)
+                + params["b_down"].astype(jnp.float32)).astype(y.dtype)
+
     def _fused_fwd(self, params, x, reduce: str):
+        bias = self._has_bias(params)
         if reduce == "rs":
-            # One kernel for AG + gate/up GEMMs + SwiGLU: the (M, 2*I/w)
-            # intermediate never touches HBM (chip bench r3: the
-            # 3-dispatch version measured 0.77x of XLA's fused program
-            # at world=1).
-            act = ag_swiglu(x, params["w_gate"], params["w_up"],
-                            self.ag_ctx, impl=self.impl)
-            return gemm_rs(act, params["w_down"], self.rs_ctx,
-                           impl=self.impl)
+            # One kernel for AG + gate/up GEMMs + bias + SwiGLU: the
+            # (M, 2*I/w) intermediate never touches HBM (chip bench r3:
+            # the 3-dispatch version measured 0.77x of XLA's fused
+            # program at world=1). With biases the raw fused op carries
+            # the whole epilogue (inference path — the autodiff wrapper
+            # stays bias-free).
+            if bias:
+                from triton_dist_tpu.ops.allgather_gemm import (
+                    ag_swiglu as raw_ag_swiglu)
+                act = raw_ag_swiglu(x, params["w_gate"], params["w_up"],
+                                    self.ag_ctx, impl=self.impl,
+                                    b_gate=params["b_gate"],
+                                    b_up=params["b_up"])
+            else:
+                act = ag_swiglu(x, params["w_gate"], params["w_up"],
+                                self.ag_ctx, impl=self.impl)
+            return self._add_down_bias(
+                gemm_rs(act, params["w_down"], self.rs_ctx,
+                        impl=self.impl), params)
         gate = col_parallel_matmul(x, params["w_gate"], self.mesh,
                                    self.axis)
         up = col_parallel_matmul(x, params["w_up"], self.mesh, self.axis)
+        if bias:
+            gate = gate + params["b_gate"][None, :].astype(gate.dtype)
+            up = up + params["b_up"][None, :].astype(up.dtype)
         act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-        return gemm_ar(act, params["w_down"], self.rs_ctx, impl=self.impl)
+        return self._add_down_bias(
+            gemm_ar(act, params["w_down"], self.rs_ctx, impl=self.impl),
+            params)
 
     def _xla_fwd(self, params, x):
         """shard_map golden with the ag_rs layout (row-sharded x)."""
         axis = self.axis
+        bias = self._has_bias(params)
 
-        def body(xs, wg, wu, wd):
+        def body(xs, wg, wu, wd, *bs):
             ag = lax.all_gather(xs, axis, tiled=True)
             gate = jnp.dot(ag, wg, preferred_element_type=jnp.float32)
             up = jnp.dot(ag, wu, preferred_element_type=jnp.float32)
+            if bs:
+                gate = gate + bs[0][None, :].astype(jnp.float32)
+                up = up + bs[1][None, :].astype(jnp.float32)
             act = (jax.nn.silu(gate) * up).astype(xs.dtype)
-            part = jnp.dot(act, wd, preferred_element_type=jnp.float32
-                           ).astype(xs.dtype)
+            part = jnp.dot(act, wd, preferred_element_type=jnp.float32)
+            if bs:
+                # psum_scatter sums w copies; pre-divide so the
+                # replicated bias lands exactly once.
+                part = part + (bs[2][None, :].astype(jnp.float32)
+                               / lax.axis_size(axis))
+            part = part.astype(xs.dtype)
             return lax.psum_scatter(part, axis, scatter_dimension=0,
                                     tiled=True)
+
+        bias_args = ((params["b_gate"], params["b_up"], params["b_down"])
+                     if bias else ())
         f = nestable_shard_map(
             body, mesh=self.mesh,
-            in_specs=(P(axis), P(None, axis), P(None, axis), P(axis)),
+            in_specs=(P(axis), P(None, axis), P(None, axis), P(axis))
+            + ((P(axis), P(axis), P()) if bias else ()),
             out_specs=P(axis), check_vma=False)
-        return f(x, params["w_gate"], params["w_up"], params["w_down"])
+        return f(x, params["w_gate"], params["w_up"], params["w_down"],
+                 *bias_args)
 
     def _xla_ar_fwd(self, params, x):
         """Replicated-activation golden (reference torch_fwd NCCL AR)."""
         gate = col_parallel_matmul(x, params["w_gate"], self.mesh, self.axis)
         up = col_parallel_matmul(x, params["w_up"], self.mesh, self.axis)
+        if self._has_bias(params):
+            gate = gate + params["b_gate"][None, :].astype(gate.dtype)
+            up = up + params["b_up"][None, :].astype(up.dtype)
         act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-        return row_parallel_matmul_ar(act, params["w_down"], self.mesh,
-                                      self.axis)
+        return self._add_down_bias(
+            row_parallel_matmul_ar(act, params["w_down"], self.mesh,
+                                   self.axis), params)

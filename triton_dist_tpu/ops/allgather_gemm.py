@@ -37,6 +37,7 @@ import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
     DEFAULT_VMEM_BUDGET,
     HARD_FOOTPRINT_CAP,
+    TUNED_VMEM_BUDGET,
     any_spec,
     cap_config_tiers,
     comm_params,
@@ -44,7 +45,11 @@ from triton_dist_tpu.ops.common import (
     maybe_straggle,
     nestable_shard_map,
     record_comm,
+    record_overlap,
     resolve_interpret,
+    resolve_ring_dirs,
+    ring_chunk_schedule,
+    ring_hop_counts,
     sync_interpret)
 
 
@@ -88,6 +93,12 @@ class AllGatherGEMMContext:
     # matmul_get_configs, allgather_gemm.py:396); jitted calls reuse the
     # shape-keyed cache.
     autotune: bool = False
+    # Ring directions for the fused AG schedule: 2 = bidirectional
+    # (chunks travel the shorter way round, both full-duplex ICI links
+    # active — the ops/allgather.py RING_BIDIR win the fused ops never
+    # had), 1 = the unidirectional proven-on-chip fallback, 0 = consult
+    # TDT_RING_DIRS (default 2).
+    ring_dirs: int = 0
     # Correctness-debug injection (reference for_correctness sleeps
     # allgather_gemm.py:507-508 and straggler_option): see ops/common.py.
     straggler_option: tuple[int, int] | None = None
@@ -120,15 +131,93 @@ def create_ag_gemm_context(mesh: Mesh | None = None, axis: str = "tp",
                                 return_gathered=return_gathered)
 
 
+def _make_ring(chunk_ref, me, axis: str, world: int, dirs: int,
+               send_sem, recv_sem):
+    """Ring bookkeeping for the rank-rotated AG consumption schedule,
+    shared by every fused AG-GEMM kernel.
+
+    ``chunk_ref(idx)`` returns the workspace slice of chunk ``idx``;
+    semaphores are per (direction, chunk) — delivery is not FIFO, and a
+    fast neighbor may run several hops ahead (same hazard note as
+    ``ops/allgather._ring_ag_kernel``). With ``dirs=2`` the forward
+    ring (rightward sends) carries chunks me-1..me-n_fwd and the
+    backward ring (leftward) me+1..me+n_bwd, halving the hop count on
+    the full-duplex ICI links; ``dirs=1`` reproduces the round-5
+    proven unidirectional schedule exactly.
+
+    Returns ``(chunk_of, advance, drain)``: ``chunk_of(s)`` is the
+    chunk consumed at schedule position s; ``advance(s)`` waits for
+    position s's arrival and keeps it travelling onward (position 0
+    launches the local chunk both ways — each later hop then overlaps
+    a whole chunk's compute); ``drain()`` waits out the send
+    semaphores before the kernel retires.
+    """
+    right = lax.rem(me + 1, world)
+    left = lax.rem(me - 1 + world, world)
+    n_fwd, n_bwd = ring_hop_counts(world, dirs)
+
+    def chunk_copy(idx, d):
+        peer = jnp.where(jnp.asarray(d) == 1, left, right)
+        ref = chunk_ref(idx)
+        return dl.remote_copy(ref, ref, peer, send_sem.at[d, idx],
+                              recv_sem.at[d, idx], axis=axis)
+
+    def chunk_of(s):
+        return ring_chunk_schedule(me, s, world, dirs)[0]
+
+    def advance(s):
+        if world == 1:
+            return
+        chunk, is_bwd, off = ring_chunk_schedule(me, s, world, dirs)
+        s = jnp.asarray(s, jnp.int32)
+        d = is_bwd.astype(jnp.int32)
+
+        @pl.when(s == 0)
+        def _():
+            if n_fwd > 0:
+                chunk_copy(me, 0).start()
+            if n_bwd > 0:
+                chunk_copy(me, 1).start()
+
+        @pl.when((s > 0) & (s < world))
+        def _():
+            chunk_copy(chunk, d).wait_recv()   # the reference dl.wait
+            onward = jnp.where(is_bwd, off < n_bwd, off < n_fwd)
+
+            @pl.when(onward)
+            def _():
+                chunk_copy(chunk, d).start()
+
+    def drain():
+        if world == 1:
+            return
+
+        def wait_one(s, _):
+            @pl.when(s < n_fwd)
+            def _():
+                chunk_copy(lax.rem(me - s + world, world), 0).wait_send()
+            if n_bwd > 0:
+                @pl.when(s < n_bwd)
+                def _():
+                    chunk_copy(lax.rem(me + s, world), 1).wait_send()
+            return _
+
+        lax.fori_loop(0, max(n_fwd, n_bwd), wait_one, None)
+
+    return chunk_of, advance, drain
+
+
 def _ag_gemm_kernel(x_ref, *rest, axis: str, world: int, rows: int,
-                    acc_dtype, n_b: int, straggler_option=None,
+                    acc_dtype, n_b: int, dirs: int = 1,
+                    straggler_option=None,
                     for_correctness=False, interp=False):
     """Ring AG of A chunks fused with per-chunk GEMM(s).
 
-    Per step: start forwarding the freshest chunk (DMA on ICI), then run
-    the MXU on it (overlap), then wait for the next chunk's arrival — the
-    wait is the reference's ``dl.wait(ready_ptr + rank, ...)``
-    (allgather_gemm.py:236).
+    Per step: the chunk-boundary ``advance`` waits for the chunk's
+    arrival and immediately keeps it travelling (DMA on ICI), then the
+    MXU runs on it (overlap) — the wait is the reference's
+    ``dl.wait(ready_ptr + rank, ...)`` (allgather_gemm.py:236). With
+    ``dirs=2`` chunks ride both ICI directions (``_make_ring``).
 
     Supports ``n_b`` weight matrices sharing the gathered A (one AG feeding
     several GEMMs — the QKV / gate+up projections of a TP transformer
@@ -140,19 +229,12 @@ def _ag_gemm_kernel(x_ref, *rest, axis: str, world: int, rows: int,
     c_refs = rest[n_b + 1:2 * n_b + 1]
     send_sem, recv_sem = rest[2 * n_b + 1:2 * n_b + 3]
     me = lax.axis_index(axis)
-    right = lax.rem(me + 1, world)
 
     ag_ref[pl.ds(me * rows, rows), :] = x_ref[:]
     if world > 1:
         dl.barrier_all(axis)
         maybe_straggle(straggler_option, axis, interp)
         maybe_noise(for_correctness, axis, world, salt=3, interpret=interp)
-
-    def chunk_copy(idx):
-        return dl.remote_copy(
-            ag_ref.at[pl.ds(idx * rows, rows), :],
-            ag_ref.at[pl.ds(idx * rows, rows), :],
-            right, send_sem.at[idx], recv_sem.at[idx], axis=axis)
 
     def gemm_chunk(idx):
         for w_ref, c_ref in zip(w_refs, c_refs):
@@ -164,34 +246,27 @@ def _ag_gemm_kernel(x_ref, *rest, axis: str, world: int, rows: int,
         gemm_chunk(me)
         return
 
+    chunk_of, advance, drain = _make_ring(
+        lambda idx: ag_ref.at[pl.ds(idx * rows, rows), :], me, axis,
+        world, dirs, send_sem, recv_sem)
+
+    advance(0)
+
     def step(s, _):
-        cur = lax.rem(me - s + world, world)
-        nxt = lax.rem(me - s - 1 + world, world)
-
-        @pl.when(s < world - 1)
-        def _():
-            chunk_copy(cur).start()       # forward current chunk (ICI)
-        gemm_chunk(cur)                   # MXU on current chunk (overlap)
-
-        @pl.when(s < world - 1)
-        def _():
-            chunk_copy(nxt).wait_recv()   # next chunk must have landed
+        gemm_chunk(chunk_of(s))           # MXU on current chunk
+        advance(s + 1)                    # next chunk: wait + forward
         return _
 
     lax.fori_loop(0, world, step, None)
-
-    def drain(s, _):
-        chunk_copy(lax.rem(me - s + world, world)).wait_send()
-        return _
-
-    lax.fori_loop(0, world - 1, drain, None)
+    drain()
 
 
 def _ag_gemm_hbm_nb_kernel(x_hbm, b_hbm, ag_hbm, c_hbm, a_tile, b_panel,
                            c_stage, copy_sem, a_sem, b_sem, c_sem,
                            send_sem, recv_sem, *, axis: str, world: int,
                            rows: int, k: int, n_loc: int, m_blk: int,
-                           n_blk: int, acc_dtype, straggler_option=None,
+                           n_blk: int, acc_dtype, dirs: int = 1,
+                           straggler_option=None,
                            for_correctness=False, interp=False):
     """N-blocked HBM AG-GEMM: resident B panel, full-K MXU dots.
 
@@ -207,7 +282,6 @@ def _ag_gemm_hbm_nb_kernel(x_hbm, b_hbm, ag_hbm, c_hbm, a_tile, b_panel,
     preserved (reference swizzle allgather_gemm.py:221-229).
     """
     me = lax.axis_index(axis)
-    right = lax.rem(me + 1, world)
     m_tiles = rows // m_blk
     n_blocks = n_loc // n_blk
     per_nb = world * m_tiles       # iterations per N-block
@@ -223,18 +297,16 @@ def _ag_gemm_hbm_nb_kernel(x_hbm, b_hbm, ag_hbm, c_hbm, a_tile, b_panel,
         maybe_straggle(straggler_option, axis, interp)
         maybe_noise(for_correctness, axis, world, salt=4, interpret=interp)
 
+    chunk_of, advance, ring_drain = _make_ring(
+        lambda idx: ag_hbm.at[pl.ds(idx * rows, rows), :], me, axis,
+        world, dirs, send_sem, recv_sem)
+
     def chunk_idx(i):
-        return lax.rem(me - lax.rem(i, per_nb) // m_tiles + world, world)
+        return chunk_of(lax.rem(i, per_nb) // m_tiles)
 
     def row_of(i):
         mt = lax.rem(i, m_tiles)
         return chunk_idx(i) * rows + mt * m_blk
-
-    def chunk_copy(idx):
-        return dl.remote_copy(
-            ag_hbm.at[pl.ds(idx * rows, rows), :],
-            ag_hbm.at[pl.ds(idx * rows, rows), :],
-            right, send_sem.at[idx], recv_sem.at[idx], axis=axis)
 
     def a_dma(slot, i):
         return pltpu.make_async_copy(
@@ -260,15 +332,7 @@ def _ag_gemm_hbm_nb_kernel(x_hbm, b_hbm, ag_hbm, c_hbm, a_tile, b_panel,
 
         @pl.when((i < per_nb) & (lax.rem(i, m_tiles) == 0))
         def _():
-            s = i // m_tiles
-
-            @pl.when(s > 0)
-            def _():
-                chunk_copy(chunk_idx(i)).wait_recv()
-
-            @pl.when(s < world - 1)
-            def _():
-                chunk_copy(chunk_idx(i)).start()
+            advance(i // m_tiles)
 
     ring_advance(0)
     b_dma(0, 0).start()
@@ -308,19 +372,15 @@ def _ag_gemm_hbm_nb_kernel(x_hbm, b_hbm, ag_hbm, c_hbm, a_tile, b_panel,
     for i_last in range(max(0, total - 2), total):
         c_dma(i_last % 2, i_last).wait()
 
-    if world > 1:
-        def drain(s, _):
-            chunk_copy(lax.rem(me - s + world, world)).wait_send()
-            return _
-        lax.fori_loop(0, world - 1, drain, None)
+    ring_drain()
 
 
 def _ag_gemm_hbm_kernel(x_hbm, b_hbm, ag_hbm, c_hbm, a_tile, b_tile, acc,
                         c_stage, copy_sem, a_sem, b_sem, c_sem, send_sem,
                         recv_sem, *, axis: str, world: int, rows: int,
                         k: int, k_blk: int, m_blk: int, acc_dtype,
-                        straggler_option=None, for_correctness=False,
-                        interp=False):
+                        dirs: int = 1, straggler_option=None,
+                        for_correctness=False, interp=False):
     """HBM-resident ring AG-GEMM: operands never fully enter VMEM.
 
     Ring protocol identical to ``_ag_gemm_kernel`` (per-chunk DMA
@@ -333,7 +393,6 @@ def _ag_gemm_hbm_kernel(x_hbm, b_hbm, ag_hbm, c_hbm, a_tile, b_tile, acc,
     tile DMA pipeline; rank-rotated consumption order is preserved.
     """
     me = lax.axis_index(axis)
-    right = lax.rem(me + 1, world)
     k_tiles = k // k_blk
     m_tiles = rows // m_blk
     per_chunk = m_tiles * k_tiles
@@ -349,19 +408,17 @@ def _ag_gemm_hbm_kernel(x_hbm, b_hbm, ag_hbm, c_hbm, a_tile, b_tile, acc,
         maybe_straggle(straggler_option, axis, interp)
         maybe_noise(for_correctness, axis, world, salt=5, interpret=interp)
 
+    chunk_pos, advance, ring_drain = _make_ring(
+        lambda idx: ag_hbm.at[pl.ds(idx * rows, rows), :], me, axis,
+        world, dirs, send_sem, recv_sem)
+
     def chunk_of(i):
-        return lax.rem(me - i // per_chunk + world, world)
+        return chunk_pos(i // per_chunk)
 
     def row_of(i):
         """First AG row of iteration i's (chunk, m-tile)."""
         mt = lax.rem(i, per_chunk) // k_tiles
         return chunk_of(i) * rows + mt * m_blk
-
-    def chunk_copy(idx):
-        return dl.remote_copy(
-            ag_hbm.at[pl.ds(idx * rows, rows), :],
-            ag_hbm.at[pl.ds(idx * rows, rows), :],
-            right, send_sem.at[idx], recv_sem.at[idx], axis=axis)
 
     def a_dma(slot, i):
         return pltpu.make_async_copy(
@@ -382,18 +439,12 @@ def _ag_gemm_hbm_kernel(x_hbm, b_hbm, ag_hbm, c_hbm, a_tile, b_tile, acc,
         """At chunk boundary j: ensure the chunk has arrived, then keep it
         moving round the ring — the forward overlaps this whole chunk's
         tile compute."""
-        s = j // per_chunk
+        if world == 1:
+            return
 
         @pl.when((j < total) & (lax.rem(j, per_chunk) == 0))
         def _():
-            if world > 1:
-                @pl.when(s > 0)
-                def _():
-                    chunk_copy(chunk_of(j)).wait_recv()
-
-                @pl.when(s < world - 1)
-                def _():
-                    chunk_copy(chunk_of(j)).start()
+            advance(j // per_chunk)
 
     ring_advance(0)
     a_dma(0, 0).start()
@@ -445,11 +496,7 @@ def _ag_gemm_hbm_kernel(x_hbm, b_hbm, ag_hbm, c_hbm, a_tile, b_tile, acc,
     for s in range(min(2, world * m_tiles)):
         c_dma(s, 0).wait()
 
-    if world > 1:
-        def drain(s, _):
-            chunk_copy(lax.rem(me - s + world, world)).wait_send()
-            return _
-        lax.fori_loop(0, world - 1, drain, None)
+    ring_drain()
 
 
 def _pick_block_k(k: int, want: int) -> int:
@@ -473,12 +520,16 @@ _TUNED: dict[tuple, dict] = {}
 
 def ag_gemm_configs(m: int, rows: int, k: int, n_tot_loc: int,
                     itemsize: int,
-                    vmem_budget: int = DEFAULT_VMEM_BUDGET) -> list[dict]:
+                    vmem_budget: int = DEFAULT_VMEM_BUDGET,
+                    tier_caps: bool = True) -> list[dict]:
     """Candidate config table for the fused AG-GEMM (reference
     ``matmul_get_configs`` allgather_gemm.py:396, pruned to shapes that
     fit the hardware constraints). Ordered best-first: every entry point
     (default, autotune) consults this table, so an infeasible default can
-    never reach the compiler (BENCH_r02's 16.5 MB-scratch crash)."""
+    never reach the compiler (BENCH_r02's 16.5 MB-scratch crash).
+    ``tier_caps=False`` skips the blind per-tier prefix caps and
+    returns the FULL feasible space — the autotune path then prunes it
+    with the perf_model cost model instead (docs/autotuner.md)."""
     vmem_cfgs: list[dict] = []
     vmem_fp = itemsize * (m * k + k * n_tot_loc + m * n_tot_loc + rows * k)
     if vmem_fp <= vmem_budget:
@@ -524,10 +575,13 @@ def ag_gemm_configs(m: int, rows: int, k: int, n_tot_loc: int,
             if fp <= vmem_budget:
                 kt_cfgs.append({"variant": "hbm_kt", "block_m": bm,
                                 "block_k": bk})
-    cfgs = (vmem_cfgs
-            + cap_config_tiers(hbm_budget, [], n_budget=4)
-            + kt_cfgs[:2]
-            + cap_config_tiers([], aggressive))
+    if tier_caps:
+        cfgs = (vmem_cfgs
+                + cap_config_tiers(hbm_budget, [], n_budget=4)
+                + kt_cfgs[:2]
+                + cap_config_tiers([], aggressive))
+    else:
+        cfgs = vmem_cfgs + hbm_budget + kt_cfgs + aggressive
     return cfgs or [{"variant": "hbm_kt",
                      "block_m": _pick_block_k(rows, 128),
                      "block_k": _pick_block_k(k, 256)}]
@@ -535,13 +589,32 @@ def ag_gemm_configs(m: int, rows: int, k: int, n_tot_loc: int,
 
 def _autotune_ag_gemm(a, bs, ctx, key, n_tot_loc):
     """Eager sweep over :func:`ag_gemm_configs`; winner cached by shape
-    and agreed across processes (tools/autotuner broadcast)."""
-    from triton_dist_tpu.tools.autotuner import autotune
+    and agreed across processes (tools/autotuner broadcast).
+
+    The candidate space is the FULL feasible table (big tiles up to
+    HARD_FOOTPRINT_CAP, generated against :data:`TUNED_VMEM_BUDGET` —
+    the sweep has per-config failure isolation, so aggressive entries
+    are safe to list without any global budget raise), pruned by the
+    perf_model roofline cost model before any Mosaic compile is paid.
+    """
+    from triton_dist_tpu.tools.autotuner import autotune, record_prune
+    from triton_dist_tpu.tools import perf_model as _pm
 
     m, k = a.shape
     rows = m // ctx.world_size
-    cfgs = ag_gemm_configs(m, rows, k, n_tot_loc, a.dtype.itemsize,
-                           ctx.vmem_budget)
+    item = a.dtype.itemsize
+    world = ctx.world_size
+    dirs = resolve_ring_dirs(ctx.ring_dirs)
+    cfgs = ag_gemm_configs(m, rows, k, n_tot_loc, item,
+                           max(ctx.vmem_budget, TUNED_VMEM_BUDGET),
+                           tier_caps=False)
+    cfgs, n_before = _pm.prune_configs(
+        cfgs,
+        lambda c: _pm.estimate_ag_gemm_cost(
+            c, m=m, rows=rows, k=k, n_loc=n_tot_loc, itemsize=item,
+            world=world, ring_dirs=dirs).total_ms,
+        always_keep=lambda c: c["variant"] == "hbm_kt")
+    record_prune("ag_gemm", n_before, len(cfgs))
     if len(cfgs) == 1:
         _TUNED[key] = cfgs[0]
         return cfgs[0]
@@ -612,9 +685,16 @@ def ag_gemm_multi(a: jax.Array, bs,
 
     variant = ctx.resolve_variant(m, k, n_tot_loc, a.dtype.itemsize)
     item = a.dtype.itemsize
+    dirs = resolve_ring_dirs(ctx.ring_dirs)
     inject = dict(straggler_option=ctx.straggler_option,
                   for_correctness=ctx.for_correctness,
                   interp=bool(interpret))
+
+    def emit_overlap(cfg):
+        from triton_dist_tpu.tools import perf_model as _pm
+        record_overlap("ag_gemm", _pm.estimate_ag_gemm_cost(
+            cfg, m=m, rows=rows, k=k, n_loc=n_tot_loc, itemsize=item,
+            world=world, ring_dirs=dirs))
 
     if variant == "hbm":
         # Clamp the ctx hint to divisors + the VMEM budget; fall back to
@@ -643,10 +723,12 @@ def ag_gemm_multi(a: jax.Array, bs,
                 variant = "hbm_kt"
 
     if variant == "hbm":
+        emit_overlap({"variant": "hbm", "block_m": m_blk,
+                      "block_n": n_blk})
         nb_kernel = functools.partial(
             _ag_gemm_hbm_nb_kernel, axis=axis, world=world, rows=rows,
             k=k, n_loc=n_tot_loc, m_blk=m_blk, n_blk=n_blk,
-            acc_dtype=ctx.acc_dtype, **inject)
+            acc_dtype=ctx.acc_dtype, dirs=dirs, **inject)
 
         def body(xs, *ws):
             wcat = ws[0] if n_b == 1 else jnp.concatenate(ws, axis=1)
@@ -664,8 +746,8 @@ def ag_gemm_multi(a: jax.Array, bs,
                     pltpu.SemaphoreType.DMA((2,)),
                     pltpu.SemaphoreType.DMA((2,)),
                     pltpu.SemaphoreType.DMA((2,)),
-                    pltpu.SemaphoreType.DMA((world,)),
-                    pltpu.SemaphoreType.DMA((world,)),
+                    pltpu.SemaphoreType.DMA((dirs, world)),
+                    pltpu.SemaphoreType.DMA((dirs, world)),
                 ],
                 compiler_params=comm_params(collective_id=4, world=world),
                 interpret=interpret,
@@ -693,9 +775,12 @@ def ag_gemm_multi(a: jax.Array, bs,
                     if c["variant"] == "hbm_kt"]
             if cand:
                 m_blk, k_blk = cand[0]["block_m"], cand[0]["block_k"]
+        emit_overlap({"variant": "hbm_kt", "block_m": m_blk,
+                      "block_k": k_blk})
         hbm_kernel = functools.partial(
             _ag_gemm_hbm_kernel, axis=axis, world=world, rows=rows, k=k,
-            k_blk=k_blk, m_blk=m_blk, acc_dtype=ctx.acc_dtype, **inject)
+            k_blk=k_blk, m_blk=m_blk, acc_dtype=ctx.acc_dtype, dirs=dirs,
+            **inject)
 
         def body(xs, *ws):
             wcat = ws[0] if n_b == 1 else jnp.concatenate(ws, axis=1)
@@ -714,8 +799,8 @@ def ag_gemm_multi(a: jax.Array, bs,
                     pltpu.SemaphoreType.DMA((2,)),
                     pltpu.SemaphoreType.DMA((2,)),
                     pltpu.SemaphoreType.DMA((2,)),
-                    pltpu.SemaphoreType.DMA((world,)),
-                    pltpu.SemaphoreType.DMA((world,)),
+                    pltpu.SemaphoreType.DMA((dirs, world)),
+                    pltpu.SemaphoreType.DMA((dirs, world)),
                 ],
                 compiler_params=comm_params(collective_id=4, world=world),
                 interpret=interpret,
@@ -732,9 +817,10 @@ def ag_gemm_multi(a: jax.Array, bs,
                           out_specs=out_specs, check_vma=False)
         return list(sync_interpret(f(a, *bs), interpret))
 
+    emit_overlap({"variant": "vmem"})
     kernel = functools.partial(_ag_gemm_kernel, axis=axis, world=world,
                                rows=rows, acc_dtype=ctx.acc_dtype, n_b=n_b,
-                               **inject)
+                               dirs=dirs, **inject)
 
     def body(xs, *ws):
         out = pl.pallas_call(
@@ -746,8 +832,8 @@ def ag_gemm_multi(a: jax.Array, bs,
             in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * (1 + n_b),
             out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)]
                             * (1 + n_b)),
-            scratch_shapes=[pltpu.SemaphoreType.DMA((world,)),
-                            pltpu.SemaphoreType.DMA((world,))],
+            scratch_shapes=[pltpu.SemaphoreType.DMA((dirs, world)),
+                            pltpu.SemaphoreType.DMA((dirs, world))],
             compiler_params=comm_params(collective_id=4, world=world),
             interpret=interpret,
         )(xs, *ws)
@@ -787,13 +873,15 @@ def _swiglu_footprint(bm: int, bn: int, k: int, itemsize: int) -> int:
 
 def ag_swiglu_configs(rows: int, k: int, n_loc: int,
                       itemsize: int,
-                      vmem_budget: int = DEFAULT_VMEM_BUDGET) -> list[dict]:
+                      vmem_budget: int = DEFAULT_VMEM_BUDGET,
+                      tier_caps: bool = True) -> list[dict]:
     """Candidate (block_m, block_n) table for the fused SwiGLU kernel,
     ordered best-first; same two-tier structure as
     :func:`ag_gemm_configs` (budget tier, then an aggressive tier up to
     HARD_FOOTPRINT_CAP for the autotuner — the dual gate+up panel
     doubles B residency, so feasible tiles are smaller than the plain
-    AG-GEMM's at equal budget)."""
+    AG-GEMM's at equal budget). ``tier_caps=False`` returns the full
+    feasible space for cost-model pruning."""
     budget: list[dict] = []
     aggressive: list[dict] = []
     for bn in (2048, 1024, 512, 256, 128):
@@ -807,22 +895,36 @@ def ag_swiglu_configs(rows: int, k: int, n_loc: int,
                 budget.append({"block_m": bm, "block_n": bn})
             elif fp <= HARD_FOOTPRINT_CAP:
                 aggressive.append({"block_m": bm, "block_n": bn})
+    if not tier_caps:
+        return budget + aggressive
     return cap_config_tiers(budget, aggressive)
 
 
 def _autotune_ag_swiglu(a, w_gate, w_up, ctx, key):
     """Eager sweep over :func:`ag_swiglu_configs`; winner cached by
     shape alongside the ag_gemm winners (same _TUNED map, distinct
-    key tag)."""
-    from triton_dist_tpu.tools.autotuner import autotune
+    key tag). Candidates are the full feasible table (generated
+    against TUNED_VMEM_BUDGET; the sweep's per-config isolation makes
+    aggressive tiles safe), cost-model pruned before any compile."""
+    from triton_dist_tpu.tools.autotuner import autotune, record_prune
+    from triton_dist_tpu.tools import perf_model as _pm
 
     m, k = a.shape
     rows = m // ctx.world_size
+    item = a.dtype.itemsize
     n_loc = w_gate.shape[1] // ctx.world_size
-    cfgs = ag_swiglu_configs(rows, k, n_loc, a.dtype.itemsize,
-                             ctx.vmem_budget)
+    dirs = resolve_ring_dirs(ctx.ring_dirs)
+    cfgs = ag_swiglu_configs(rows, k, n_loc, item,
+                             max(ctx.vmem_budget, TUNED_VMEM_BUDGET),
+                             tier_caps=False)
     if not cfgs:
         return None
+    cfgs, n_before = _pm.prune_configs(
+        cfgs,
+        lambda c: _pm.estimate_ag_swiglu_cost(
+            c, m=m, rows=rows, k=k, n_loc=n_loc, itemsize=item,
+            world=ctx.world_size, ring_dirs=dirs).total_ms)
+    record_prune("ag_swiglu", n_before, len(cfgs))
     if len(cfgs) == 1:
         _TUNED[key] = cfgs[0]
         return cfgs[0]
@@ -841,28 +943,36 @@ def _autotune_ag_swiglu(a, w_gate, w_up, ctx, key):
     return result.config
 
 
-def _ag_swiglu_hbm_kernel(x_hbm, wg_hbm, wu_hbm, ag_hbm, act_hbm, a_tile,
-                          b_panel, c_stage, copy_sem, a_sem, b_sem, c_sem,
-                          send_sem, recv_sem, *, axis: str, world: int,
-                          rows: int, k: int, n_loc: int, m_blk: int,
-                          n_blk: int, acc_dtype, straggler_option=None,
+def _ag_swiglu_hbm_kernel(x_hbm, wg_hbm, wu_hbm, *rest, axis: str,
+                          world: int, rows: int, k: int, n_loc: int,
+                          m_blk: int, n_blk: int, acc_dtype,
+                          dirs: int = 1, has_bias: bool = False,
+                          straggler_option=None,
                           for_correctness=False, interp=False):
-    """AG + dual GEMM + SwiGLU epilogue in ONE kernel.
+    """AG + dual GEMM + bias + SwiGLU epilogue in ONE kernel.
 
-    Same ring/double-buffer structure as :func:`_ag_gemm_hbm_nb_kernel`,
-    but each N-block holds BOTH the gate and up B panels (separate HBM
-    inputs — no concatenated copy) and writes ``silu(A@Wg) * (A@Wu)``
-    directly —
-    the (M, 2*n_loc) gate/up intermediate never exists in HBM and the
-    activation needs no separate XLA kernel. This is what XLA's fusion
-    does for the unsharded MLP; the round-3 chip bench measured the
-    3-dispatch fused path at 0.77x of XLA's single fused program at
-    world=1, and this kernel removes exactly that overhead (reference
-    TP_MLP runs AG-GEMM then a separate silu-mul, tp_mlp.py:147-270 —
-    fusing past it is a TPU-side win, not a parity requirement).
+    Same ring/double-buffer structure as :func:`_ag_gemm_hbm_nb_kernel`
+    (incl. the bidirectional schedule via ``_make_ring``), but each
+    N-block holds BOTH the gate and up B panels (separate HBM inputs —
+    no concatenated copy) and writes
+    ``silu(A@Wg + bg) * (A@Wu + bu)`` directly — the (M, 2*n_loc)
+    gate/up intermediate never exists in HBM and the whole TP-MLP front
+    epilogue (bias add + SwiGLU gate) needs no separate XLA kernel.
+    This is what XLA's fusion does for the unsharded MLP; the round-3
+    chip bench measured the 3-dispatch fused path at 0.77x of XLA's
+    single fused program at world=1, and this kernel removes exactly
+    that overhead (reference TP_MLP runs AG-GEMM then a separate
+    silu-mul, tp_mlp.py:147-270 — fusing past it is a TPU-side win,
+    not a parity requirement). Biases are tiny (1, n_loc) VMEM
+    residents; ``has_bias=False`` omits the operands entirely.
     """
+    n_bias = 2 if has_bias else 0
+    bg_ref = rest[0] if has_bias else None
+    bu_ref = rest[1] if has_bias else None
+    ag_hbm, act_hbm = rest[n_bias], rest[n_bias + 1]
+    (a_tile, b_panel, c_stage, copy_sem, a_sem, b_sem, c_sem,
+     send_sem, recv_sem) = rest[n_bias + 2:]
     me = lax.axis_index(axis)
-    right = lax.rem(me + 1, world)
     m_tiles = rows // m_blk
     n_blocks = n_loc // n_blk
     per_nb = world * m_tiles
@@ -877,18 +987,16 @@ def _ag_swiglu_hbm_kernel(x_hbm, wg_hbm, wu_hbm, ag_hbm, act_hbm, a_tile,
         maybe_straggle(straggler_option, axis, interp)
         maybe_noise(for_correctness, axis, world, salt=4, interpret=interp)
 
+    chunk_of, advance, ring_drain = _make_ring(
+        lambda idx: ag_hbm.at[pl.ds(idx * rows, rows), :], me, axis,
+        world, dirs, send_sem, recv_sem)
+
     def chunk_idx(i):
-        return lax.rem(me - lax.rem(i, per_nb) // m_tiles + world, world)
+        return chunk_of(lax.rem(i, per_nb) // m_tiles)
 
     def row_of(i):
         mt = lax.rem(i, m_tiles)
         return chunk_idx(i) * rows + mt * m_blk
-
-    def chunk_copy(idx):
-        return dl.remote_copy(
-            ag_hbm.at[pl.ds(idx * rows, rows), :],
-            ag_hbm.at[pl.ds(idx * rows, rows), :],
-            right, send_sem.at[idx], recv_sem.at[idx], axis=axis)
 
     def a_dma(slot, i):
         return pltpu.make_async_copy(
@@ -915,15 +1023,7 @@ def _ag_swiglu_hbm_kernel(x_hbm, wg_hbm, wu_hbm, ag_hbm, act_hbm, a_tile,
 
         @pl.when((i < per_nb) & (lax.rem(i, m_tiles) == 0))
         def _():
-            s = i // m_tiles
-
-            @pl.when(s > 0)
-            def _():
-                chunk_copy(chunk_idx(i)).wait_recv()
-
-            @pl.when(s < world - 1)
-            def _():
-                chunk_copy(chunk_idx(i)).start()
+            advance(i // m_tiles)
 
     ring_advance(0)
     b_dma(0, 0, 0).start()
@@ -955,6 +1055,10 @@ def _ag_swiglu_hbm_kernel(x_hbm, wg_hbm, wu_hbm, ag_hbm, act_hbm, a_tile,
                        preferred_element_type=acc_dtype)
         up = jnp.dot(a_tile[slot], b_panel[bslot, 1],
                      preferred_element_type=acc_dtype)
+        if has_bias:
+            col = pl.ds(nb * n_blk, n_blk)
+            gate = gate + bg_ref[0:1, col].astype(acc_dtype)
+            up = up + bu_ref[0:1, col].astype(acc_dtype)
         act = gate * jax.nn.sigmoid(gate) * up      # SwiGLU in acc dtype
 
         @pl.when(i >= 2)
@@ -969,26 +1073,30 @@ def _ag_swiglu_hbm_kernel(x_hbm, wg_hbm, wu_hbm, ag_hbm, act_hbm, a_tile,
     for i_last in range(max(0, total - 2), total):
         c_dma(i_last % 2, i_last).wait()
 
-    if world > 1:
-        def drain(s, _):
-            chunk_copy(lax.rem(me - s + world, world)).wait_send()
-            return _
-        lax.fori_loop(0, world - 1, drain, None)
+    ring_drain()
 
 
 def ag_swiglu(a: jax.Array, w_gate: jax.Array, w_up: jax.Array,
               ctx: AllGatherGEMMContext | None = None,
-              impl: str = "pallas") -> jax.Array:
-    """``silu(allgather(a) @ w_gate) * (allgather(a) @ w_up)`` fused.
+              impl: str = "pallas",
+              b_gate: jax.Array | None = None,
+              b_up: jax.Array | None = None) -> jax.Array:
+    """``silu(allgather(a) @ w_gate + b_gate) * (allgather(a) @ w_up +
+    b_up)`` fused.
 
-    The MLP front half as ONE kernel (AG + both GEMMs + activation).
+    The MLP front half as ONE kernel (AG + both GEMMs + bias +
+    activation — the whole TP-MLP epilogue lives in the consumer tile
+    loop, so the activation never makes an extra HBM round trip).
     Not differentiable directly — training wraps it in
     :func:`triton_dist_tpu.ops.autodiff.ag_swiglu`, whose backward
-    recomputes gate/up through the differentiable composition.
+    recomputes gate/up through the differentiable composition (bias-free
+    form; the biased epilogue is the inference path).
 
     Args:
       a: (M, K) row-sharded over ``ctx.axis``.
       w_gate/w_up: (K, N) column-sharded over ``ctx.axis``.
+      b_gate/b_up: optional (N,) biases, column-sharded like the
+        weights; pass both or neither.
     Returns:
       act: (M, N_loc-per-shard) column-sharded, a.dtype.
     """
@@ -996,6 +1104,8 @@ def ag_swiglu(a: jax.Array, w_gate: jax.Array, w_up: jax.Array,
     if ctx.return_gathered:  # same convention as autodiff.ag_gemm_multi
         raise ValueError("ag_swiglu does not support return_gathered "
                          "(the gathered A is a workspace, not an output)")
+    if (b_gate is None) != (b_up is None):
+        raise ValueError("pass both biases or neither")
     mesh, axis, world = ctx.mesh, ctx.axis, ctx.world_size
     record_comm("ag_swiglu", a)
     m, k = a.shape
@@ -1003,21 +1113,35 @@ def ag_swiglu(a: jax.Array, w_gate: jax.Array, w_up: jax.Array,
     assert w_gate.shape[1] % world == 0 and m % world == 0
     n_loc = w_gate.shape[1] // world
     rows = m // world
+    has_bias = b_gate is not None
+    if has_bias:
+        assert b_gate.shape[-1] == w_gate.shape[1], (b_gate.shape,
+                                                     w_gate.shape)
+        # (1, N) keeps the lane-major layout; sharded like the weights.
+        biases = (jnp.reshape(b_gate, (1, -1)),
+                  jnp.reshape(b_up, (1, -1)))
+    else:
+        biases = ()
 
     if impl == "xla":
-        def body(xs, wg, wu):
+        def body(xs, wg, wu, *bs):
             ag = lax.all_gather(xs, axis, tiled=True)
             gate = jnp.dot(ag, wg, preferred_element_type=ctx.acc_dtype)
             up = jnp.dot(ag, wu, preferred_element_type=ctx.acc_dtype)
+            if bs:
+                gate = gate + bs[0].astype(ctx.acc_dtype)
+                up = up + bs[1].astype(ctx.acc_dtype)
             return (jax.nn.silu(gate) * up).astype(xs.dtype)
         f = nestable_shard_map(body, mesh=mesh,
                                in_specs=(P(axis), P(None, axis),
-                                         P(None, axis)),
+                                         P(None, axis))
+                               + (P(None, axis),) * len(biases),
                                out_specs=P(None, axis), check_vma=False)
-        return f(a, w_gate, w_up)
+        return f(a, w_gate, w_up, *biases)
 
     interpret = resolve_interpret(ctx.interpret)
     item = a.dtype.itemsize
+    dirs = resolve_ring_dirs(ctx.ring_dirs)
 
     if ctx.autotune:
         tune_key = (m, k, n_loc, str(a.dtype), world, "swiglu")
@@ -1061,22 +1185,36 @@ def ag_swiglu(a: jax.Array, w_gate: jax.Array, w_up: jax.Array,
         # No feasible single-kernel tiling (huge K or tiny shards):
         # compose from the proven pieces — still fused AG, unfused act.
         gate, up = ag_gemm_multi(a, [w_gate, w_up], ctx, impl=impl)
+        if has_bias:
+            # gate/up are (M, N) column-sharded globals; the (1, N)
+            # biases broadcast — XLA inserts the matching sharding.
+            gate = (gate.astype(jnp.float32)
+                    + biases[0].astype(jnp.float32)).astype(a.dtype)
+            up = (up.astype(jnp.float32)
+                  + biases[1].astype(jnp.float32)).astype(a.dtype)
         return (jax.nn.silu(gate.astype(jnp.float32))
                 ).astype(a.dtype) * up
     m_blk, n_blk = choice
 
+    from triton_dist_tpu.tools import perf_model as _pm
+    record_overlap("ag_swiglu", _pm.estimate_ag_swiglu_cost(
+        {"block_m": m_blk, "block_n": n_blk}, m=m, rows=rows, k=k,
+        n_loc=n_loc, itemsize=item, world=world, ring_dirs=dirs))
+
     kernel = functools.partial(
         _ag_swiglu_hbm_kernel, axis=axis, world=world, rows=rows, k=k,
         n_loc=n_loc, m_blk=m_blk, n_blk=n_blk, acc_dtype=ctx.acc_dtype,
+        dirs=dirs, has_bias=has_bias,
         straggler_option=ctx.straggler_option,
         for_correctness=ctx.for_correctness, interp=bool(interpret))
 
-    def body(xs, wg, wu):
-        _, act = pl.pallas_call(
+    def body(xs, wg, wu, *bs):
+        out = pl.pallas_call(
             kernel,
             out_shape=(jax.ShapeDtypeStruct((m, k), a.dtype),
                        jax.ShapeDtypeStruct((m, n_loc), a.dtype)),
-            in_specs=[any_spec()] * 3,
+            in_specs=[any_spec()] * 3
+            + [pl.BlockSpec(memory_space=pltpu.VMEM)] * len(bs),
             out_specs=(any_spec(),) * 2,
             scratch_shapes=[
                 pltpu.VMEM((2, m_blk, k), a.dtype),
@@ -1086,16 +1224,17 @@ def ag_swiglu(a: jax.Array, w_gate: jax.Array, w_up: jax.Array,
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2, 2)),
                 pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((world,)),
-                pltpu.SemaphoreType.DMA((world,)),
+                pltpu.SemaphoreType.DMA((dirs, world)),
+                pltpu.SemaphoreType.DMA((dirs, world)),
             ],
             compiler_params=comm_params(collective_id=4, world=world),
             interpret=interpret,
-        )(xs, wg, wu)
-        return act
+        )(xs, wg, wu, *bs)
+        return out[1]
 
     f = nestable_shard_map(body, mesh=mesh,
                            in_specs=(P(axis), P(None, axis),
-                                     P(None, axis)),
+                                     P(None, axis))
+                           + (P(None, axis),) * len(biases),
                            out_specs=P(None, axis), check_vma=False)
-    return sync_interpret(f(a, w_gate, w_up), interpret)
+    return sync_interpret(f(a, w_gate, w_up, *biases), interpret)

@@ -136,14 +136,26 @@ VMEM_LIMIT_BYTES = 64 * 1024 * 1024
 HARD_FOOTPRINT_CAP = 26 * 1024 * 1024
 
 #: Soft VMEM budget the fused ops' "auto" tile choice and default-path
-#: clamps target. Sized against :data:`VMEM_LIMIT_BYTES`: 24 MB
-#: declared x the measured ~2.2x scoped overhead ~= 53 MB, under the
-#: 64 MB limit with margin; must stay below
-#: :data:`HARD_FOOTPRINT_CAP`. Was 12 MB while Mosaic's default 16 MB
-#: cap governed — the round-5 chip run showed the small tiles that
-#: budget forced cost ~30% of MXU throughput vs XLA's matmul.
-DEFAULT_VMEM_BUDGET = 24 * 1024 * 1024
-assert DEFAULT_VMEM_BUDGET < HARD_FOOTPRINT_CAP
+#: clamps target. Back to the PROVEN 12 MB (ADVICE r5 medium 2): the
+#: round-5 default path compiled on chip under 12 MB, and the 24 MB
+#: raise that round introduced was never revalidated there — an
+#: unproven default is the BENCH_r02 crash class waiting to recur. The
+#: larger declared footprints the raise was after are still reachable,
+#: but only through paths with per-config compile-failure isolation:
+#: autotune sweeps and tuned winners run against
+#: :data:`TUNED_VMEM_BUDGET` / :data:`HARD_FOOTPRINT_CAP` (the sweep
+#: scores a config that fails to compile as inf instead of crashing).
+DEFAULT_VMEM_BUDGET = 12 * 1024 * 1024
+
+#: Budget-tier boundary for AUTOTUNE candidate tables (the round-5
+#: value DEFAULT_VMEM_BUDGET briefly held): 24 MB declared x the
+#: measured ~2.2x scoped overhead ~= 53 MB, under the 64 MB
+#: :data:`VMEM_LIMIT_BYTES` with margin. Only swept / trust_blocks
+#: paths — which carry per-config failure isolation — use it; the
+#: default path keeps :data:`DEFAULT_VMEM_BUDGET` until
+#: ``smoke_revalidate`` passes these shapes on chip.
+TUNED_VMEM_BUDGET = 24 * 1024 * 1024
+assert DEFAULT_VMEM_BUDGET < TUNED_VMEM_BUDGET < HARD_FOOTPRINT_CAP
 
 
 def cap_config_tiers(budget_cfgs, aggressive_cfgs, n_budget: int = 5,
@@ -160,6 +172,24 @@ def cap_config_tiers(budget_cfgs, aggressive_cfgs, n_budget: int = 5,
     clamp depends on (hbm_kt) must be appended by the caller OUTSIDE
     the cap so pruning can never remove them (r5l finding 1)."""
     return budget_cfgs[:n_budget] + aggressive_cfgs[:n_aggressive]
+
+
+def record_overlap(op: str, cost) -> None:
+    """Per-op overlap gauges from a :class:`tools.perf_model
+    .FusedGemmCost` breakdown: ``comms.<op>.overlap_pct`` (hidden
+    fraction of the ring communication under the chosen tile schedule —
+    the BASELINE.md >=90% north-star metric, previously only derivable
+    by hand from bench ingredients) and ``comms.<op>.exposed_comm_ms``.
+
+    Model-derived from the tile-loop timing structure at DISPATCH time
+    (trace time under jit, like ``record_comm``), not a trace
+    decomposition — bench.py's ``comms.<op>.overlap_pct`` extras carry
+    the measured counterpart on chip. At world=1 there is no
+    communication to expose, so the gauge reads 100."""
+    if not obs.enabled():
+        return
+    obs.gauge(f"comms.{op}.overlap_pct").set(cost.overlap_pct)
+    obs.gauge(f"comms.{op}.exposed_comm_ms").set(cost.exposed_comm_ms)
 
 
 def comm_params(collective_id: int | None = 0,
@@ -235,6 +265,79 @@ def maybe_noise(for_correctness: bool, axis: str, world: int,
         @pl.when(me == r)
         def _(amt=amt):
             pl.delay(base_cycles * amt)
+
+
+# -- bidirectional ring scheduling ------------------------------------------
+# ICI links are full duplex, so a ring collective can run both directions
+# at once: chunks travel the SHORTER way round and the hop count halves
+# (ops/allgather.py RING_BIDIR documents the win for the plain
+# collective). These helpers give the fused GEMM kernels the same
+# schedule: a rank-rotated consumption order that starts at the local
+# chunk and then alternates between arrivals from the left (forward
+# ring) and the right (backward ring).
+
+def resolve_ring_dirs(ring_dirs: int = 0) -> int:
+    """Ring direction count for the fused comm-GEMM schedules.
+
+    ``2`` = bidirectional (default), ``1`` = the unidirectional
+    proven-on-chip fallback. ``0`` consults ``TDT_RING_DIRS`` (so the
+    round-5-measured schedule stays selectable without code changes)
+    and falls back to 2.
+    """
+    import os
+    if ring_dirs not in (0, 1, 2):
+        raise ValueError(f"ring_dirs must be 0 (auto), 1 or 2: {ring_dirs}")
+    if ring_dirs:
+        return ring_dirs
+    env = os.environ.get("TDT_RING_DIRS", "").strip()
+    if env:
+        if env not in ("1", "2"):
+            raise ValueError(f"TDT_RING_DIRS must be 1 or 2: {env!r}")
+        return int(env)
+    return 2
+
+
+def ring_hop_counts(world: int, dirs: int) -> tuple[int, int]:
+    """(forward, backward) hop counts of the ring schedule. Odd worlds
+    split the w-1 travelling chunks as ceil/floor; world <= 2 has no
+    shorter way round, so bidir degenerates to the unidirectional ring
+    (same split as ``ops/allgather._ring_ag_kernel``)."""
+    if world <= 1:
+        return 0, 0
+    if dirs == 1 or world == 2:
+        return world - 1, 0
+    n_bwd = (world - 1) // 2
+    return (world - 1) - n_bwd, n_bwd
+
+
+def ring_chunk_schedule(me, s, world: int, dirs: int):
+    """Chunk consumed at position ``s`` of the rank-rotated schedule.
+
+    dirs=1: chunk ``me - s`` (all forward — today's proven order).
+    dirs=2: own chunk first, then alternating arrivals from the left
+    (forward ring: me-1, me-2, ...) and the right (backward ring: me+1,
+    me+2, ...); even worlds end with a forward-only tail because the
+    backward ring carries floor((w-1)/2) chunks.
+
+    Returns ``(chunk, is_bwd, offset)``: ``offset`` is the hop count
+    from the chunk's origin rank to this rank along its travel
+    direction (0 for the local chunk). ``me``/``s`` may be traced;
+    ``world``/``dirs`` are static.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+    if dirs == 1 or world <= 2:
+        off = jnp.asarray(s, jnp.int32)
+        chunk = lax.rem(me - off + world, world)
+        return chunk, jnp.zeros((), jnp.bool_), off
+    s = jnp.asarray(s, jnp.int32)
+    n_bwd = (world - 1) // 2
+    in_alt = s <= 2 * n_bwd
+    is_bwd = in_alt & (lax.rem(s, 2) == 0) & (s > 0)
+    off = jnp.where(in_alt, jnp.where(is_bwd, s // 2, (s + 1) // 2),
+                    s - n_bwd)
+    chunk = lax.rem(jnp.where(is_bwd, me + off, me - off) + world, world)
+    return chunk, is_bwd, off
 
 
 def vmem_spec(block_shape=None, index_map=None):

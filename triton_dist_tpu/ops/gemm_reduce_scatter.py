@@ -35,12 +35,15 @@ import triton_dist_tpu.language as dl
 from triton_dist_tpu.ops.common import (
     DEFAULT_VMEM_BUDGET,
     HARD_FOOTPRINT_CAP,
+    TUNED_VMEM_BUDGET,
     any_spec,
     cap_config_tiers,
     comm_params,
     nestable_shard_map,
     record_comm,
+    record_overlap,
     resolve_interpret,
+    resolve_ring_dirs,
     sync_interpret)
 
 
@@ -64,10 +67,13 @@ def _hbm_nb_footprint(bm: int, bn: int, k_loc: int, itemsize: int) -> int:
 
 def gemm_rs_configs(m: int, rows: int, k_loc: int, n: int, itemsize: int,
                     world: int,
-                    vmem_budget: int = DEFAULT_VMEM_BUDGET) -> list[dict]:
+                    vmem_budget: int = DEFAULT_VMEM_BUDGET,
+                    tier_caps: bool = True) -> list[dict]:
     """Candidate config table for the fused GEMM-RS, ordered best-first.
     Every entry point (default, autotune) consults this table so an
-    infeasible default can never reach the compiler (BENCH_r02)."""
+    infeasible default can never reach the compiler (BENCH_r02).
+    ``tier_caps=False`` returns the full feasible space for the
+    autotune path's cost-model pruning (docs/autotuner.md)."""
     vmem_cfgs: list[dict] = []
     vmem_fp = itemsize * (m * k_loc + k_loc * n + rows * n
                           + 2 * max(world - 1, 1) * rows * n)
@@ -107,10 +113,13 @@ def gemm_rs_configs(m: int, rows: int, k_loc: int, n: int, itemsize: int,
             if fp <= vmem_budget:
                 kt_cfgs.append({"variant": "hbm_kt", "block_m": bm,
                                 "block_k": bk})
-    cfgs = (vmem_cfgs
-            + cap_config_tiers(hbm_budget, [], n_budget=4)
-            + kt_cfgs[:2]
-            + cap_config_tiers([], aggressive))
+    if tier_caps:
+        cfgs = (vmem_cfgs
+                + cap_config_tiers(hbm_budget, [], n_budget=4)
+                + kt_cfgs[:2]
+                + cap_config_tiers([], aggressive))
+    else:
+        cfgs = vmem_cfgs + hbm_budget + kt_cfgs + aggressive
     # Last resort: shape-CLAMPED k-tiled blocks (see ag_gemm_configs —
     # an unclamped literal yields k_tiles = 0 on tiny shards).
     return cfgs or [{"variant": "hbm_kt",
@@ -119,19 +128,36 @@ def gemm_rs_configs(m: int, rows: int, k_loc: int, n: int, itemsize: int,
 
 
 def _autotune_gemm_rs(a, b, ctx, key, all_gather_epilogue):
-    from triton_dist_tpu.tools.autotuner import autotune
+    """Candidates are the full feasible table (TUNED_VMEM_BUDGET tier
+    boundary — the sweep's per-config failure isolation makes
+    aggressive tiles safe to list without a global budget raise),
+    cost-model pruned before any Mosaic compile is paid."""
+    from triton_dist_tpu.tools.autotuner import autotune, record_prune
+    from triton_dist_tpu.tools import perf_model as _pm
 
     m = a.shape[0]
     world = ctx.world_size
     rows = m // world
     k_loc = a.shape[1] // world
     n = b.shape[1]
-    cfgs = gemm_rs_configs(m, rows, k_loc, n, a.dtype.itemsize, world,
-                           ctx.vmem_budget)
+    item = a.dtype.itemsize
+    dirs = resolve_ring_dirs(ctx.ring_dirs)
+    cfgs = gemm_rs_configs(m, rows, k_loc, n, item, world,
+                           max(ctx.vmem_budget, TUNED_VMEM_BUDGET),
+                           tier_caps=False)
     if all_gather_epilogue:
         # The k-tiled fallback has no AG epilogue; the N-blocked hbm
         # kernel does (VERDICT r2 weak 8).
         cfgs = [c for c in cfgs if c["variant"] != "hbm_kt"] or cfgs[:1]
+    cfgs, n_before = _pm.prune_configs(
+        cfgs,
+        lambda c: _pm.estimate_gemm_rs_cost(
+            c, m=m, rows=rows, k_loc=k_loc, n=n, itemsize=item,
+            world=world, ring_dirs=dirs).total_ms,
+        always_keep=(None if all_gather_epilogue
+                     else lambda c: c["variant"] == "hbm_kt"))
+    record_prune("gemm_ar" if all_gather_epilogue else "gemm_rs",
+                 n_before, len(cfgs))
     if len(cfgs) == 1:
         _TUNED[key] = cfgs[0]
         return cfgs[0]
@@ -177,6 +203,12 @@ class GEMMReduceScatterContext:
     # (reference ContextualAutoTuner + get_auto_triton_config,
     # moe_reduce_rs.py:553).
     autotune: bool = False
+    # Ring directions for the fused RS schedule: 2 = bidirectional (the
+    # two column halves of every travelling partial ride opposite
+    # full-duplex ICI links, halving per-link bytes), 1 = the
+    # unidirectional proven-on-chip fallback, 0 = consult TDT_RING_DIRS
+    # (default 2).
+    ring_dirs: int = 0
     # Honor block hints past the soft budget (up to HARD_FOOTPRINT_CAP);
     # set by the sweep / tuned-winner application — see
     # AllGatherGEMMContext.trust_blocks.
@@ -212,20 +244,32 @@ def create_gemm_rs_context(mesh: Mesh | None = None, axis: str = "tp",
 def _gemm_rs_kernel(x_ref, w_ref, o_ref, send_buf, recv_buf, send_sem,
                     recv_sem, *, axis: str, world: int, rows: int,
                     acc_dtype, all_gather_epilogue: bool,
-                    ag_sems=None):
+                    dirs: int = 1, ag_sems=None):
     """Producer GEMM in ring order fused with ring reduce-scatter.
 
     Step s computes the partial for chunk (me-s-1) — exactly the chunk this
     device must forward at step s — adds the travelling partial received at
     step s-1, and sends. The send of step s overlaps the MXU work of step
     s+1. Per-step buffers/semaphores (see ops/reduce_scatter.py for the
-    FIFO-reordering race this avoids)."""
+    FIFO-reordering race this avoids).
+
+    ``dirs=2``: every chunk's N columns split in half — the left half
+    reduces on the rightward (forward) ring as above while the right
+    half reduces on the mirrored leftward ring (chunk me+s+1 at step s)
+    — so both full-duplex ICI directions carry half the bytes and the
+    per-link RS time halves. Each half is still summed in identical
+    ring order, only narrower."""
     me = lax.axis_index(axis)
     right = lax.rem(me + 1, world)
+    left = lax.rem(me - 1 + world, world)
+    n = w_ref.shape[1]
+    nh = n // 2 if dirs == 2 else n
+    cols = ((0, n),) if dirs == 1 else ((0, nh), (nh, n))
 
-    def partial_chunk(idx):
+    def partial_chunk(idx, c0=0, c1=n):
         return jnp.dot(
-            x_ref[pl.ds(idx * rows, rows), :], w_ref[:],
+            x_ref[pl.ds(idx * rows, rows), :],
+            w_ref[:, pl.ds(c0, c1 - c0)],
             preferred_element_type=acc_dtype).astype(o_ref.dtype)
 
     if world == 1:
@@ -234,34 +278,44 @@ def _gemm_rs_kernel(x_ref, w_ref, o_ref, send_buf, recv_buf, send_sem,
 
     dl.barrier_all(axis)
 
-    def rs_copy(s):
-        return dl.remote_copy(send_buf.at[s], recv_buf.at[s], right,
-                              send_sem.at[s], recv_sem.at[s], axis=axis)
+    def rs_copy(s, d):
+        c0, c1 = cols[d]
+        sl = pl.ds(c0, c1 - c0)
+        return dl.remote_copy(send_buf.at[s, :, sl],
+                              recv_buf.at[s, :, sl],
+                              right if d == 0 else left,
+                              send_sem.at[d, s], recv_sem.at[d, s],
+                              axis=axis)
 
     def rs_step(s, _):
-        send_idx = lax.rem(me - s - 1 + world, world)
-        part = partial_chunk(send_idx)
+        for d, (c0, c1) in enumerate(cols):
+            send_idx = (lax.rem(me - s - 1 + world, world) if d == 0
+                        else lax.rem(me + s + 1, world))
+            part = partial_chunk(send_idx, c0, c1)
+            sl = pl.ds(c0, c1 - c0)
 
-        @pl.when(s == 0)
-        def _():
-            send_buf[s] = part
+            @pl.when(s == 0)
+            def _(part=part, sl=sl):
+                send_buf[s, :, sl] = part
 
-        @pl.when(s > 0)
-        def _():
-            rs_copy(jnp.maximum(s - 1, 0)).wait_recv()
-            send_buf[s] = part + recv_buf[jnp.maximum(s - 1, 0)]
+            @pl.when(s > 0)
+            def _(part=part, sl=sl, d=d):
+                rs_copy(jnp.maximum(s - 1, 0), d).wait_recv()
+                send_buf[s, :, sl] = (
+                    part + recv_buf[jnp.maximum(s - 1, 0), :, sl])
 
-        rs_copy(s).start()
+            rs_copy(s, d).start()
         return _
 
     lax.fori_loop(0, world - 1, rs_step, None)
-    rs_copy(world - 2).wait_recv()
-    reduced = recv_buf[world - 2] + partial_chunk(me)
+    row0 = me * rows if all_gather_epilogue else 0
+    for d, (c0, c1) in enumerate(cols):
+        sl = pl.ds(c0, c1 - c0)
+        rs_copy(world - 2, d).wait_recv()
+        o_ref[pl.ds(row0, rows), sl] = (recv_buf[world - 2, :, sl]
+                                        + partial_chunk(me, c0, c1))
 
-    if not all_gather_epilogue:
-        o_ref[:] = reduced
-    else:
-        o_ref[pl.ds(me * rows, rows), :] = reduced
+    if all_gather_epilogue:
         ag_send_sem, ag_recv_sem = ag_sems
 
         def ag_copy(idx):
@@ -284,7 +338,8 @@ def _gemm_rs_kernel(x_ref, w_ref, o_ref, send_buf, recv_buf, send_sem,
         lax.fori_loop(0, world - 1, ag_drain, None)
 
     def drain(s, _):
-        rs_copy(s).wait_send()
+        for d in range(len(cols)):
+            rs_copy(s, d).wait_send()
         return _
 
     lax.fori_loop(0, world - 1, drain, None)
@@ -295,7 +350,7 @@ def _gemm_rs_hbm_nb_kernel(x_hbm, w_hbm, o_hbm, send_hbm, recv_hbm, a_tile,
                            c_sem, send_sem, recv_sem, ag_send_sem,
                            ag_recv_sem, *, axis: str, world: int,
                            rows: int, k_loc: int, n: int, m_blk: int,
-                           n_blk: int, acc_dtype,
+                           n_blk: int, acc_dtype, dirs: int = 1,
                            all_gather_epilogue: bool):
     """N-blocked HBM GEMM-RS/-AR: resident B panel, full-K MXU dots.
 
@@ -311,20 +366,36 @@ def _gemm_rs_hbm_nb_kernel(x_hbm, w_hbm, o_hbm, send_hbm, recv_hbm, a_tile,
     """
     me = lax.axis_index(axis)
     right = lax.rem(me + 1, world)
+    left = lax.rem(me - 1 + world, world)
     m_tiles = rows // m_blk
     n_blocks = n // n_blk
-    per = n_blocks * m_tiles
+    # Bidirectional split at N-block granularity: the forward (rightward)
+    # ring reduces N-blocks [0, nbh), the backward ring [nbh, n_blocks)
+    # — both full-duplex ICI directions carry about half the bytes.
+    nbh = n_blocks // 2
+    ranges = (((0, n_blocks),) if dirs == 1
+              else ((0, nbh), (nbh, n_blocks)))
 
-    def rs_copy(s):
-        return dl.remote_copy(send_hbm.at[s], recv_hbm.at[s], right,
-                              send_sem.at[s], recv_sem.at[s], axis=axis)
+    def rs_copy(s, d):
+        nb0, nb1 = ranges[d]
+        sl = pl.ds(nb0 * n_blk, (nb1 - nb0) * n_blk)
+        return dl.remote_copy(send_hbm.at[s, :, sl],
+                              recv_hbm.at[s, :, sl],
+                              right if d == 0 else left,
+                              send_sem.at[d, s], recv_sem.at[d, s],
+                              axis=axis)
 
-    def chunk_gemm(chunk, s, dst, dst_row0):
-        """Tiled partial for ``chunk``; adds recv slab s-1 when s > 0;
-        writes (rows, n) into ``dst`` starting at ``dst_row0``."""
+    def chunk_gemm(chunk, s, dst, dst_row0, nb0=0, nb1=n_blocks):
+        """Tiled partial for ``chunk`` over N-blocks [nb0, nb1); adds
+        recv slab s-1 when s > 0; writes (rows, those columns) into
+        ``dst`` starting at ``dst_row0``."""
+        per = (nb1 - nb0) * m_tiles
 
         def mt_of(i):
             return lax.rem(i, m_tiles)
+
+        def nb_of(i):
+            return nb0 + i // m_tiles
 
         def a_dma(slot, i):
             return pltpu.make_async_copy(
@@ -340,17 +411,17 @@ def _gemm_rs_hbm_nb_kernel(x_hbm, w_hbm, o_hbm, send_hbm, recv_hbm, a_tile,
             return pltpu.make_async_copy(
                 recv_hbm.at[jnp.maximum(s - 1, 0),
                             pl.ds(mt_of(i) * m_blk, m_blk),
-                            pl.ds((i // m_tiles) * n_blk, n_blk)],
+                            pl.ds(nb_of(i) * n_blk, n_blk)],
                 r_tile.at[slot], r_sem.at[slot])
 
         def c_dma(slot, i):
             return pltpu.make_async_copy(
                 c_stage.at[slot],
                 dst.at[pl.ds(dst_row0 + mt_of(i) * m_blk, m_blk),
-                       pl.ds((i // m_tiles) * n_blk, n_blk)],
+                       pl.ds(nb_of(i) * n_blk, n_blk)],
                 c_sem.at[slot])
 
-        b_dma(0, 0).start()
+        b_dma(0, nb0).start()
         a_dma(0, 0).start()
 
         @pl.when(s > 0)
@@ -359,8 +430,8 @@ def _gemm_rs_hbm_nb_kernel(x_hbm, w_hbm, o_hbm, send_hbm, recv_hbm, a_tile,
 
         def istep(i, _):
             slot = lax.rem(i, 2)
-            nb = i // m_tiles
-            bslot = lax.rem(nb, 2)
+            nb = nb_of(i)
+            bslot = lax.rem(i // m_tiles, 2)
 
             @pl.when(i + 1 < per)
             def _():
@@ -370,9 +441,9 @@ def _gemm_rs_hbm_nb_kernel(x_hbm, w_hbm, o_hbm, send_hbm, recv_hbm, a_tile,
             def _():
                 r_dma(lax.rem(i + 1, 2), i + 1).start()
 
-            @pl.when((lax.rem(i, m_tiles) == 0) & (nb + 1 < n_blocks))
+            @pl.when((lax.rem(i, m_tiles) == 0) & (nb + 1 < nb1))
             def _():
-                b_dma(lax.rem(nb + 1, 2), nb + 1).start()  # next panel
+                b_dma(lax.rem(i // m_tiles + 1, 2), nb + 1).start()
 
             @pl.when(lax.rem(i, m_tiles) == 0)
             def _():
@@ -409,19 +480,22 @@ def _gemm_rs_hbm_nb_kernel(x_hbm, w_hbm, o_hbm, send_hbm, recv_hbm, a_tile,
     dl.barrier_all(axis)
 
     def rs_step(s, _):
-        send_idx = lax.rem(me - s - 1 + world, world)
+        for d, (nb0, nb1) in enumerate(ranges):
+            send_idx = (lax.rem(me - s - 1 + world, world) if d == 0
+                        else lax.rem(me + s + 1, world))
 
-        @pl.when(s > 0)
-        def _():
-            rs_copy(jnp.maximum(s - 1, 0)).wait_recv()
-        chunk_gemm(send_idx, s, send_hbm.at[s], 0)
-        rs_copy(s).start()
+            @pl.when(s > 0)
+            def _(d=d):
+                rs_copy(jnp.maximum(s - 1, 0), d).wait_recv()
+            chunk_gemm(send_idx, s, send_hbm.at[s], 0, nb0, nb1)
+            rs_copy(s, d).start()
         return _
 
     lax.fori_loop(0, world - 1, rs_step, None)
-    rs_copy(world - 2).wait_recv()
-    chunk_gemm(me, jnp.int32(world - 1), o_hbm,
-               me * rows if all_gather_epilogue else 0)
+    row0 = me * rows if all_gather_epilogue else 0
+    for d, (nb0, nb1) in enumerate(ranges):
+        rs_copy(world - 2, d).wait_recv()
+        chunk_gemm(me, jnp.int32(world - 1), o_hbm, row0, nb0, nb1)
 
     if all_gather_epilogue:
         def ag_copy(idx):
@@ -444,7 +518,8 @@ def _gemm_rs_hbm_nb_kernel(x_hbm, w_hbm, o_hbm, send_hbm, recv_hbm, a_tile,
         lax.fori_loop(0, world - 1, ag_drain, None)
 
     def drain(s, _):
-        rs_copy(s).wait_send()
+        for d in range(len(ranges)):
+            rs_copy(s, d).wait_send()
         return _
 
     lax.fori_loop(0, world - 1, drain, None)
@@ -619,6 +694,14 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
 
     variant = ctx.resolve_variant(m, k_loc, n, a.dtype.itemsize)
     item = a.dtype.itemsize
+    dirs = resolve_ring_dirs(ctx.ring_dirs)
+    op_name = "gemm_ar" if all_gather_epilogue else "gemm_rs"
+
+    def emit_overlap(cfg, eff_dirs):
+        from triton_dist_tpu.tools import perf_model as _pm
+        record_overlap(op_name, _pm.estimate_gemm_rs_cost(
+            cfg, m=m, rows=rows, k_loc=k_loc, n=n, itemsize=item,
+            world=world, ring_dirs=eff_dirs))
 
     if variant == "hbm":
         # Clamp ctx hints to divisors + the VMEM budget; fall back to the
@@ -652,10 +735,14 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
         return run_xla()
 
     if variant == "hbm":
+        # Bidir needs >= 2 N-blocks to split between the directions.
+        eff_dirs = dirs if (world > 1 and n // n_blk >= 2) else 1
+        emit_overlap({"variant": "hbm", "block_m": m_blk,
+                      "block_n": n_blk}, eff_dirs)
         kernel = functools.partial(
             _gemm_rs_hbm_nb_kernel, axis=axis, world=world, rows=rows,
             k_loc=k_loc, n=n, m_blk=m_blk, n_blk=n_blk,
-            acc_dtype=ctx.acc_dtype,
+            acc_dtype=ctx.acc_dtype, dirs=eff_dirs,
             all_gather_epilogue=all_gather_epilogue)
 
         def nb_body(xs, ws):
@@ -678,8 +765,10 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
                     pltpu.SemaphoreType.DMA((2,)),
                     pltpu.SemaphoreType.DMA((2,)),
                     pltpu.SemaphoreType.DMA((2,)),
-                    pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
-                    pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+                    pltpu.SemaphoreType.DMA((eff_dirs,
+                                             max(world - 1, 1))),
+                    pltpu.SemaphoreType.DMA((eff_dirs,
+                                             max(world - 1, 1))),
                     pltpu.SemaphoreType.DMA((world,)),
                     pltpu.SemaphoreType.DMA((world,)),
                 ],
@@ -704,6 +793,9 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
                     if c["variant"] == "hbm_kt"]
             if cand:
                 m_blk, k_blk = cand[0]["block_m"], cand[0]["block_k"]
+        # The k-tiled fallback keeps the proven unidirectional ring.
+        emit_overlap({"variant": "hbm_kt", "block_m": m_blk,
+                      "block_k": k_blk}, 1)
         kernel = functools.partial(
             _gemm_rs_hbm_kernel, axis=axis, world=world, rows=rows,
             k_loc=k_loc, n=n, k_blk=k_blk, m_blk=m_blk,
@@ -743,10 +835,13 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
                           out_specs=out_spec, check_vma=False)
         return sync_interpret(f(a, b), interpret)
 
+    # vmem variant: the column split needs lane-aligned halves.
+    eff_dirs = dirs if (world > 1 and n % 256 == 0) else 1
+    emit_overlap({"variant": "vmem"}, eff_dirs)
     scratch = [pltpu.VMEM((world - 1, rows, n), a.dtype),
                pltpu.VMEM((world - 1, rows, n), a.dtype),
-               pltpu.SemaphoreType.DMA((world - 1,)),
-               pltpu.SemaphoreType.DMA((world - 1,))]
+               pltpu.SemaphoreType.DMA((eff_dirs, world - 1)),
+               pltpu.SemaphoreType.DMA((eff_dirs, world - 1))]
     if all_gather_epilogue:
         scratch += [pltpu.SemaphoreType.DMA((world,)),
                     pltpu.SemaphoreType.DMA((world,))]
@@ -754,12 +849,13 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
         def kernel(x_ref, w_ref, o_ref, sb, rb, ss, rs, ags, agr):
             _gemm_rs_kernel(x_ref, w_ref, o_ref, sb, rb, ss, rs,
                             axis=axis, world=world, rows=rows,
-                            acc_dtype=ctx.acc_dtype,
+                            acc_dtype=ctx.acc_dtype, dirs=eff_dirs,
                             all_gather_epilogue=True, ag_sems=(ags, agr))
     else:
         kernel = functools.partial(
             _gemm_rs_kernel, axis=axis, world=world, rows=rows,
-            acc_dtype=ctx.acc_dtype, all_gather_epilogue=False)
+            acc_dtype=ctx.acc_dtype, dirs=eff_dirs,
+            all_gather_epilogue=False)
 
     def body(xs, ws):
         return pl.pallas_call(

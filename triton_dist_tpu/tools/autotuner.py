@@ -129,6 +129,28 @@ def _disk_store(key: str, result: TuneResult) -> None:
         pass
 
 
+#: Last cost-model prune per op family: op -> (n_before, n_after).
+#: Introspectable record of the search-space reduction (the acceptance
+#: log for "prunes >= 4x"); the same pair lands in the obs gauges
+#: ``autotune.<op>.candidates_before/after``.
+LAST_PRUNE: dict[str, tuple[int, int]] = {}
+
+
+def record_prune(op: str, n_before: int, n_after: int) -> None:
+    """Log a cost-model candidate-table prune (perf_model.prune_configs):
+    keeps the before/after counts visible in telemetry and in
+    :data:`LAST_PRUNE` so sweeps can show their search-space reduction."""
+    LAST_PRUNE[op] = (int(n_before), int(n_after))
+    from triton_dist_tpu import obs
+    if obs.enabled():
+        obs.gauge(f"autotune.{op}.candidates_before").set(n_before)
+        obs.gauge(f"autotune.{op}.candidates_after").set(n_after)
+    import logging
+    logging.getLogger("triton_dist_tpu.autotuner").info(
+        "autotune %s: cost model pruned %d candidates -> %d",
+        op, n_before, n_after)
+
+
 _TRACE_FALLBACK_WARNED: set = set()
 
 

@@ -178,6 +178,125 @@ def sweep_flash_decode(mesh, world, shapes, out):
         _emit(row, out)
 
 
+# ---------------------------------------------------------------------------
+# Regression gate (--regress): compare *_vs_xla ratios against the
+# checked-in floors in BASELINE.json and exit nonzero on a drop.
+# ---------------------------------------------------------------------------
+
+def _default_baseline_path() -> str:
+    import os
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "BASELINE.json")
+
+
+def load_floors(baseline_path: str, tier: str) -> dict:
+    """Floor dict for ``tier`` ("tpu" | "cpu") from BASELINE.json's
+    ``regression_floors``. The cpu tier is deliberately lax (near-zero
+    floors): a CPU smoke asserts the harness runs end to end and the
+    keys exist, not interpret-mode throughput."""
+    with open(baseline_path) as f:
+        floors = json.load(f).get("regression_floors", {})
+    if tier not in floors:
+        raise SystemExit(
+            f"BASELINE.json regression_floors has no {tier!r} tier "
+            f"(found {sorted(floors)})")
+    return {k: v for k, v in floors[tier].items()
+            if not k.startswith("_")}
+
+
+def check_regression(extras: dict, floors: dict) -> list[str]:
+    """Machine-check a bench run's ratios against the floors.
+
+    Returns failure strings (empty = pass). A missing or non-numeric
+    key fails — that is how the CPU smoke asserts the harness produced
+    every metric end to end — and a non-null ``baseline_anomaly``
+    fails outright: when the same-matmul XLA baselines disagree, every
+    vs_xla ratio in the run is untrustworthy (docs/perf.md), so a
+    "pass" against floors would be meaningless.
+    """
+    fails = []
+    for key, floor in sorted(floors.items()):
+        val = extras.get(key)
+        if not isinstance(val, (int, float)):
+            fails.append(f"{key}: missing (floor {floor})")
+        elif float(val) < float(floor):
+            fails.append(f"{key}: {val} < floor {floor}")
+    anom = extras.get("baseline_anomaly")
+    if anom:
+        fails.append(f"baseline_anomaly is set - ratios untrustworthy: "
+                     f"{anom}")
+    return fails
+
+
+def _extras_from_file(path: str) -> dict:
+    """Extras dict from any bench artifact: a bench.py checkpoint
+    ({"extras": ...}), a bench.py result line ({"metric", "extras"}),
+    or a plain extras dict."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and isinstance(data.get("extras"), dict):
+        return data["extras"]
+    return data
+
+
+def _extras_from_sweep(mesh, world, on_tpu) -> dict:
+    """Run the standard sweeps and fold rows into bench-style extras:
+    per op the WORST (min) vs_xla across shapes, so a single regressed
+    shape cannot hide behind a good one."""
+    import io
+    buf = io.StringIO()
+    for name, (fn, tpu_shapes, cpu_shapes) in sorted(SWEEPS.items()):
+        fn(mesh, world, tpu_shapes if on_tpu else cpu_shapes, buf)
+    extras: dict = {}
+    for line in buf.getvalue().splitlines():
+        row = json.loads(line)
+        key = f"{row['op']}_vs_xla"
+        if "vs_xla" in row:
+            extras[key] = min(extras.get(key, float("inf")),
+                              row["vs_xla"])
+        elif "error" in row:
+            extras.setdefault(f"{row['op']}_errors", []).append(
+                row["error"])
+    extras["baseline_anomaly"] = None   # sweep shares one timing path
+    return extras
+
+
+def run_regress(baseline_path: str, from_file: str | None,
+                tier: str | None) -> int:
+    skipped: list = []
+    if from_file:
+        extras = _extras_from_file(from_file)
+        if tier is None:
+            tier = ("tpu" if "tpu" in str(extras.get("device_kind", "")
+                                          ).lower() else "cpu")
+    else:
+        mesh, world = _init_mesh()
+        on_tpu = _is_tpu()
+        if tier is None:
+            tier = "tpu" if on_tpu else "cpu"
+        extras = _extras_from_sweep(mesh, world, on_tpu)
+    floors = load_floors(baseline_path, tier)
+    if not from_file:
+        # The live sweep covers the SWEEPS ops only; floors for
+        # bench.py-only metrics (gemm_ar, tp_mlp, ...) apply to --from
+        # checkpoints. Without this filter the missing-key-fails
+        # contract would make the live TPU gate structurally unpassable.
+        sweep_keys = {f"{op}_vs_xla" for op in SWEEPS}
+        skipped = sorted(set(floors) - sweep_keys)
+        floors = {k: v for k, v in floors.items() if k in sweep_keys}
+    fails = check_regression(extras, floors)
+    report = {"tier": tier, "floors": floors, "failures": fails,
+              "floors_skipped_not_swept": skipped,
+              "checked": {k: extras.get(k) for k in sorted(floors)}}
+    print(json.dumps(report, indent=1))
+    if fails:
+        print(f"REGRESSION: {len(fails)} metric(s) below floor",
+              file=sys.stderr)
+        return 1
+    print("regression gate: PASS", file=sys.stderr)
+    return 0
+
+
 SWEEPS = {
     "ag_gemm": (sweep_ag_gemm,
                 [(2048, 4096, 4096), (4096, 4096, 4096),
@@ -212,7 +331,21 @@ def main(argv=None):
                     default="all")
     ap.add_argument("--json", default=None,
                     help="append JSON lines here (default stdout)")
+    ap.add_argument("--regress", action="store_true",
+                    help="compare *_vs_xla ratios against BASELINE.json "
+                         "regression_floors; exit 1 on a drop")
+    ap.add_argument("--baseline", default=None,
+                    help="floor file (default: repo BASELINE.json)")
+    ap.add_argument("--from", dest="from_file", default=None,
+                    help="take ratios from a bench checkpoint/result "
+                         "JSON instead of running the sweep")
+    ap.add_argument("--tier", choices=["tpu", "cpu"], default=None,
+                    help="floor tier (default: by device_kind/backend)")
     args = ap.parse_args(argv)
+
+    if args.regress:
+        return run_regress(args.baseline or _default_baseline_path(),
+                           args.from_file, args.tier)
 
     mesh, world = _init_mesh()
     on_tpu = _is_tpu()

@@ -147,6 +147,217 @@ def estimate_all_reduce_time_ms(nbytes: int, world: int,
             + estimate_reduce_scatter_time_ms(per, world, spec))
 
 
+# ---------------------------------------------------------------------------
+# Fused-kernel config cost model (VMEM/ICI/MXU roofline)
+# ---------------------------------------------------------------------------
+
+#: Per-MXU-dispatch fixed cost inside a Mosaic tile loop (loop
+#: bookkeeping, semaphore ops, the VMEM C-stage copy). Measured round 5
+#: on v5e: at block_m=128/block_n=512 each ~1.4 us dot carried ~0.5 us
+#: of overhead — the gap between the kernel's 135 TFLOPS and the 167
+#: TFLOPS calibration dot (docs/perf.md "Why 135 TFLOPS"). This term is
+#: what makes the model prefer big tiles: halving the tile count halves
+#: the overhead while the roofline terms stay put.
+TILE_OVERHEAD_US = 0.5
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedGemmCost:
+    """Roofline breakdown of one fused comm-GEMM config.
+
+    ``total_ms`` ranks autotune candidates (tile loop + the comm the
+    schedule could not hide); ``overlap_pct`` is the hidden fraction of
+    the ring communication — the per-op ``comms.<op>.overlap_pct``
+    gauge the ops emit (docs/perf.md "Overlap accounting")."""
+    total_ms: float
+    compute_ms: float          # max(mxu, hbm) + tile overhead
+    mxu_ms: float              # FLOP-bound term
+    hbm_ms: float              # HBM-traffic term (tile re-reads incl.)
+    tile_overhead_ms: float    # n_tiles * TILE_OVERHEAD_US
+    comm_ms: float             # ring ICI time for the full payload
+    exposed_comm_ms: float     # comm the tile loop cannot hide
+    overlap_pct: float         # 100 * (1 - exposed/comm); 100 if no comm
+    n_tiles: int
+
+
+def _ring_hops(world: int, ring_dirs: int) -> int:
+    """Critical-path hop count of the AG ring schedule — derived from
+    the kernels' own ``ops.common.ring_hop_counts`` (single source of
+    truth: a future change to the direction split must reprice the
+    cost model automatically). Lazy import: ops.common imports nothing
+    from tools at module scope, but keeping tools → ops edges lazy
+    mirrors the ops → tools convention."""
+    if world <= 1:
+        return 0
+    from triton_dist_tpu.ops.common import ring_hop_counts
+    return max(ring_hop_counts(world, ring_dirs))
+
+
+def _fused_cost(flops: float, hbm_bytes: float, n_tiles: int,
+                comm_ms: float, world: int, hops: int,
+                spec: ChipSpec) -> FusedGemmCost:
+    """Combine the roofline terms with the ring schedule's per-step
+    overlap structure: the hop moving chunk s+1 overlaps the tile loop
+    of chunk s, so each hop hides up to one chunk's compute; the rest
+    is exposed."""
+    mxu_ms = flops / (spec.bf16_tflops * 1e12) * 1e3
+    hbm_ms = hbm_bytes / (spec.hbm_gbps * 1e9) * 1e3
+    tile_ms = n_tiles * TILE_OVERHEAD_US * 1e-3
+    compute_ms = max(mxu_ms, hbm_ms) + tile_ms
+    if world <= 1 or comm_ms <= 0.0 or hops <= 0:
+        exposed_ms, pct = 0.0, 100.0
+    else:
+        t_hop = comm_ms / hops
+        per_chunk = compute_ms / world
+        exposed_ms = hops * max(0.0, t_hop - per_chunk)
+        pct = 100.0 * (1.0 - exposed_ms / comm_ms)
+    return FusedGemmCost(
+        total_ms=compute_ms + exposed_ms, compute_ms=compute_ms,
+        mxu_ms=mxu_ms, hbm_ms=hbm_ms, tile_overhead_ms=tile_ms,
+        comm_ms=comm_ms, exposed_comm_ms=exposed_ms,
+        overlap_pct=round(max(0.0, min(100.0, pct)), 1),
+        n_tiles=n_tiles)
+
+
+def estimate_ag_gemm_cost(cfg: dict, *, m: int, rows: int, k: int,
+                          n_loc: int, itemsize: int, world: int,
+                          spec: ChipSpec | None = None,
+                          ring_dirs: int = 2) -> FusedGemmCost:
+    """Cost of one ``ag_gemm_configs`` entry at (M, K) x (K, N_loc).
+
+    Traffic model per variant (mirrors the kernels' DMA structure):
+    ``vmem`` — operands once, one dot per chunk; ``hbm`` (N-blocked) —
+    B panel once, A re-read once per N-block, C once; ``hbm_kt`` — A
+    once but the B panel re-read per (chunk, m-tile) — the re-read that
+    makes it the huge-K fallback, priced here instead of hidden."""
+    spec = spec or get_chip_spec()
+    variant = cfg.get("variant", "hbm")
+    flops = 2.0 * m * k * n_loc
+    if variant == "vmem":
+        hbm_bytes = itemsize * (rows * k + k * n_loc + m * n_loc + m * k)
+        n_tiles = max(world, 1)
+    elif variant == "hbm":
+        bm = cfg.get("block_m", 256)
+        bn = cfg.get("block_n", 512)
+        n_blocks = max(n_loc // max(bn, 1), 1)
+        m_tiles = max(rows // max(bm, 1), 1)
+        hbm_bytes = itemsize * (m * k * (n_blocks + 1) + k * n_loc
+                                + m * n_loc)
+        n_tiles = world * m_tiles * n_blocks
+    else:  # hbm_kt
+        bm = cfg.get("block_m", 128)
+        bk = cfg.get("block_k", 256)
+        m_tiles = max(rows // max(bm, 1), 1)
+        k_tiles = max(k // max(bk, 1), 1)
+        hbm_bytes = itemsize * (2 * m * k + world * m_tiles * k * n_loc
+                                + m * n_loc)
+        n_tiles = world * m_tiles * k_tiles
+    comm_ms = estimate_all_gather_time_ms(
+        rows * k * itemsize, world, spec,
+        bidir=(ring_dirs == 2 and world > 2))
+    return _fused_cost(flops, hbm_bytes, n_tiles, comm_ms, world,
+                       _ring_hops(world, ring_dirs), spec)
+
+
+def estimate_ag_swiglu_cost(cfg: dict, *, m: int, rows: int, k: int,
+                            n_loc: int, itemsize: int, world: int,
+                            spec: ChipSpec | None = None,
+                            ring_dirs: int = 2) -> FusedGemmCost:
+    """Cost of one ``ag_swiglu_configs`` entry: the N-blocked dual-GEMM
+    kernel (gate AND up panels resident, two dots + the activation per
+    tile, one fused C write)."""
+    spec = spec or get_chip_spec()
+    bm = cfg.get("block_m", 256)
+    bn = cfg.get("block_n", 512)
+    n_blocks = max(n_loc // max(bn, 1), 1)
+    m_tiles = max(rows // max(bm, 1), 1)
+    flops = 2.0 * 2.0 * m * k * n_loc
+    hbm_bytes = itemsize * (m * k * (n_blocks + 1) + 2 * k * n_loc
+                            + m * n_loc)
+    n_tiles = 2 * world * m_tiles * n_blocks   # two dots per tile
+    comm_ms = estimate_all_gather_time_ms(
+        rows * k * itemsize, world, spec,
+        bidir=(ring_dirs == 2 and world > 2))
+    return _fused_cost(flops, hbm_bytes, n_tiles, comm_ms, world,
+                       _ring_hops(world, ring_dirs), spec)
+
+
+def estimate_gemm_rs_cost(cfg: dict, *, m: int, rows: int, k_loc: int,
+                          n: int, itemsize: int, world: int,
+                          spec: ChipSpec | None = None,
+                          ring_dirs: int = 2) -> FusedGemmCost:
+    """Cost of one ``gemm_rs_configs`` entry at (M, K_loc) x (K_loc, N).
+
+    The bidirectional RS halves per-link traffic by sending the two
+    column halves opposite ways, which ``estimate_reduce_scatter_time_ms
+    (bidir=True)`` already prices as half the hops of a full payload."""
+    spec = spec or get_chip_spec()
+    variant = cfg.get("variant", "hbm")
+    flops = 2.0 * m * k_loc * n
+    slab_bytes = 2 * max(world - 1, 0) * rows * n * itemsize
+    if variant == "vmem":
+        hbm_bytes = itemsize * (m * k_loc + k_loc * n + rows * n)
+        n_tiles = max(world, 1)
+    elif variant == "hbm":
+        bm = cfg.get("block_m", 256)
+        bn = cfg.get("block_n", 512)
+        n_blocks = max(n // max(bn, 1), 1)
+        m_tiles = max(rows // max(bm, 1), 1)
+        hbm_bytes = (itemsize * (m * k_loc * n_blocks
+                                 + world * k_loc * n + m * n)
+                     + slab_bytes)
+        n_tiles = world * m_tiles * n_blocks
+    else:  # hbm_kt
+        bm = cfg.get("block_m", 128)
+        bk = cfg.get("block_k", 256)
+        m_tiles = max(rows // max(bm, 1), 1)
+        k_tiles = max(k_loc // max(bk, 1), 1)
+        hbm_bytes = (itemsize * (m * k_loc
+                                 + world * m_tiles * k_loc * n + m * n)
+                     + slab_bytes)
+        n_tiles = world * m_tiles * k_tiles
+    comm_ms = estimate_reduce_scatter_time_ms(
+        rows * n * itemsize, world, spec,
+        bidir=(ring_dirs == 2))
+    return _fused_cost(flops, hbm_bytes, n_tiles, comm_ms, world,
+                       world - 1 if world > 1 else 0, spec)
+
+
+def prune_configs(cfgs, cost_ms_fn, *, factor: int = 4,
+                  keep_min: int = 2, always_keep=None):
+    """Cost-model pruning of an autotune candidate table.
+
+    Keeps ``max(keep_min, len(cfgs) // factor)`` entries: first the
+    best-cost config matching ``always_keep`` (the downstream-clamp
+    fallback variants pruning must never drop — review r5l finding 1),
+    then the best-ranked remainder. Every kept entry still runs under
+    the sweep's per-config compile-failure isolation; pruning trims the
+    ~30 s-per-candidate Mosaic compile bill, it does not relax safety.
+
+    Returns ``(pruned, n_before)`` so callers can log the counts
+    (``tools.autotuner.record_prune``).
+    """
+    cfgs = list(cfgs)
+    n_before = len(cfgs)
+    if n_before <= keep_min:
+        return cfgs, n_before
+    costs = [float(cost_ms_fn(c)) for c in cfgs]
+    order = sorted(range(n_before), key=lambda i: costs[i])
+    n_keep = max(keep_min, n_before // factor)
+    picked: list[int] = []
+    if always_keep is not None:
+        musts = [i for i in order if always_keep(cfgs[i])]
+        if musts:
+            picked.append(musts[0])
+    for i in order:
+        if len(picked) >= n_keep:
+            break
+        if i not in picked:
+            picked.append(i)
+    picked.sort(key=lambda i: costs[i])
+    return [cfgs[i] for i in picked], n_before
+
+
 def overlap_efficiency(gemm_ms: float, comm_ms: float) -> float:
     """Upper bound on fused-op gain: serial/(overlapped) time ratio. 1.0 =
     no win, 2.0 = perfect hiding of the shorter phase (the BASELINE.md
