@@ -67,6 +67,21 @@ os.environ.setdefault(
     "TDT_AUTOTUNE_CACHE",
     os.path.join(os.path.dirname(os.path.abspath(__file__)),
                  ".tdt_autotune_cache.json"))
+def _resilience_env() -> None:
+    """Bench-run resilience posture (called from main(), NOT at import
+    — tests import this module and must not inherit these settings).
+
+    The bench MEASURES the fused kernels the resilience router
+    consults BASELINE ratios about — routing a bench call to its XLA
+    fallback would make every *_vs_xla ratio silently measure XLA vs
+    XLA (= 1.0) and poison the very data the router runs on. Force the
+    fused path; the per-part subprocess deadlines still bound any
+    compile hang, and watchdog trips land in the known-bad cache at
+    its DEFAULT path — deliberately not a bench-local file, so a hang
+    found here protects every later process on this machine (serving,
+    smoke reruns) that reads the same default. Children inherit the
+    flag via os.environ."""
+    os.environ.setdefault("TDT_FORCE_FUSED", "1")
 
 _T0 = time.monotonic()
 
@@ -1253,6 +1268,7 @@ def _fallback_scan_paths() -> list:
 
 
 def main():
+    _resilience_env()
     extras: dict = {}
     result = {"metric": "ag_gemm_tflops", "value": None, "unit": "TFLOPS",
               "vs_baseline": None, "extras": extras}

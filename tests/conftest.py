@@ -61,3 +61,24 @@ def _isolate_autotune_cache(monkeypatch):
     cache (TDT_AUTOTUNE_CACHE); the disk-cache tests opt back in with
     their own tmp_path setenv."""
     monkeypatch.delenv("TDT_AUTOTUNE_CACHE", raising=False)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_resilience(monkeypatch, tmp_path):
+    """Point the resilience known-bad cache at a per-test temp file
+    (never the developer's ~/.cache) and reset all process-local
+    resilience state (breakers, compiled-key set, fault plan) around
+    each test, so a breaker tripped in one test cannot silently route
+    another test's fused path to XLA."""
+    monkeypatch.setenv("TDT_KNOWN_BAD_CACHE",
+                       str(tmp_path / "known_bad.json"))
+    # Defense in depth: a module imported by one test (bench.py sets
+    # this for real runs) must not pin routing for every later test.
+    monkeypatch.delenv("TDT_FORCE_FUSED", raising=False)
+    from triton_dist_tpu import resilience
+    from triton_dist_tpu.testing import faults
+    resilience.reset_for_tests()
+    faults.clear()
+    yield
+    resilience.reset_for_tests()
+    faults.clear()

@@ -56,23 +56,31 @@ def _init_backend(retries: int = 3, backoff_s: float = 20.0):
 def run_smoke(log_path: str | None = None, only: str | None = None,
               interpret: bool = False, list_only: bool = False,
               skip: str | None = None, export_lint: bool = False,
-              world: int = 1) -> int:
+              world: int = 1, case_timeout: float = 420.0) -> int:
     import jax
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    # The smoke exists to exercise the FUSED kernels: the resilience
+    # router must never silently divert a case to its XLA fallback
+    # (a smoke that "passed" on XLA would be worse than one that
+    # failed; under FORCE_FUSED the router records infra failures and
+    # re-raises instead of falling back). The compile watchdog below
+    # still guards every case.
+    os.environ.setdefault("TDT_FORCE_FUSED", "1")
+    # Arm the router's OWN per-op watchdog below the case deadline so
+    # a hang is recorded under the real (op, config, device_kind) key
+    # the production router checks — the cross-process protection the
+    # known-bad cache promises. The case-level watchdog (below) stays
+    # as the backstop for hangs outside any op entry (jit, transfer).
+    if not list_only:
+        os.environ.setdefault("TDT_COMPILE_TIMEOUT_S",
+                              str(max(case_timeout * 0.8, 1.0)))
+
     results: list[tuple[str, str, str]] = []  # (name, status, detail)
 
-    def _finite(out) -> bool:
-        leaves = jax.tree_util.tree_leaves(out)
-        for leaf in leaves:
-            if isinstance(leaf, jax.Array) and jnp.issubdtype(
-                    leaf.dtype, jnp.floating):
-                if not bool(jnp.isfinite(
-                        leaf.astype(jnp.float32)).all()):
-                    return False
-        return True
+    from triton_dist_tpu.runtime.utils import tree_all_finite as _finite
 
     skips = [s for s in (skip or "").split(",") if s]
 
@@ -89,8 +97,12 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
                     return
             elif only not in name:
                 return
+        from triton_dist_tpu.resilience import (CompileTimeout,
+                                                known_bad_cache,
+                                                run_with_timeout)
         t0 = time.perf_counter()
-        try:
+
+        def run_case():
             if export_lint:
                 # Lower + serialize the case for the TPU platform on
                 # this (CPU) host: runs the Pallas→Mosaic lowering and
@@ -101,15 +113,35 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
                 # constraints"). No kernel executes.
                 from jax import export as jexport
                 jexport.export(jax.jit(fn), platforms=("tpu",))()
-                out = None
-                ok = True
-            else:
-                out = fn()
-                jax.block_until_ready(out)
-                ok = _finite(out)
+                return None, True
+            out = fn()
+            jax.block_until_ready(out)
+            return out, _finite(out)
+
+        try:
+            # Every case runs under the compile watchdog: a Mosaic
+            # hang marks THIS case TIMEOUT and the queue advances —
+            # the r5 failure mode was one hang wedging every case
+            # behind it. The worker thread is abandoned, never killed
+            # (killing mid-compile is the known tunnel-wedge trigger).
+            out, ok = run_with_timeout(run_case, case_timeout,
+                                       op=f"smoke:{name}")
             dt = time.perf_counter() - t0
             results.append((name, "PASS" if ok else "NONFINITE",
                             f"{dt:.1f}s"))
+        except CompileTimeout as e:
+            dt = time.perf_counter() - t0
+            known_bad_cache().record(f"smoke:{name}", "case",
+                                    dev.device_kind
+                                    if hasattr(dev, "device_kind")
+                                    else dev.platform,
+                                    reason=str(e))
+            # e.timeout_s distinguishes the router's inner per-op trip
+            # (0.8x, real op key recorded) from the case-level backstop.
+            results.append((name, "TIMEOUT",
+                            f"{dt:.1f}s abandoned after "
+                            f"{e.timeout_s:.0f}s (known-bad recorded; "
+                            f"queue advances)"))
         except Exception as e:  # noqa: BLE001 — record and continue
             dt = time.perf_counter() - t0
             tb = traceback.format_exc().strip().splitlines()
@@ -320,8 +352,10 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         P("tp"))
     # Pin "direct" explicitly: the context default is now "gathered"
     # (production must not wedge on the un-root-caused direct compile
-    # hang), but THIS case exists precisely to keep monitoring that
-    # hang — it must stay on the direct block-table kernel.
+    # hang), but THIS case is the compile watchdog's LIVE CANARY — it
+    # re-enters the direct block-table kernel every smoke run, and the
+    # per-case watchdog turns a recurrence of the r5 hang into one
+    # TIMEOUT line + a known-bad record while the queue advances.
     import dataclasses as _dc
     fd_paged = _dc.replace(
         create_flash_decode_context(mesh, "tp", interpret=interpret),
@@ -576,9 +610,15 @@ def run_subproc(log_path: str, timeout_s: float,
     run (03:23 on 07-31 — the case PASSed, the process never exited).
     The case's own output is authoritative: a lingering child whose
     output already says PASS/FAIL is scored as such and the run
-    CONTINUES; only a case with no written result is a genuine compile
-    hang, which stops the run (later compiles would only queue behind
-    the stuck one). ``--start-after`` resumes a stopped run."""
+    CONTINUES; a case with no written result is a genuine compile hang,
+    scored TIMEOUT and recorded in the resilience known-bad cache — the
+    QUEUE ADVANCES past it (the r5 whole-queue wedge class: one bad
+    kernel must not cost the rest of the round). TWO CONSECUTIVE hangs
+    mean the tunnel itself is wedged, not a kernel: every later case
+    would queue behind the same stuck compile and burn a full timeout
+    each (and a second known-bad record would blame a case that never
+    got to compile), so the run stops there. ``--start-after`` resumes
+    a partial run."""
     import subprocess
     names = subprocess.run(
         [sys.executable, __file__, "--list"], capture_output=True,
@@ -602,18 +642,27 @@ def run_subproc(log_path: str, timeout_s: float,
             f.write(line + "\n")
 
     def case_result(out_path, name):
-        """Parse the child's own result line: (status, detail) or None."""
+        """Parse the child's own result line: (status, detail) or None.
+
+        TIMEOUT: the child's own watchdogs (armed at 0.8x/1.0x the
+        case timeout, clocks starting after interpreter startup)
+        usually trip BEFORE the parent's Popen-anchored deadline — the
+        child then writes its TIMEOUT line and hard-exits, and the
+        parent must score it as the hang it is, not "FAIL rc=1"."""
         try:
             with open(out_path) as f:
                 for ln in f.read().splitlines():
                     toks = ln.split()
                     if toks[:1] == [name] and len(toks) >= 2 and \
-                            toks[1] in ("PASS", "FAIL"):
+                            toks[1] in ("PASS", "FAIL", "TIMEOUT",
+                                        "NONFINITE"):
                         return toks[1], " ".join(toks[2:])
         except OSError:
             pass
         return None
 
+    from triton_dist_tpu.resilience import known_bad_cache
+    consecutive_hangs = 0
     stopped = False
     for name in names:
         t0 = time.perf_counter()
@@ -621,7 +670,8 @@ def run_subproc(log_path: str, timeout_s: float,
         with open(out_path, "w") as out:
             child = subprocess.Popen(
                 [sys.executable, __file__, "--only", f"={name}",
-                 "--hard-exit", "--log", log_path + ".case"],
+                 "--hard-exit", "--case-timeout", str(timeout_s),
+                 "--log", log_path + ".case"],
                 stdout=out, stderr=subprocess.STDOUT)
         hung = False
         while child.poll() is None:
@@ -632,11 +682,25 @@ def run_subproc(log_path: str, timeout_s: float,
         dt = time.perf_counter() - t0
         parsed = case_result(out_path, name)
         if hung and parsed is None:
-            emit(f"{name:<28} {'HANG':<9} {dt:.0f}s abandoned after "
-                 f"{timeout_s:.0f}s (never killed; run stops here)")
             n_fail += 1
-            stopped = True
-            break
+            consecutive_hangs += 1
+            if consecutive_hangs >= 2:
+                # Second hang in a row: that's the TUNNEL wedged, not
+                # this kernel — no known-bad record (it would blame a
+                # case that never reached its compile), and no point
+                # burning a timeout per remaining case.
+                emit(f"{name:<28} {'TIMEOUT':<9} {dt:.0f}s second "
+                     f"consecutive hang — tunnel wedged, run stops "
+                     f"(no known-bad recorded for this case)")
+                stopped = True
+                break
+            known_bad_cache().record(f"smoke:{name}", "subproc-case",
+                                     "tunnel", reason="compile hang "
+                                     f"abandoned after {timeout_s:.0f}s")
+            emit(f"{name:<28} {'TIMEOUT':<9} {dt:.0f}s abandoned after "
+                 f"{timeout_s:.0f}s (never killed; known-bad recorded; "
+                 f"queue advances)")
+            continue
         if parsed is not None:
             status, detail = parsed
             if hung:
@@ -644,12 +708,23 @@ def run_subproc(log_path: str, timeout_s: float,
         else:
             status = "PASS" if child.returncode == 0 else "FAIL"
             detail = f"rc={child.returncode}"
+        # Child-detected hangs (its own watchdog tripped and it wrote
+        # TIMEOUT) feed the wedged-tunnel accounting like parent-
+        # detected ones; anything else resets the streak.
+        consecutive_hangs = (consecutive_hangs + 1
+                             if status == "TIMEOUT" else 0)
         if not hung:
             os.unlink(out_path)
         n_fail += status != "PASS"
         emit(f"{name:<28} {status:<9} {dt:.0f}s {detail}")
-    report = "\n".join(lines + [f"TOTAL {len(names)} ops, {n_fail} failing"
-                                + (" [STOPPED on hang]" if stopped else "")])
+        if consecutive_hangs >= 2:
+            emit("second consecutive hang — tunnel wedged, run stops")
+            stopped = True
+            break
+    report = "\n".join(lines + [f"TOTAL {len(names)} ops, "
+                                f"{n_fail} failing"
+                                + (" [STOPPED: tunnel wedged]"
+                                   if stopped else "")])
     with open(log_path, "a") as f:
         f.write(report + "\n")
     print(report.splitlines()[-1])
@@ -665,7 +740,12 @@ if __name__ == "__main__":
                     help="print case names (CPU; no kernels run)")
     ap.add_argument("--subproc", action="store_true",
                     help="one subprocess per case with a hard timeout")
-    ap.add_argument("--case-timeout", type=float, default=420.0)
+    ap.add_argument("--case-timeout", type=float, default=420.0,
+                    help="per-case deadline (seconds): the subprocess "
+                         "hard timeout under --subproc, the in-process "
+                         "compile-watchdog budget otherwise; a trip "
+                         "marks the case TIMEOUT, records it in the "
+                         "known-bad cache, and the queue advances")
     ap.add_argument("--skip", default=None,
                     help="comma-separated exact case names to exclude "
                          "(e.g. risky never-compiled kernels, run last "
@@ -710,7 +790,8 @@ if __name__ == "__main__":
                 f" --xla_force_host_platform_device_count={args.world}"
             ).strip()
     rc = run_smoke(args.log, args.only, skip=args.skip,
-                   export_lint=args.export_lint, world=args.world)
+                   export_lint=args.export_lint, world=args.world,
+                   case_timeout=args.case_timeout)
     if args.hard_exit:
         sys.stdout.flush()
         sys.stderr.flush()

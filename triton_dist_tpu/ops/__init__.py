@@ -11,4 +11,12 @@ Each op has (at least) two implementations:
   the golden baseline, like the reference's torch/NCCL goldens.
 - ``impl="pallas"`` — fused Pallas kernel with explicit remote DMA /
   semaphore overlap (compiled on TPU, interpreted on CPU meshes).
+
+Resilience contract (docs/resilience.md): every public entry here
+wears the ``@resilient`` decorator, registering its ``impl="xla"``
+branch as the always-available escape hatch — the router diverts
+known-bad configs, BASELINE-measured slow regimes, and open-breaker
+ops to it, and retries fused infra failures on it with bit-identical
+numerics. ``tools/fallback_lint.py`` (quick tier) rejects any new
+entry that ships without one.
 """

@@ -41,6 +41,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
+from triton_dist_tpu.resilience import resilient
 from triton_dist_tpu.ops.common import (
     cdiv,
     comm_params,
@@ -197,6 +198,7 @@ def _a2a_kernel(send_counts_ref, recv_counts_ref, send_ref, recv_ref,
     lax.fori_loop(1, world, drain, None)
 
 
+@resilient("all_to_all")
 def fast_all_to_all(send_buf: jax.Array, send_counts: jax.Array,
                     ctx: AllToAllContext | None = None,
                     impl: str = "pallas"):

@@ -37,6 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
+from triton_dist_tpu.resilience import resilient
 from triton_dist_tpu.ops.common import (
     comm_params,
     maybe_noise,
@@ -301,6 +302,7 @@ def _full_mesh_push_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis: str,
 # Functional entry
 # ---------------------------------------------------------------------------
 
+@resilient("allgather")
 def all_gather(x: jax.Array, ctx: AllGatherContext | None = None,
                impl: str = "pallas", stacked: bool = False) -> jax.Array:
     """Gather ``x`` (sharded on dim 0 over ``ctx.axis``) onto every device.
@@ -367,6 +369,7 @@ def all_gather(x: jax.Array, ctx: AllGatherContext | None = None,
     return sync_interpret(f(x), interpret)
 
 
+@resilient("broadcast")
 def broadcast(x: jax.Array, root: int = 0,
               ctx: AllGatherContext | None = None,
               impl: str = "pallas") -> jax.Array:

@@ -34,6 +34,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
+from triton_dist_tpu.resilience import resilient
 from triton_dist_tpu.ops.common import (
     DEFAULT_VMEM_BUDGET,
     HARD_FOOTPRINT_CAP,
@@ -635,6 +636,7 @@ def _autotune_ag_gemm(a, bs, ctx, key, n_tot_loc):
     return result.config
 
 
+@resilient("ag_gemm", env_keys=("TDT_RING_DIRS",))
 def ag_gemm_multi(a: jax.Array, bs,
                   ctx: AllGatherGEMMContext | None = None,
                   impl: str = "pallas"):
@@ -1076,6 +1078,7 @@ def _ag_swiglu_hbm_kernel(x_hbm, wg_hbm, wu_hbm, *rest, axis: str,
     ring_drain()
 
 
+@resilient("ag_swiglu", env_keys=("TDT_RING_DIRS",))
 def ag_swiglu(a: jax.Array, w_gate: jax.Array, w_up: jax.Array,
               ctx: AllGatherGEMMContext | None = None,
               impl: str = "pallas",

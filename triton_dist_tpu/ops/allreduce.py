@@ -37,6 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
+from triton_dist_tpu.resilience import resilient
 from triton_dist_tpu.ops.common import (
     comm_params,
     nestable_shard_map,
@@ -253,6 +254,7 @@ def _two_shot_ar_kernel(x_ref, o_ref, send_buf, recv_buf, send_sem, recv_sem,
     lax.fori_loop(0, world - 1, drain, None)
 
 
+@resilient("allreduce")
 def all_reduce(x: jax.Array, ctx: AllReduceContext | None = None,
                impl: str = "pallas", stacked: bool = False) -> jax.Array:
     """Sum per-device partials; every device receives the total.

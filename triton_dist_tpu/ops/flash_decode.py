@@ -56,6 +56,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
+from triton_dist_tpu.resilience import resilient
 from triton_dist_tpu.ops.common import (
     any_spec,
     comm_params,
@@ -386,6 +387,7 @@ def _combine_shapes(world, b, hkv, groups, d):
             jax.ShapeDtypeStruct((world, b, hkv, groups), jnp.float32))
 
 
+@resilient("flash_decode")
 def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
                          cache_v: jax.Array, kv_len: jax.Array,
                          ctx: FlashDecodeContext | None = None,
@@ -510,6 +512,7 @@ def gqa_fwd_batch_decode(q: jax.Array, cache_k: jax.Array,
     return sync_interpret(f(q, kv_len, cache_k, cache_v), interpret)
 
 
+@resilient("flash_decode_paged", env_keys=("TDT_PAGED_VARIANT",))
 def gqa_fwd_batch_decode_paged(q: jax.Array, pool_k: jax.Array,
                                pool_v: jax.Array, block_table: jax.Array,
                                kv_len: jax.Array,

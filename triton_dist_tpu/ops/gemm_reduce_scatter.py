@@ -32,6 +32,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
+from triton_dist_tpu.resilience import resilient
 from triton_dist_tpu.ops.common import (
     DEFAULT_VMEM_BUDGET,
     HARD_FOOTPRINT_CAP,
@@ -874,6 +875,7 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
     return sync_interpret(f(a, b), interpret)
 
 
+@resilient("gemm_rs", env_keys=("TDT_RING_DIRS",))
 def gemm_rs(a: jax.Array, b: jax.Array,
             ctx: GEMMReduceScatterContext | None = None,
             impl: str = "pallas") -> jax.Array:
@@ -887,6 +889,7 @@ def gemm_rs(a: jax.Array, b: jax.Array,
     return _entry(a, b, ctx, impl, all_gather_epilogue=False)
 
 
+@resilient("gemm_ar", env_keys=("TDT_RING_DIRS",))
 def gemm_ar(a: jax.Array, b: jax.Array,
             ctx: GEMMReduceScatterContext | None = None,
             impl: str = "pallas") -> jax.Array:

@@ -29,6 +29,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
+from triton_dist_tpu.resilience import resilient
 from triton_dist_tpu.ops.common import (
     DEFAULT_VMEM_BUDGET,
     any_spec,
@@ -301,6 +302,7 @@ def create_ag_group_gemm_context(mesh: Mesh | None = None, axis: str = "tp",
 _IMPL_TUNED: dict = {}
 
 
+@resilient("ag_group_gemm", fused_impls=("fused", "auto"))
 def ag_group_gemm(x: jax.Array, w: jax.Array, expert_ids: jax.Array,
                   num_experts: int, ctx: AGGroupGEMMContext | None = None,
                   impl: str = "ring") -> jax.Array:

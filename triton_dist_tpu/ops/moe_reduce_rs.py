@@ -56,6 +56,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
+from triton_dist_tpu.resilience import resilient
 from triton_dist_tpu.ops.common import (
     DEFAULT_VMEM_BUDGET,
     any_spec,
@@ -260,6 +261,7 @@ def create_moe_rs_context(mesh: Mesh | None = None, axis: str = "tp",
 _IMPL_TUNED: dict = {}
 
 
+@resilient("moe_reduce_rs", fused_impls=("fused", "auto"))
 def moe_reduce_rs(act: jax.Array, w_down: jax.Array, expert_ids: jax.Array,
                   weights: jax.Array, ctx: MoEReduceRSContext,
                   impl: str = "ring") -> jax.Array:

@@ -28,6 +28,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
+from triton_dist_tpu.resilience import resilient
 from triton_dist_tpu.ops.common import (
     comm_params,
     nestable_shard_map,
@@ -70,6 +71,7 @@ def _shift_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis: str,
                    axis=axis).wait_send()
 
 
+@resilient("pp_shift")
 def pp_shift(x: jax.Array, ctx: P2PContext | None = None, delta: int = 1,
              impl: str = "pallas") -> jax.Array:
     """Shift per-stage activations one pipeline hop (functional entry;

@@ -33,6 +33,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
+from triton_dist_tpu.resilience import resilient
 from triton_dist_tpu.ops.common import (
     comm_params,
     nestable_shard_map,
@@ -194,6 +195,7 @@ def _one_shot_rs_kernel(x_ref, o_ref, stage_ref, send_sem, recv_sem, *,
     lax.fori_loop(1, world, wait_send, None)
 
 
+@resilient("reduce_scatter")
 def reduce_scatter(x: jax.Array, ctx: ReduceScatterContext | None = None,
                    impl: str = "pallas") -> jax.Array:
     """Reduce-scatter ``x`` along dim 0: every device holds the full (M, N)

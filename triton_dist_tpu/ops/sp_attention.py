@@ -51,6 +51,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 import triton_dist_tpu.language as dl
+from triton_dist_tpu.resilience import resilient
 from triton_dist_tpu.ops.allgather import (
     AllGatherContext, create_allgather_context, all_gather)
 from triton_dist_tpu.ops.common import (
@@ -459,6 +460,7 @@ def sp_ag_attention_fused(q: jax.Array, k: jax.Array, v: jax.Array,
     return sync_interpret(f(q, k, v), interpret)
 
 
+@resilient("sp_attention", fused_impls=("pallas", "ag_pallas"))
 def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     ctx: SpAttentionContext | None = None,
                     impl: str = "ring", q_offset=0,

@@ -1,0 +1,160 @@
+"""Per-op circuit breakers for the fused kernel paths.
+
+Classic three-state breaker (closed → open → half-open → closed),
+scoped per op family: ``TDT_BREAKER_THRESHOLD`` consecutive infra
+failures of an op's fused path open its breaker, routing every call to
+the XLA reference path for ``TDT_BREAKER_COOLDOWN_S`` seconds; the
+first call after the cooldown runs fused as a half-open probe, and its
+outcome decides between re-closing and re-opening. A bad kernel config
+thus degrades at most N requests, never the process — the ROADMAP
+"serves heavy traffic" posture.
+
+State changes emit ``resilience.<op>.breaker_state`` (0 closed /
+1 open / 2 half-open), ``resilience.<op>.breaker_opens``, and the
+aggregate ``resilience.breakers_open`` gauge through ``obs``.
+
+The clock is injectable (``clock=``) so the full state machine is
+testable without sleeping.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from triton_dist_tpu import obs
+
+__all__ = ["CLOSED", "OPEN", "HALF_OPEN", "CircuitBreaker",
+           "get_breaker", "all_breakers", "reset_breakers"]
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+#: Gauge encoding of the states (docs/observability.md).
+STATE_GAUGE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+DEFAULT_THRESHOLD = 3
+DEFAULT_COOLDOWN_S = 30.0
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v else default
+
+
+class CircuitBreaker:
+    def __init__(self, op: str, threshold: int | None = None,
+                 cooldown_s: float | None = None, clock=time.monotonic):
+        self.op = op
+        self.threshold = (threshold if threshold is not None else
+                          _env_int("TDT_BREAKER_THRESHOLD",
+                                   DEFAULT_THRESHOLD))
+        self.cooldown_s = (cooldown_s if cooldown_s is not None else
+                           _env_float("TDT_BREAKER_COOLDOWN_S",
+                                      DEFAULT_COOLDOWN_S))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_at: float | None = None
+        self._emit()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _emit(self) -> None:
+        obs.gauge(f"resilience.{self.op}.breaker_state").set(
+            STATE_GAUGE[self._state])
+        _emit_open_count()
+
+    def allow(self) -> bool:
+        """May the fused path run right now? An expired cooldown
+        transitions open → half-open and admits ONE probe call; other
+        callers keep getting the fallback until the probe reports. A
+        probe that never reports (its outcome lost — e.g. a trace that
+        never executes, or a crashed worker) self-heals: after another
+        cooldown interval the next caller becomes the new probe."""
+        with self._lock:
+            now = self._clock()
+            if self._state == OPEN:
+                if now - self._opened_at >= self.cooldown_s:
+                    self._state = HALF_OPEN
+                    self._probe_at = now
+                    self._emit()
+                    return True
+                return False
+            if self._state == HALF_OPEN:
+                if (self._probe_at is None
+                        or now - self._probe_at >= self.cooldown_s):
+                    self._probe_at = now
+                    return True
+                return False
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probe_at = None
+            if self._state != CLOSED:
+                self._state = CLOSED
+                self._emit()
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._open()
+                return
+            self._failures += 1
+            if self._state == CLOSED and self._failures >= self.threshold:
+                self._open()
+
+    def _open(self) -> None:
+        # Caller holds the lock.
+        self._state = OPEN
+        self._failures = 0
+        self._probe_at = None
+        self._opened_at = self._clock()
+        obs.counter(f"resilience.{self.op}.breaker_opens").inc()
+        self._emit()
+
+
+_BREAKERS: dict[str, CircuitBreaker] = {}
+# RLock: get_breaker holds it while CircuitBreaker.__init__ emits the
+# initial state, which re-enters here for the aggregate gauge.
+_REG_LOCK = threading.RLock()
+
+
+def _emit_open_count() -> None:
+    with _REG_LOCK:
+        open_count = sum(1 for b in _BREAKERS.values()
+                         if b._state != CLOSED)
+    obs.gauge("resilience.breakers_open").set(open_count)
+
+
+def get_breaker(op: str) -> CircuitBreaker:
+    with _REG_LOCK:
+        b = _BREAKERS.get(op)
+        if b is None:
+            b = _BREAKERS[op] = CircuitBreaker(op)
+        return b
+
+
+def all_breakers() -> dict[str, CircuitBreaker]:
+    with _REG_LOCK:
+        return dict(_BREAKERS)
+
+
+def reset_breakers() -> None:
+    """Drop every breaker (tests; thresholds re-read env on rebuild)."""
+    with _REG_LOCK:
+        _BREAKERS.clear()

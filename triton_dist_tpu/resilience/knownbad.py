@@ -1,0 +1,168 @@
+"""On-disk known-bad config cache: never re-enter a compile hang.
+
+Round 5's paged flash-decode ``direct`` kernel hung Mosaic and wedged
+the hardware queue for the rest of the round; nothing recorded the
+(op, config, device_kind) that did it, so the next session was one
+env-var typo away from re-entering the same hang. This cache is that
+record: the compile watchdog writes the exact tuple on every trip, the
+fallback router checks it before dispatching a fused kernel, and the
+file persists across processes so a hang discovered by ``tpu_smoke``
+protects the serving process that starts an hour later.
+
+File format (``docs/resilience.md``): a single JSON object mapping
+``"<op>|<device_kind>|<config>"`` →
+
+    {"op": ..., "device_kind": ..., "config": ...,
+     "reason": ..., "ts": <unix seconds>}
+
+Writes are atomic (tmp + ``os.replace``) and merge with the on-disk
+state first, so concurrent processes can both record trips without
+losing entries. A corrupt or unreadable file reads as empty — the
+resilience layer must degrade the cache, never the op path.
+
+Path resolution: ``TDT_KNOWN_BAD_CACHE`` env var, else
+``~/.cache/triton_dist_tpu/known_bad.json`` (tests isolate via the
+env var, like ``TDT_AUTOTUNE_CACHE``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from triton_dist_tpu import obs
+
+__all__ = ["KnownBadCache", "cache_path", "get_cache", "make_key",
+           "reset_cache"]
+
+
+def cache_path() -> str:
+    env = os.environ.get("TDT_KNOWN_BAD_CACHE")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "triton_dist_tpu", "known_bad.json")
+
+
+def make_key(op: str, config: str, device_kind: str) -> str:
+    """The cache key for one (op, config, device_kind) tuple. ``|`` is
+    the field separator; embedded pipes in config are tolerated (the
+    key is only ever compared whole)."""
+    return f"{op}|{device_kind}|{config}"
+
+
+class KnownBadCache:
+    """Lazy-loading view of one known-bad cache file."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path or cache_path()
+        self._lock = threading.Lock()
+        self._entries: dict[str, dict] | None = None
+
+    def _read_disk(self) -> dict[str, dict]:
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            if isinstance(data, dict):
+                return {k: v for k, v in data.items()
+                        if isinstance(v, dict)}
+        except (OSError, ValueError):
+            pass
+        return {}
+
+    def _loaded(self) -> dict[str, dict]:
+        """The live entry dict (lazy first load). Callers treat it as
+        read-only; mutation happens only in :meth:`record` under the
+        lock, so lock-free membership reads are race-benign."""
+        with self._lock:
+            if self._entries is None:
+                self._entries = self._read_disk()
+                self._emit_size()
+            return self._entries
+
+    @staticmethod
+    def _expired(entry: dict) -> bool:
+        """TDT_KNOWN_BAD_TTL_S (seconds; 0/unset = never expire) ages
+        entries out of every view — routing, entries(), len, and the
+        size gauge agree — for environments where a trip may have been
+        slow-that-day rather than hung."""
+        ttl = float(os.environ.get("TDT_KNOWN_BAD_TTL_S", "0") or 0)
+        return ttl > 0 and time.time() - entry.get("ts", 0.0) > ttl
+
+    def entries(self) -> dict[str, dict]:
+        return {k: v for k, v in self._loaded().items()
+                if not self._expired(v)}
+
+    def _emit_size(self) -> None:
+        live = sum(1 for v in (self._entries or {}).values()
+                   if not self._expired(v))
+        obs.gauge("resilience.known_bad.size").set(live)
+
+    def __contains__(self, key: str) -> bool:
+        # Hot path: router.decide() calls this per eager guarded op —
+        # membership on the live dict, no copy.
+        entry = self._loaded().get(key)
+        return entry is not None and not self._expired(entry)
+
+    def __len__(self) -> int:
+        return sum(1 for v in self._loaded().values()
+                   if not self._expired(v))
+
+    def refresh(self) -> None:
+        """Drop the in-memory view; the next read reloads from disk
+        (pick up another process's trips without restarting)."""
+        with self._lock:
+            self._entries = None
+
+    def record(self, op: str, config: str, device_kind: str,
+               reason: str) -> str:
+        """Persist one known-bad tuple; returns its key. Merges with
+        the current on-disk state under the lock so concurrent
+        recorders do not drop each other's entries."""
+        key = make_key(op, config, device_kind)
+        entry = {"op": op, "device_kind": device_kind, "config": config,
+                 "reason": reason, "ts": time.time()}
+        with self._lock:
+            merged = self._read_disk()
+            if self._entries:
+                merged.update(self._entries)
+            merged[key] = entry
+            self._entries = merged
+            try:
+                d = os.path.dirname(self.path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                tmp = f"{self.path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(merged, f, indent=1, sort_keys=True)
+                os.replace(tmp, self.path)
+            except OSError:
+                # Disk trouble must not mask the failure being
+                # recorded; the in-memory entry still routes this
+                # process away from the bad config.
+                pass
+            self._emit_size()
+        return key
+
+
+_CACHE: KnownBadCache | None = None
+_CACHE_LOCK = threading.Lock()
+
+
+def get_cache() -> KnownBadCache:
+    """Process-wide cache singleton, rebuilt if the configured path
+    changed (tests repoint ``TDT_KNOWN_BAD_CACHE`` per test)."""
+    global _CACHE
+    path = cache_path()
+    with _CACHE_LOCK:
+        if _CACHE is None or _CACHE.path != path:
+            _CACHE = KnownBadCache(path)
+        return _CACHE
+
+
+def reset_cache() -> None:
+    global _CACHE
+    with _CACHE_LOCK:
+        _CACHE = None

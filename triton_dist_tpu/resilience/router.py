@@ -1,0 +1,522 @@
+"""Fallback router: every fused op keeps an always-available escape
+hatch to its XLA reference path.
+
+Triton-distributed itself treats the hand-written overlapped kernel as
+one routing choice among several per shape/topology (arXiv:2504.19442
+§5), and T3-style transparent overlap (arXiv:2401.16677) presumes a
+safe non-fused path always exists. This module makes that stance
+structural: the :func:`resilient` decorator wraps every public op
+entry in ``ops/`` and, per call, chooses between the fused
+implementation and the op's ``impl="xla"`` reference branch — the same
+function, same arguments, different ``impl`` — so a fallback is
+bit-identical to calling the reference path directly.
+
+Routing order (first match wins), per (op, config, device_kind):
+
+1. ``TDT_FORCE_FUSED=1``    → fused, always (bench / smoke / manual
+   revalidation; the watchdog still guards the compile).
+2. known-bad cache hit      → XLA (``resilience.knownbad`` — a config
+   that ever hung Mosaic is never re-entered, across processes).
+3. BASELINE policy          → XLA for regimes where the measured
+   ``<op>_vs_xla`` ratio says the fused kernel is slower
+   (``BASELINE.json`` ``regression_floors``; see :func:`policy_reason`).
+4. open circuit breaker     → XLA until the cooldown's half-open probe
+   (``resilience.breaker``).
+5. otherwise                → fused, guarded: first-compile runs under
+   the watchdog (``resilience.watchdog``), infra failures (Mosaic /
+   XLA runtime errors, injected faults, watchdog trips, optional
+   non-finite-output guard) record into the breaker + known-bad cache
+   and the call retries on the XLA path. User errors (bad shapes,
+   unsupported compositions: ``ValueError`` / ``AssertionError`` /
+   ``NotImplementedError`` / ``TypeError``) propagate unchanged.
+
+Everything here is Python-side and works at trace time too — under
+``jax.jit`` the routing decision is baked into the traced program
+(like the ``comms.*`` counters, it is per program build; a breaker
+that opens later does not rewrite already-compiled programs).
+
+Metric surface (docs/observability.md): ``resilience.fallbacks_total``,
+``resilience.<op>.fallbacks_total`` / ``.fallback.<reason>`` /
+``.fused_total``, ``resilience.watchdog.trips`` /
+``resilience.<op>.watchdog_trips``, breaker + known-bad gauges.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import inspect
+import json
+import os
+import threading
+
+from triton_dist_tpu import obs
+from triton_dist_tpu.resilience import knownbad
+from triton_dist_tpu.resilience.breaker import get_breaker
+from triton_dist_tpu.resilience.watchdog import (CompileTimeout,
+                                                 compile_timeout_s,
+                                                 run_with_timeout)
+
+__all__ = ["FallbackSpec", "NonFiniteOutput", "decide", "device_kind",
+           "force_fused", "policy_reason", "registered_fallbacks",
+           "resilient", "reset_router"]
+
+
+class NonFiniteOutput(RuntimeError):
+    """The numeric guard (``TDT_NUMERIC_GUARD=1``) found NaN/inf in a
+    fused op's eager output. Infra-class: the call is retried on the
+    XLA reference path and the breaker records the failure."""
+
+    def __init__(self, op: str):
+        self.op = op
+        super().__init__(
+            f"fused op {op!r} produced non-finite outputs")
+
+
+# ---------------------------------------------------------------------------
+# Registry: which entries have an escape hatch (tools/fallback_lint.py
+# cross-checks this against the public surface of ops/).
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FallbackSpec:
+    op: str
+    entry: str                      # "module.qualname" of the entry fn
+    fused_impls: tuple[str, ...]
+    fallback_impl: str
+
+
+_REGISTRY: dict[str, FallbackSpec] = {}
+
+
+def registered_fallbacks() -> dict[str, FallbackSpec]:
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Environment / platform probes (read per call so tests can monkeypatch).
+# ---------------------------------------------------------------------------
+
+def force_fused() -> bool:
+    """``TDT_FORCE_FUSED=1``: bypass all routing, always run fused
+    (bench.py and tpu_smoke.py set this — a measurement or smoke run
+    that silently measured XLA would be worse than one that fails)."""
+    return os.environ.get("TDT_FORCE_FUSED", "").strip() in (
+        "1", "true", "yes")
+
+
+def _numeric_guard_enabled() -> bool:
+    return os.environ.get("TDT_NUMERIC_GUARD", "").strip() in (
+        "1", "true", "yes")
+
+
+_DEVICE_KIND: str | None = None
+
+
+def device_kind() -> str:
+    """``device_kind`` of device 0 (the known-bad cache's third key
+    field — a config that hangs v5e Mosaic may be fine on v5p)."""
+    global _DEVICE_KIND
+    if _DEVICE_KIND is None:
+        try:
+            import jax
+            d = jax.devices()[0]
+            _DEVICE_KIND = str(getattr(d, "device_kind", d.platform))
+        except Exception:  # noqa: BLE001 — no backend yet
+            return "unknown"
+    return _DEVICE_KIND
+
+
+def _platform_tier() -> str:
+    try:
+        import jax
+        return "tpu" if jax.default_backend() == "tpu" else "cpu"
+    except Exception:  # noqa: BLE001
+        return "cpu"
+
+
+# ---------------------------------------------------------------------------
+# BASELINE-driven policy.
+# ---------------------------------------------------------------------------
+
+_BASELINE_CACHE: dict[str, dict] = {}
+
+
+def _baseline_path() -> str:
+    env = os.environ.get("TDT_BASELINE_PATH")
+    if env:
+        return env
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(os.path.dirname(here)),
+                        "BASELINE.json")
+
+
+def _baseline_ratios(tier: str) -> dict:
+    path = _baseline_path()
+    key = f"{path}|{tier}"
+    cached = _BASELINE_CACHE.get(key)
+    if cached is None:
+        ratios = {}
+        try:
+            with open(path) as f:
+                floors = json.load(f).get("regression_floors", {})
+            tbl = floors.get(tier, {})
+            ratios = {k: float(v) for k, v in tbl.items()
+                      if not k.startswith("_")
+                      and isinstance(v, (int, float))}
+        except (OSError, ValueError):
+            pass
+        cached = _BASELINE_CACHE[key] = ratios
+    return cached
+
+
+def _routing_tier() -> str | None:
+    """Which BASELINE tier drives policy routing, or None for off.
+
+    Default: the ``tpu`` table on TPU backends only. The ``cpu`` table
+    explicitly prices the interpret-mode simulator, not the kernels
+    (BASELINE.json ``_comment``), and the CPU mesh is the test tier —
+    auto-routing there would silently turn every fused-path test into
+    an XLA test. ``TDT_BASELINE_ROUTING`` overrides: ``off``/``0``
+    disables everywhere, ``tpu``/``cpu`` forces that table (the test
+    hook for exercising the policy on the CPU mesh)."""
+    env = os.environ.get("TDT_BASELINE_ROUTING", "").strip().lower()
+    if env in ("off", "0", "none"):
+        return None
+    if env in ("tpu", "cpu"):
+        return env
+    tier = _platform_tier()
+    return "tpu" if tier == "tpu" else None
+
+
+def policy_reason(op: str) -> str | None:
+    """Non-None iff BASELINE data says this op's fused kernel is
+    clearly slower than XLA in the active tier.
+
+    The ``regression_floors`` table is a CI gate that deliberately
+    sits just UNDER the measured ratios (BASELINE.json ``_comment``),
+    so a floor slightly below 1.0 can belong to an op that actually
+    measures faster than XLA (r5 gemm_ar: floor 0.95, measured
+    1.065×). The default threshold therefore leaves a parity margin:
+    route to XLA only when the floor is below ``TDT_POLICY_THRESHOLD``
+    (default 0.9 — clearly-slower regimes like ag_gemm 0.7 and
+    gemm_rs 0.78), and treat [threshold, ∞) as parity-or-better."""
+    tier = _routing_tier()
+    if tier is None:
+        return None
+    ratio = _baseline_ratios(tier).get(f"{op}_vs_xla")
+    if ratio is None:
+        return None
+    thr = float(os.environ.get("TDT_POLICY_THRESHOLD", "0.9"))
+    if ratio < thr:
+        return f"{op}_vs_xla={ratio} < {thr} ({tier})"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# The routing decision.
+# ---------------------------------------------------------------------------
+
+def decide(op: str, key: str) -> str | None:
+    """None → run fused; otherwise the fallback reason string."""
+    if force_fused():
+        return None
+    if key in knownbad.get_cache():
+        return "known_bad"
+    if policy_reason(op) is not None:
+        return "policy"
+    if not get_breaker(op).allow():
+        return "breaker"
+    return None
+
+
+def _count_fallback(op: str, reason: str) -> None:
+    obs.counter("resilience.fallbacks_total").inc()
+    obs.counter(f"resilience.{op}.fallbacks_total").inc()
+    obs.counter(f"resilience.{op}.fallback.{reason}").inc()
+
+
+def _record_failure(op: str, key: str, config: str, exc) -> None:
+    get_breaker(op).record_failure()
+    if isinstance(exc, CompileTimeout):
+        obs.counter("resilience.watchdog.trips").inc()
+        obs.counter(f"resilience.{op}.watchdog_trips").inc()
+        knownbad.get_cache().record(op, config, device_kind(),
+                                    reason=f"compile_timeout: {exc}")
+    elif _is_compile_error(exc):
+        # Deterministic compiler breaks (Mosaic rejection, Pallas
+        # lowering failure) re-break on every process restart — record
+        # them like hangs so no process re-enters the compile, instead
+        # of each one burning breaker-threshold attempts rediscovering
+        # it (runtime errors stay out: they may be transient).
+        knownbad.get_cache().record(
+            op, config, device_kind(),
+            reason=f"compile_error: {type(exc).__name__}: "
+                   f"{str(exc)[:200]}")
+
+
+#: Exception type names treated as infra failures when raised from a
+#: fused path. Matched by name: the concrete classes live in jaxlib /
+#: Mosaic modules whose import paths move between jax versions.
+_INFRA_EXC_NAMES = frozenset({
+    "XlaRuntimeError", "JaxRuntimeError", "InternalError",
+    "MosaicError", "LoweringError", "LoweringException",
+    "VerificationError",
+})
+
+#: The deterministic-compiler-break subset of the infra classes: these
+#: reproduce on every compile of the config, so they join watchdog
+#: trips in the known-bad cache.
+_COMPILE_EXC_NAMES = frozenset({
+    "MosaicError", "LoweringError", "LoweringException",
+    "VerificationError",
+})
+
+
+def _is_compile_error(e: BaseException) -> bool:
+    t = type(e)
+    return (t.__name__ in _COMPILE_EXC_NAMES
+            or "mosaic" in (t.__module__ or "").lower())
+
+
+def _is_infra_error(e: BaseException) -> bool:
+    from triton_dist_tpu.testing.faults import InjectedFault
+    if isinstance(e, (CompileTimeout, InjectedFault, NonFiniteOutput)):
+        return True
+    t = type(e)
+    if t.__name__ in _INFRA_EXC_NAMES:
+        return True
+    mod = (t.__module__ or "").lower()
+    return "mosaic" in mod
+
+
+# ---------------------------------------------------------------------------
+# The @resilient decorator.
+# ---------------------------------------------------------------------------
+
+_TLS = threading.local()
+
+#: (op, config, device_kind) keys that have completed a fused run in
+#: this process — later calls skip the watchdog thread (a key that
+#: compiled once cannot hang on compile again).
+_COMPILED: set[str] = set()
+
+
+def _in_resilient() -> bool:
+    return getattr(_TLS, "depth", 0) > 0
+
+
+class _Reentrant:
+    """Nested op entries (ag_gemm → ag_gemm_multi, paged → gathered
+    decode, autotune sweeps) run under the outer guard only."""
+
+    def __enter__(self):
+        _TLS.depth = getattr(_TLS, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.depth -= 1
+        return False
+
+
+#: Context fields worth distinguishing in a config key: the knobs that
+#: select a kernel variant / tile schedule (the things a compile hang
+#: depends on).
+_CTX_KEY_FIELDS = ("variant", "paged_variant", "method", "block_m",
+                   "block_n", "block_k", "t_blk", "ring_dirs",
+                   "vmem_budget")
+
+
+def _default_config(bound: inspect.BoundArguments,
+                    env_keys: tuple[str, ...] = ()) -> str:
+    parts = []
+    for name, v in bound.arguments.items():
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            parts.append(f"{name}={tuple(v.shape)}:{v.dtype}")
+        elif (isinstance(v, (list, tuple)) and v
+              and all(hasattr(e, "shape") and hasattr(e, "dtype")
+                      for e in v)):
+            # ag_gemm_multi-style operand lists.
+            parts.append(name + "=[" + ";".join(
+                f"{tuple(e.shape)}:{e.dtype}" for e in v) + "]")
+        elif dataclasses.is_dataclass(v) and not isinstance(v, type):
+            for fld in _CTX_KEY_FIELDS:
+                if hasattr(v, fld):
+                    fv = getattr(v, fld)
+                    if isinstance(fv, (int, str, bool, type(None))):
+                        parts.append(f"{fld}={fv}")
+        elif isinstance(v, (int, str, bool)) or v is None:
+            parts.append(f"{name}={v}")
+    for k in env_keys:
+        # Variant-selecting env overrides (TDT_PAGED_VARIANT,
+        # TDT_RING_DIRS): when ctx is None the entry builds a default
+        # context AFTER this key is computed, so the env override is
+        # the only visible variant selector — without it a hang in one
+        # variant would share a key with (and wrongly route) the other.
+        ev = os.environ.get(k)
+        if ev:
+            parts.append(f"{k}={ev}")
+    return ",".join(parts)
+
+
+def _has_tracer(bound: inspect.BoundArguments) -> bool:
+    import jax
+    for v in bound.arguments.values():
+        for leaf in jax.tree_util.tree_leaves(v):
+            if isinstance(leaf, jax.core.Tracer):
+                return True
+    return False
+
+
+def _all_finite(out) -> bool:
+    from triton_dist_tpu.runtime.utils import tree_all_finite
+    return tree_all_finite(out)
+
+
+def _nan_fill(out):
+    import jax
+    import jax.numpy as jnp
+
+    def fill(leaf):
+        if isinstance(leaf, jax.Array) and jnp.issubdtype(
+                leaf.dtype, jnp.floating):
+            return jnp.full_like(leaf, jnp.nan)
+        return leaf
+
+    return jax.tree_util.tree_map(fill, out)
+
+
+def resilient(op: str, *, fused_impls: tuple[str, ...] = ("pallas",),
+              fallback_impl: str = "xla", config_fn=None,
+              env_keys: tuple[str, ...] = ()):
+    """Wrap an op entry with watchdog + breaker + fallback routing.
+
+    The entry must take an ``impl`` parameter whose ``fallback_impl``
+    value selects the jax.lax/XLA reference path. Calls whose ``impl``
+    is not in ``fused_impls`` (already on the reference path, or on a
+    collective-composition impl like sp_attention's ``ring``) pass
+    through untouched. ``config_fn(bound_arguments) -> str`` overrides
+    the default shape/dtype/ctx-field config key; ``env_keys`` folds
+    the named env vars into the default key (variant selectors that
+    bypass the ctx object)."""
+
+    def deco(fn):
+        sig = inspect.signature(fn)
+        _REGISTRY[op] = FallbackSpec(
+            op=op, entry=f"{fn.__module__}.{fn.__qualname__}",
+            fused_impls=tuple(fused_impls), fallback_impl=fallback_impl)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if _in_resilient():
+                return fn(*args, **kwargs)
+            try:
+                bound = sig.bind(*args, **kwargs)
+                bound.apply_defaults()
+            except TypeError:
+                # Let the entry raise its own signature error.
+                return fn(*args, **kwargs)
+            if bound.arguments.get("impl") not in fused_impls:
+                return fn(*args, **kwargs)
+            config = (config_fn(bound) if config_fn
+                      else _default_config(bound, env_keys))
+            key = knownbad.make_key(op, config, device_kind())
+
+            def call(impl):
+                # Fresh binding per invocation: an abandoned watchdog
+                # worker still running the fused call must not share
+                # mutable argument state with the main thread's
+                # fallback re-invocation (a shared impl slot could
+                # race the fallback back onto the fused path).
+                b = sig.bind(*args, **kwargs)
+                b.apply_defaults()
+                b.arguments["impl"] = impl
+                with _Reentrant():
+                    return fn(*b.args, **b.kwargs)
+
+            reason = decide(op, key)
+            if reason is not None:
+                _count_fallback(op, reason)
+                return call(fallback_impl)
+            return _guarded(op, key, config, call,
+                            bound, fallback_impl)
+
+        wrapper.__tdt_resilient_op__ = op
+        return wrapper
+
+    return deco
+
+
+def _guarded(op, key, config, call, bound, fallback_impl):
+    """Run the fused path with watchdog + fault hooks; on an infra
+    failure, record it and retry on the reference path."""
+    from triton_dist_tpu.testing import faults
+
+    fused_impl = bound.arguments["impl"]
+    obs.counter(f"resilience.{op}.fused_total").inc()
+    tracing = _has_tracer(bound)
+    timeout = compile_timeout_s()
+    try:
+        f = faults.take("comm_error", op) if faults.active() else None
+        if f is not None:
+            raise faults.InjectedFault(f"{f.message} (op {op})")
+        f = (faults.take("compile_timeout", op)
+             if faults.active() else None)
+        if f is not None:
+            raise CompileTimeout(op, key, 0.0)
+        if not tracing and timeout > 0 and key not in _COMPILED:
+
+            def thunk():
+                # Runs in the watchdog worker thread; call() re-enters
+                # the reentrancy guard on that thread's own stack.
+                hang = (faults.take("compile_hang", op)
+                        if faults.active() else None)
+                if hang is not None:
+                    import time
+                    time.sleep(hang.hang_s)
+                return call(fused_impl)
+
+            out = run_with_timeout(thunk, timeout, op=op, key=key)
+        else:
+            out = call(fused_impl)
+        if not tracing:
+            f = (faults.take("nan_payload", op)
+                 if faults.active() else None)
+            if f is not None:
+                out = _nan_fill(out)
+            if _numeric_guard_enabled() and not _all_finite(out):
+                raise NonFiniteOutput(op)
+    except Exception as e:  # noqa: BLE001 — classified below
+        if not _is_infra_error(e):
+            raise
+        _record_failure(op, key, config, e)
+        if force_fused():
+            # Bench/smoke set TDT_FORCE_FUSED precisely so a run can
+            # never silently measure the XLA fallback while claiming
+            # to measure the fused kernel — the failure is recorded
+            # (breaker, known-bad, counters) and then SURFACES.
+            raise
+        reason = ("watchdog" if isinstance(e, CompileTimeout)
+                  else "nonfinite" if isinstance(e, NonFiniteOutput)
+                  else "error")
+        _count_fallback(op, reason)
+        return call(fallback_impl)
+    if not tracing:
+        # Only a real execution proves anything: a successful TRACE
+        # must neither mark the key compiled (the genuine first Mosaic
+        # compile — the hang class — comes later and must stay under
+        # the watchdog) nor close a half-open breaker.
+        _COMPILED.add(key)
+        get_breaker(op).record_success()
+    return out
+
+
+def reset_router() -> None:
+    """Drop router process state (tests): compiled-key set, baseline
+    cache, breakers, known-bad singleton. The fallback registry is
+    code-derived and survives."""
+    from triton_dist_tpu.resilience.breaker import reset_breakers
+    _COMPILED.clear()
+    _BASELINE_CACHE.clear()
+    reset_breakers()
+    knownbad.reset_cache()

@@ -64,6 +64,73 @@ class DistContext:
         return self.mesh.shape[axis]
 
 
+def _already_initialized_error(e: RuntimeError) -> bool:
+    """Is this ``jax.distributed.initialize`` failure the idempotent
+    re-entry case (service already running) rather than a connect
+    failure worth retrying?"""
+    msg = str(e).lower()
+    return ("already" in msg or "once" in msg
+            or "duplicate" in msg)
+
+
+def _initialize_with_retry(coord: str, nproc: int, pid: int,
+                           retries: int | None = None,
+                           backoff_s: float | None = None,
+                           sleep=None) -> None:
+    """``jax.distributed.initialize`` under bounded exponential backoff.
+
+    The common multi-host race (found in r5): worker processes start
+    before the coordinator's gRPC service is listening, and the bare
+    ``initialize`` call fails hard — one slow pod member then kills the
+    whole job at t=0. Retry ``TDT_DIST_INIT_RETRIES`` times (default
+    5) with exponential backoff from ``TDT_DIST_INIT_BACKOFF_S``
+    (default 0.5 s, doubling, capped at 30 s per wait), counting each
+    retry into ``resilience.dist_init.retries``. Idempotent re-entry
+    (already initialized) returns quietly at any attempt, preserving
+    the previous barrier-guarded-re-init contract.
+
+    ``sleep`` is injectable for tests; fault kind ``"dist_init"``
+    (triton_dist_tpu.testing.faults) deterministically simulates the
+    coordinator-not-up failure.
+    """
+    import time
+
+    from triton_dist_tpu import obs
+    from triton_dist_tpu.testing import faults
+
+    if retries is None:
+        retries = int(os.environ.get("TDT_DIST_INIT_RETRIES", "5"))
+    if backoff_s is None:
+        backoff_s = float(os.environ.get("TDT_DIST_INIT_BACKOFF_S",
+                                         "0.5"))
+    if sleep is None:
+        sleep = time.sleep
+    for attempt in range(retries + 1):
+        try:
+            f = faults.take("dist_init", None) if faults.active() \
+                else None
+            if f is not None:
+                raise faults.InjectedFault(
+                    f"{f.message} (coordinator {coord} not up)")
+            # Passed explicitly: bare ``initialize()`` only auto-detects
+            # under recognized cluster launchers (Slurm/MPI/K8s), NOT
+            # from these env vars — found by tests/test_multihost.py
+            # (the r4 path raised "Number of processes must be
+            # defined" on any pod launched this way).
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=nproc,
+                process_id=pid)
+            return
+        except RuntimeError as e:
+            if _already_initialized_error(e):
+                return
+            if attempt >= retries:
+                raise
+            obs.counter("resilience.dist_init.retries").inc()
+            sleep(min(backoff_s * (2 ** attempt), 30.0))
+
+
 def _maybe_multihost_init() -> None:
     """Call ``jax.distributed.initialize`` iff a coordinator is configured.
 
@@ -84,20 +151,7 @@ def _maybe_multihost_init() -> None:
                 "JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES and "
                 "JAX_PROCESS_ID set to valid values; got "
                 f"num_processes={nproc!r}, process_id={pid!r}") from None
-        try:
-            # Passed explicitly: bare ``initialize()`` only auto-detects
-            # under recognized cluster launchers (Slurm/MPI/K8s), NOT
-            # from these env vars — found by tests/test_multihost.py
-            # (the r4 path raised "Number of processes must be
-            # defined" on any pod launched this way).
-            jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=nproc_i,
-                process_id=pid_i)
-        except RuntimeError:
-            # Already initialized (idempotent re-entry, like the reference's
-            # barrier-guarded re-init).
-            pass
+        _initialize_with_retry(coord, nproc_i, pid_i)
 
 
 def initialize_distributed(

@@ -23,6 +23,21 @@ def init_seed(seed: int = 42) -> jax.Array:
     return jax.random.PRNGKey(seed)
 
 
+def tree_all_finite(tree) -> bool:
+    """Every floating jax.Array leaf of ``tree`` is NaN/inf-free.
+
+    The one shared finiteness walk (resilience numeric guard,
+    tpu_smoke result scoring): blocks on the leaves, casts to f32 so
+    bf16/f16 reduce without surprises."""
+    import jax.numpy as jnp
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array) and jnp.issubdtype(
+                leaf.dtype, jnp.floating):
+            if not bool(jnp.isfinite(leaf.astype(jnp.float32)).all()):
+                return False
+    return True
+
+
 def _block(tree) -> None:
     for leaf in jax.tree_util.tree_leaves(tree):
         if isinstance(leaf, jax.Array):

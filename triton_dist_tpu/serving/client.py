@@ -16,11 +16,16 @@ class ChatClient:
         self._sock = socket.create_connection(self.addr)
         self._file = self._sock.makefile("rwb")
 
-    def generate_ids(self, prompt_ids, gen_len: int = 16) -> dict:
-        req = {"prompt_ids": prompt_ids, "gen_len": gen_len}
+    def request(self, req: dict) -> dict:
+        """One protocol round trip with an arbitrary request object
+        (generation or control-plane, e.g. ``{"cmd": "metrics"}``)."""
         self._file.write((json.dumps(req) + "\n").encode())
         self._file.flush()
         return json.loads(self._file.readline())
+
+    def generate_ids(self, prompt_ids, gen_len: int = 16) -> dict:
+        return self.request({"prompt_ids": prompt_ids,
+                             "gen_len": gen_len})
 
     def chat(self, text: str, gen_len: int = 64) -> str:
         assert self.tokenizer is not None, "text chat needs a tokenizer"

@@ -45,14 +45,38 @@ class _Handler(socketserver.StreamRequestHandler):
             line = line.strip()
             if not line:
                 continue
+            # A per-request failure — malformed JSON, bad arguments, or
+            # the engine/fused-kernel path blowing up — answers THIS
+            # request with a structured error and keeps both the
+            # connection and the serve loop alive: a bad request must
+            # degrade a request, never the process (docs/resilience.md).
             try:
                 req = json.loads(line)
-                resp = self.server.model_server._serve_request(req)
-            except Exception as e:  # report, keep serving
+            except Exception as e:
                 obs.counter("server.errors").inc()
-                resp = {"error": repr(e)}
-            self.wfile.write((json.dumps(resp) + "\n").encode())
-            self.wfile.flush()
+                resp = {"error": f"malformed request: {e}",
+                        "type": type(e).__name__}
+            else:
+                try:
+                    resp = self.server.model_server._serve_request(req)
+                except Exception as e:  # report, keep serving
+                    obs.counter("server.errors").inc()
+                    resp = {"error": str(e) or repr(e),
+                            "type": type(e).__name__}
+            try:
+                wire = json.dumps(resp)
+            except (TypeError, ValueError) as e:
+                obs.counter("server.errors").inc()
+                wire = json.dumps({"error": f"unserializable response: "
+                                            f"{e}",
+                                   "type": type(e).__name__})
+            try:
+                self.wfile.write((wire + "\n").encode())
+                self.wfile.flush()
+            except OSError:
+                # Client hung up mid-response: connection-scoped —
+                # the ThreadingTCPServer keeps serving other clients.
+                break
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):

@@ -1,0 +1,118 @@
+"""Deterministic fault injection for the resilience subsystem.
+
+The reference exercises failure handling only by what real hardware
+happens to do to it (SURVEY.md §4: no fault harness); round 5 showed
+what that costs — one Mosaic compile hang wedged the whole smoke queue.
+This module is the controlled stand-in for the chip misbehaving: tests
+plant faults here and the resilience layer (``triton_dist_tpu
+.resilience``) and ``runtime.dist`` poll for them at the exact points
+where the real failure classes bite, so every breaker / fallback /
+retry transition is exercised in tier-1 CPU tests with zero wall-clock
+dependence.
+
+Fault kinds and where they fire:
+
+- ``"compile_timeout"`` — the guarded fused-op call raises
+  :class:`~triton_dist_tpu.resilience.CompileTimeout` immediately, as
+  if the compile watchdog had tripped (no wall clock involved;
+  deterministic stand-in for the paged-``direct`` Mosaic hang class).
+- ``"compile_hang"``    — the fused thunk sleeps ``hang_s`` inside the
+  watchdog worker thread, driving the REAL thread-timeout path (pair
+  with a small ``TDT_COMPILE_TIMEOUT_S``).
+- ``"comm_error"``      — the fused op raises :class:`InjectedFault`
+  (the runtime-failure class: a remote DMA / collective blowing up).
+- ``"nan_payload"``     — the fused op's outputs are replaced with NaN
+  before the numeric guard sees them (``TDT_NUMERIC_GUARD=1``).
+- ``"dist_init"``       — ``runtime.dist``'s coordinator bootstrap
+  raises before calling ``jax.distributed.initialize`` (the
+  coordinator-not-yet-up multi-host race found in r5).
+
+Usage::
+
+    from triton_dist_tpu.testing import faults
+    with faults.inject("compile_timeout", op="gemm_rs", times=2):
+        gemm_rs(a, b, ctx)          # trips the watchdog, falls back
+
+Faults are process-local, thread-safe, and consumed atomically
+(``times`` decrements per activation); ``inject`` removes its fault on
+exit, ``clear()`` wipes the plan between tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+
+__all__ = ["Fault", "InjectedFault", "KINDS", "active", "clear",
+           "inject", "take"]
+
+#: The recognized fault kinds (see module docstring for semantics).
+KINDS = ("compile_timeout", "compile_hang", "comm_error", "nan_payload",
+         "dist_init")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by instrumented code for ``comm_error`` / ``dist_init``
+    faults. Classified as an infra error by the resilience router, so
+    it takes the same fallback path a real runtime failure would."""
+
+
+@dataclasses.dataclass
+class Fault:
+    kind: str
+    op: str | None = None       # None matches any op
+    times: int = 1              # remaining activations
+    hang_s: float = 60.0        # compile_hang sleep
+    message: str = "injected fault"
+    fired: int = 0              # activations so far (test assertions)
+
+
+_LOCK = threading.Lock()
+_PLAN: list[Fault] = []
+
+
+def active() -> bool:
+    """Cheap gate for hot paths: any fault currently planted?"""
+    return bool(_PLAN)
+
+
+def take(kind: str, op: str | None) -> Fault | None:
+    """Consume one activation of a matching fault, or None.
+
+    A fault with ``op=None`` matches every op; an op-specific fault
+    only its own. Matching is first-planted-first-served."""
+    if not _PLAN:
+        return None
+    with _LOCK:
+        for f in _PLAN:
+            if (f.kind == kind and f.times > 0
+                    and (f.op is None or f.op == op)):
+                f.times -= 1
+                f.fired += 1
+                return f
+    return None
+
+
+@contextlib.contextmanager
+def inject(kind: str, op: str | None = None, times: int = 1,
+           hang_s: float = 60.0, message: str = "injected fault"):
+    """Plant a fault for the duration of the ``with`` block."""
+    if kind not in KINDS:
+        raise ValueError(f"unknown fault kind {kind!r} (known: {KINDS})")
+    f = Fault(kind=kind, op=op, times=times, hang_s=hang_s,
+              message=message)
+    with _LOCK:
+        _PLAN.append(f)
+    try:
+        yield f
+    finally:
+        with _LOCK:
+            if f in _PLAN:
+                _PLAN.remove(f)
+
+
+def clear() -> None:
+    """Remove every planted fault (test teardown)."""
+    with _LOCK:
+        _PLAN.clear()

@@ -49,15 +49,50 @@ def render_bench(d: dict) -> str:
     return "\n".join(lines)
 
 
+_BREAKER_STATES = {0: "closed", 1: "OPEN", 2: "half-open"}
+
+
+def render_resilience(snap: dict) -> str:
+    """Summarize the ``resilience.*`` metrics (docs/resilience.md):
+    breaker states decoded to words, fallback totals by op and reason,
+    watchdog trips, known-bad cache size. Empty string when the
+    snapshot carries no resilience metrics."""
+    counters = {k: v for k, v in snap.get("counters", {}).items()
+                if k.startswith("resilience.")}
+    gauges = {k: v for k, v in snap.get("gauges", {}).items()
+              if k.startswith("resilience.")}
+    if not counters and not gauges:
+        return ""
+    lines = ["#### resilience", "| metric | value |", "|---|---|"]
+    for k in sorted(gauges):
+        v = gauges[k]
+        if k.endswith(".breaker_state"):
+            v = _BREAKER_STATES.get(int(v), v)
+        else:
+            v = int(v) if float(v) == int(v) else round(float(v), 4)
+        lines.append(f"| {k} | {v} |")
+    for k in sorted(counters):
+        v = counters[k]
+        lines.append(f"| {k} | {int(v) if float(v) == int(v) else v} |")
+    return "\n".join(lines)
+
+
 def render_telemetry(snap: dict) -> str:
     """Render an obs snapshot (bench ``extras.telemetry`` / server
     ``{"cmd": "metrics"}`` payload — docs/observability.md) as
-    markdown: one counters/gauges table, one histogram summary table."""
+    markdown: one counters/gauges table, one histogram summary table,
+    plus a dedicated resilience section when those metrics exist."""
     lines = ["### telemetry"]
+    resil = render_resilience(snap)
+    skip = lambda k: k.startswith("resilience.")  # noqa: E731
     scalars = [("counter", k, v)
-               for k, v in sorted(snap.get("counters", {}).items())]
+               for k, v in sorted(snap.get("counters", {}).items())
+               if not skip(k)]
     scalars += [("gauge", k, v)
-                for k, v in sorted(snap.get("gauges", {}).items())]
+                for k, v in sorted(snap.get("gauges", {}).items())
+                if not skip(k)]
+    if resil:
+        lines += [resil, ""]
     if scalars:
         lines += ["| metric | type | value |", "|---|---|---|"]
         for kind, k, v in scalars:
