@@ -1380,8 +1380,13 @@ def main():
         # benches exercise count their invocations + payload bytes
         # (trace-time under jit — per program build) into the obs
         # registry; the cumulative snapshot lands under
-        # extras.telemetry and tools/report.py renders it.
+        # extras.telemetry and tools/report.py renders it. With
+        # TDT_TRACE=1, enable() also arms the event tracer — the
+        # dispatch timeline (op instants, ring-schedule chunk events)
+        # then dumps as a flight record at the end of the run.
         from triton_dist_tpu import obs
+        from triton_dist_tpu.obs import flight as _flight
+        from triton_dist_tpu.obs import trace as _trace
         obs.enable()
 
         if on_tpu and (not only_env or "ag_gemm" in only_env):
@@ -1431,10 +1436,21 @@ def main():
             except Exception as e:  # noqa: BLE001 — partial over rc!=0
                 extras[name + "_error"] = _err(e)
             tel = obs.snapshot()
+            if _trace.enabled():
+                tel["trace"] = _trace.stats()
             if any(tel.values()):
                 extras["telemetry"] = tel
             _checkpoint_extras(extras, name)
 
+        if _trace.enabled():
+            # The run's timeline as an artifact: the full ring window,
+            # path surfaced next to the numbers it explains.
+            p = _flight.maybe_dump("bench", last_s=1e9)
+            if p:
+                extras["trace_path"] = p
+                tel = extras.get("telemetry")
+                if tel is not None:
+                    tel["trace"] = _trace.stats()
         _finalize_checks(extras)
         result = _select_result(extras)
     except Exception as e:  # noqa: BLE001 — emit partial JSON, never rc!=0

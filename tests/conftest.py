@@ -64,6 +64,23 @@ def _isolate_autotune_cache(monkeypatch):
 
 
 @pytest.fixture(autouse=True)
+def _isolate_trace(monkeypatch, tmp_path):
+    """Tracing state is process-global like the metrics registry:
+    every test starts and ends with the tracer disabled and the flight
+    recorder's dump history cleared, and dumps land in a per-test temp
+    dir (never the developer's /tmp/tdt_trace)."""
+    monkeypatch.delenv("TDT_TRACE", raising=False)
+    monkeypatch.delenv("TDT_FLIGHT_SECONDS", raising=False)
+    monkeypatch.setenv("TDT_TRACE_DIR", str(tmp_path / "traces"))
+    from triton_dist_tpu.obs import flight, trace
+    trace.reset()
+    flight.reset()
+    yield
+    trace.reset()
+    flight.reset()
+
+
+@pytest.fixture(autouse=True)
 def _isolate_resilience(monkeypatch, tmp_path):
     """Point the resilience known-bad cache at a per-test temp file
     (never the developer's ~/.cache) and reset all process-local

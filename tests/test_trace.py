@@ -1,0 +1,461 @@
+"""Event tracing + flight recorder (docs/observability.md "Tracing").
+
+Quick tier, CPU-only: ring-buffer overwrite semantics, trace-ID
+propagation through the server → engine → ops path, the Chrome
+trace-event exporter/validator/merger, overlap reconstruction from
+ring-schedule chunk events, and the fault-injected watchdog-trip
+auto-dump (the ISSUE 4 acceptance scenarios).
+"""
+
+import json
+import socket
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from triton_dist_tpu import obs
+from triton_dist_tpu.obs import flight, trace
+from triton_dist_tpu.tools import trace_export
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    obs.disable()
+    yield
+    obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# Ring buffer semantics.
+# ---------------------------------------------------------------------------
+
+def test_disabled_tracing_is_noop():
+    assert not trace.enabled()
+    trace.instant("x")
+    trace.begin("y")
+    trace.end("y")
+    with trace.span("z"):
+        pass
+    c = trace.collect()
+    assert c["tracks"] == {} and c["events_total"] == 0
+
+
+def test_ring_overwrites_oldest_and_counts_drops():
+    trace.enable(capacity=4)
+    for i in range(10):
+        trace.instant(f"e{i}")
+    c = trace.collect()
+    assert c["events_total"] == 10
+    assert c["dropped_total"] == 6          # oldest 6 overwritten
+    (events,) = c["tracks"].values()
+    names = [e[3] for e in events]
+    assert names == ["e6", "e7", "e8", "e9"]   # newest window, in order
+    assert trace.stats()["dropped_total"] == 6
+
+
+def test_collect_last_s_window_trims_old_events():
+    t = trace.enable()
+    t.emit("i", "old", ts_us=t.now_us() - 100e6)    # 100 s ago
+    trace.instant("new")
+    all_names = [e[3] for evs in trace.collect()["tracks"].values()
+                 for e in evs]
+    assert set(all_names) == {"old", "new"}
+    recent = [e[3] for evs in trace.collect(last_s=30)["tracks"].values()
+              for e in evs]
+    assert recent == ["new"]
+
+
+def test_dead_thread_rings_are_bounded():
+    """A server handling each connection on a fresh thread must not
+    leak one ring per connection: finished threads' rings are pruned
+    beyond a bounded tail (newest kept — they are flight-record
+    history)."""
+    import threading
+    t = trace.enable()
+    n = t.MAX_DEAD_RINGS + 20
+    for i in range(n):
+        th = threading.Thread(target=trace.instant, args=(f"c{i}",),
+                              name=f"conn-{i}")
+        th.start()
+        th.join()
+    trace.instant("live")
+    with t._lock:
+        rings = list(t._rings.values())
+    assert len(rings) <= t.MAX_DEAD_RINGS + 2   # dead tail + this thread
+    names = {e[3] for evs in trace.collect()["tracks"].values()
+             for e in evs}
+    assert "live" in names and f"c{n - 1}" in names   # newest kept
+    assert f"c{0}" not in names                       # oldest pruned
+
+
+def test_trace_id_binds_to_thread():
+    trace.enable()
+    assert trace.current_trace_id() is None
+    with trace.bind("req-1"):
+        assert trace.current_trace_id() == "req-1"
+        trace.instant("inner")
+        with trace.bind("req-2"):
+            assert trace.current_trace_id() == "req-2"
+        assert trace.current_trace_id() == "req-1"
+    assert trace.current_trace_id() is None
+    (events,) = trace.collect()["tracks"].values()
+    assert events[0][5] == "req-1"          # trace_id slot
+
+
+def test_span_emits_events_with_tracing_only():
+    """The span contract extends PR 1's: with ONLY tracing enabled
+    (metrics registry still the no-op default) spans emit B/E events
+    and the metrics side stays empty."""
+    trace.enable()
+    assert not obs.enabled()
+    with obs.span("engine.step"):
+        pass
+    assert obs.snapshot()["histograms"] == {}
+    (events,) = trace.collect()["tracks"].values()
+    phs = [(e[0], e[3], e[4]) for e in events]
+    assert ("B", "engine.step", "engine") in phs
+    assert ("E", "engine.step", "engine") in phs
+
+
+def test_span_annotate_unavailable_warns_once_and_counts(monkeypatch):
+    from triton_dist_tpu.obs import registry as registry_mod
+    from triton_dist_tpu.tools import profiler
+
+    def boom(label):
+        raise ImportError("no xprof here")
+
+    monkeypatch.setattr(profiler, "annotate", boom)
+    monkeypatch.setattr(registry_mod, "_ANNOTATE_WARNED", False)
+    obs.enable(obs.Registry())
+    with pytest.warns(RuntimeWarning, match="annotate unavailable"):
+        with obs.span("engine.step"):
+            pass
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")            # second failure: no warning
+        with obs.span("engine.step"):
+            pass
+    snap = obs.snapshot()
+    assert snap["counters"]["obs.span.annotate_unavailable"] == 2
+    # the span still recorded its histogram both times
+    assert snap["histograms"]["engine.step_ms"]["count"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Exporter + validator.
+# ---------------------------------------------------------------------------
+
+def _chrome_of_current():
+    return trace_export.to_chrome(trace.collect(), pid=0)
+
+
+def test_export_validate_roundtrip(tmp_path):
+    trace.enable()
+    with trace.bind("rt-1"):
+        with trace.span("serving.request", "serving", args={"n": 1}):
+            trace.instant("comms.ag_gemm", "op", args={"bytes": 64})
+    chrome = _chrome_of_current()
+    errors, warnings = trace_export.validate(chrome)
+    assert errors == [] and warnings == []
+    # args carry the trace id through export
+    by_name = {e["name"]: e for e in chrome["traceEvents"]
+               if e["ph"] != "M"}
+    assert by_name["comms.ag_gemm"]["args"]["trace_id"] == "rt-1"
+    # the CLI validates the written file (the CI wire)
+    p = tmp_path / "dump.trace.json"
+    trace_export.write_trace(chrome, str(p))
+    assert trace_export.main(["--validate", str(p)]) == 0
+
+
+def test_validate_catches_malformed_traces():
+    bad = {"traceEvents": [
+        {"ph": "E", "ts": 1.0, "pid": 0, "tid": 1, "name": "a"},
+        {"ph": "B", "ts": 5.0, "pid": 0, "tid": 1, "name": "b"},
+        {"ph": "i", "ts": 2.0, "pid": 0, "tid": 1, "name": "c"},
+        {"ph": "X", "ts": 1.0, "dur": -4.0, "pid": 0, "tid": 2,
+         "name": "d"},
+        {"ph": "B", "ts": "NaN?", "pid": 0, "tid": 3, "name": "e"},
+    ]}
+    errors, warnings = trace_export.validate(bad)
+    # an E whose B fell outside the recorded window is truncation,
+    # not corruption: warning, like trailing unclosed begins
+    assert any("no open B" in w for w in warnings)
+    assert any("backwards" in e for e in errors)
+    assert any("bad dur" in e for e in errors)
+    assert any("non-numeric ts" in e for e in errors)
+    assert any("unclosed B" in w for w in warnings)
+    assert trace_export.validate({"nope": 1})[0]
+    # mismatched B/E names on one track
+    errors, _ = trace_export.validate({"traceEvents": [
+        {"ph": "B", "ts": 1.0, "pid": 0, "tid": 1, "name": "a"},
+        {"ph": "E", "ts": 2.0, "pid": 0, "tid": 1, "name": "z"},
+    ]})
+    assert any("closes B" in e for e in errors)
+
+
+def test_unclosed_begin_is_warning_not_error():
+    """A flight record of a hang legitimately ends mid-span: the
+    unclosed B IS the postmortem's answer, so --validate must not
+    reject it."""
+    trace.enable()
+    trace.begin("smoke.hung_case", "op")
+    errors, warnings = trace_export.validate(_chrome_of_current())
+    assert errors == []
+    assert any("hung_case" in w for w in warnings)
+
+
+def test_merge_chrome_keeps_hosts_distinct():
+    a = {"traceEvents": [{"ph": "i", "ts": 1.0, "pid": 0, "tid": 1,
+                          "name": "h0", "s": "t"}]}
+    b = {"traceEvents": [{"ph": "i", "ts": 2.0, "pid": 0, "tid": 1,
+                          "name": "h1", "s": "t"}]}
+    merged = trace_export.merge_chrome([a, b])
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert len(pids) == 2                       # collision re-based
+    assert trace_export.validate(merged)[0] == []
+
+
+# ---------------------------------------------------------------------------
+# Overlap reconstruction from ring-schedule chunk events.
+# ---------------------------------------------------------------------------
+
+def test_ring_schedule_events_and_overlap_reconstruction():
+    trace.enable()
+    trace.ring_schedule_events("ag_gemm", world=4, dirs=2,
+                               compute_ms=4.0, comm_ms=2.0)
+    c = trace.collect()
+    assert set(c["tracks"]) == {"comms.ag_gemm.compute",
+                                "comms.ag_gemm.comm"}
+    assert len(c["tracks"]["comms.ag_gemm.compute"]) == 4  # one/chunk
+    assert len(c["tracks"]["comms.ag_gemm.comm"]) == 3     # w-1 hops
+    chunks = {e[6]["chunk"] for e in c["tracks"]["comms.ag_gemm.compute"]}
+    assert chunks == {0, 1, 2, 3}               # rank-rotated, complete
+    ov = trace_export.compute_overlap(_chrome_of_current())
+    assert set(ov) == {"ag_gemm"}
+    r = ov["ag_gemm"]
+    assert r["n_chunks"] == 4
+    assert r["comm_ms"] == pytest.approx(2.0, rel=0.01)
+    # per-chunk compute (1 ms) exceeds per-hop comm (0.67 ms): the
+    # schedule hides everything, and the geometry shows it.
+    assert r["overlap_pct"] == pytest.approx(100.0, abs=0.5)
+    assert r["exposed_comm_ms"] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_overlap_exposed_when_comm_dominates():
+    trace.enable()
+    # comm 8 ms over 3 hops (2.67 ms each) vs 0.5 ms per chunk: most
+    # of each hop sticks out past the tile loop it overlaps.
+    trace.ring_schedule_events("gemm_rs", world=4, dirs=1,
+                               compute_ms=2.0, comm_ms=8.0)
+    r = trace_export.compute_overlap(_chrome_of_current())["gemm_rs"]
+    # hops union to [0, 3.67 ms] of which compute covers [0, 2 ms]:
+    # 1.67 ms exposed, ~55% hidden — the geometry, not the gauge.
+    assert r["exposed_comm_ms"] == pytest.approx(1.667, rel=0.05)
+    assert 40 < r["overlap_pct"] < 70
+
+
+def test_overlap_is_computed_per_host_in_merged_traces():
+    """SPMD hosts run near-simultaneously on wall-anchored clocks: in
+    a merged trace, host B's compute slices must not mask host A's
+    exposed comm — the interval arithmetic runs per (pid, op) and the
+    per-op numbers sum the hosts."""
+    trace.enable()
+    trace.ring_schedule_events("gemm_rs", world=4, dirs=1,
+                               compute_ms=2.0, comm_ms=8.0)
+    host0 = _chrome_of_current()
+    solo = trace_export.compute_overlap(host0)["gemm_rs"]
+    # "host 1": same schedule, same wall-clock — covers nothing of
+    # host 0's comm if keyed per host, everything if pooled.
+    merged = trace_export.merge_chrome([host0, host0])
+    both = trace_export.compute_overlap(merged)["gemm_rs"]
+    assert both["n_hosts"] == 2
+    assert both["exposed_comm_ms"] == pytest.approx(
+        2 * solo["exposed_comm_ms"], rel=1e-6)
+    assert both["overlap_pct"] == pytest.approx(solo["overlap_pct"],
+                                               rel=1e-6)
+
+
+def test_record_overlap_emits_schedule_with_tracing(mesh8):
+    from triton_dist_tpu.ops.common import record_overlap
+    from triton_dist_tpu.tools.perf_model import estimate_ag_gemm_cost
+    trace.enable()
+    cost = estimate_ag_gemm_cost({"variant": "vmem"}, m=64, rows=8,
+                                 k=128, n_loc=32, itemsize=2, world=8,
+                                 ring_dirs=2)
+    record_overlap("ag_gemm", cost, world=8, dirs=2)
+    c = trace.collect()
+    assert "comms.ag_gemm.compute" in c["tracks"]
+    assert len(c["tracks"]["comms.ag_gemm.comm"]) == 7
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder.
+# ---------------------------------------------------------------------------
+
+def test_flight_dump_writes_valid_trace(tmp_path, monkeypatch):
+    monkeypatch.setenv("TDT_TRACE_DIR", str(tmp_path / "fr"))
+    assert flight.dump("nothing") is None       # tracing off → no dump
+    trace.enable()
+    trace.instant("resilience.x.failure", "resilience")
+    path = flight.dump("unit_test")
+    assert path and path.endswith(".trace.json")
+    with open(path) as f:
+        chrome = json.load(f)
+    assert trace_export.validate(chrome)[0] == []
+    assert chrome["metadata"]["reason"] == "unit_test"
+    rec = flight.last_record()
+    assert rec["path"] == path and rec["count"] == 1
+    # the dump surfaced in metrics and in trace.stats()
+    obs.enable(obs.Registry())
+    flight.dump("unit_test")
+    assert obs.snapshot()["counters"]["resilience.flight_dumps"] == 1
+    assert trace.stats()["last_flight_record"] != path   # newer dump
+
+
+def test_maybe_dump_rate_limits_per_reason():
+    trace.enable()
+    p1 = flight.maybe_dump("breaker_x")
+    p2 = flight.maybe_dump("breaker_x")         # within MIN_INTERVAL_S
+    p3 = flight.maybe_dump("watchdog_y")        # different reason
+    assert p1 and p3 and p2 is None
+
+
+def test_watchdog_trip_auto_dumps_flight_record(devices, monkeypatch):
+    """ISSUE 4 acceptance: a fault-injected watchdog trip auto-dumps a
+    flight record whose path appears in the report output next to the
+    ``resilience.*`` counters."""
+    from triton_dist_tpu.ops.p2p import create_p2p_context, pp_shift
+    from triton_dist_tpu.testing import faults
+    from triton_dist_tpu.tools.report import render_telemetry
+    obs.enable(obs.Registry())
+    trace.enable()
+    mesh1 = Mesh(np.array(devices[:1]), ("tp",))
+    xp = jnp.ones((1, 8, 128), jnp.float32)
+    ctx = create_p2p_context(mesh1, "tp")
+    with faults.inject("compile_timeout", op="pp_shift"):
+        out = pp_shift(xp, ctx, impl="pallas")  # trips → falls back
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(xp))
+    c = obs.snapshot()["counters"]
+    assert c["resilience.pp_shift.watchdog_trips"] == 1
+    assert c["resilience.flight_dumps"] == 1
+    rec = flight.last_record()
+    assert rec and "watchdog_pp_shift" in rec["path"]
+    with open(rec["path"]) as f:
+        chrome = json.load(f)
+    assert trace_export.validate(chrome)[0] == []
+    # the trip itself is on the recorded timeline (the fallback
+    # instant fires AFTER the dump — by design, the record is the
+    # window up to and including the failure — so it shows up in the
+    # live tracer, not in this dump)
+    names = {e.get("name") for e in chrome["traceEvents"]}
+    assert "resilience.pp_shift.failure" in names
+    live = {e[3] for evs in trace.collect()["tracks"].values()
+            for e in evs}
+    assert "resilience.pp_shift.fallback" in live
+    # ... and the path renders in the report's Tracing section
+    snap = obs.snapshot()
+    snap["trace"] = trace.stats()
+    text = render_telemetry(snap)
+    assert "#### tracing" in text and rec["path"] in text
+    assert "resilience.flight_dumps" in text
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: server request → engine → ops under one trace ID.
+# ---------------------------------------------------------------------------
+
+def _tiny_engine(mesh8, key):
+    from triton_dist_tpu.models import DenseLLM, Engine, ModelConfig
+    cfg = ModelConfig(hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=8,
+                      num_key_value_heads=8, head_dim=4, vocab_size=64,
+                      max_position_embeddings=32, dtype=jnp.float32)
+    model = DenseLLM(cfg, mesh=mesh8, axis="tp", impl="xla")
+    params = model.init(key)
+    eng = Engine(model, batch=1, max_seq=16, prefill_mode="xla_ar",
+                 decode_mode="gemm_ar")
+    return eng, params
+
+
+def _send(host, port, payload: dict) -> dict:
+    with socket.create_connection((host, port)) as s:
+        f = s.makefile("rwb")
+        f.write((json.dumps(payload) + "\n").encode())
+        f.flush()
+        return json.loads(f.readline())
+
+
+def test_server_request_traced_end_to_end(mesh8, key):
+    """ISSUE 4 acceptance: serve one request with tracing on, dump via
+    {"cmd": "dump_trace"}, and the exported Perfetto JSON validates
+    and holds the request's serving → engine → op spans under ONE
+    trace ID."""
+    from triton_dist_tpu.serving import ModelServer
+    eng, params = _tiny_engine(mesh8, key)
+    srv = ModelServer(eng, params, port=0).start()   # tracing default-on
+    try:
+        assert trace.enabled()
+        gen = _send(srv.host, srv.port,
+                    {"prompt_ids": [[1, 2, 3]], "gen_len": 3})
+        assert "tokens" in gen
+        tid = gen["trace_id"]
+        assert tid
+        # a client-chosen trace id is honored and echoed
+        gen2 = _send(srv.host, srv.port,
+                     {"prompt_ids": [[1, 2]], "gen_len": 2,
+                      "trace_id": "client-chosen"})
+        assert gen2["trace_id"] == "client-chosen"
+        # window widened past the first-compile time so the request's
+        # back-dated serve/prefill events stay inside it (also
+        # exercises the protocol's "seconds" knob)
+        resp = _send(srv.host, srv.port,
+                     {"cmd": "dump_trace", "seconds": 600})
+        path = resp["dumped"]
+        assert path and resp["trace"]["events_total"] > 0
+        with open(path) as f:
+            chrome = json.load(f)
+        errors, _ = trace_export.validate(chrome)
+        assert errors == []
+        cats = {e.get("cat") for e in chrome["traceEvents"]
+                if e.get("args", {}).get("trace_id") == tid}
+        assert {"serving", "engine", "op"} <= cats, cats
+        names = {e["name"] for e in chrome["traceEvents"]
+                 if e.get("args", {}).get("trace_id") == tid}
+        assert "serving.request" in names
+        assert "engine.prefill" in names and "engine.serve" in names
+        assert any(n.startswith("comms.") for n in names), names
+        # decode spans carry the id too (span B events record args)
+        b_names = {e["name"] for e in chrome["traceEvents"]
+                   if e["ph"] == "B"
+                   and e.get("args", {}).get("trace_id") == tid}
+        assert "engine.decode_step" in b_names
+        # the metrics command surfaces tracing stats for report.py
+        m = _send(srv.host, srv.port, {"cmd": "metrics"})
+        assert m["metrics"]["trace"]["events_total"] > 0
+    finally:
+        srv.stop()
+
+
+def test_server_tracing_opt_out(mesh8, key, monkeypatch):
+    monkeypatch.setenv("TDT_TRACE", "0")
+    from triton_dist_tpu.serving import ModelServer
+    eng, params = _tiny_engine(mesh8, key)
+    srv = ModelServer(eng, params, port=0).start()
+    try:
+        assert not trace.enabled()
+        gen = _send(srv.host, srv.port,
+                    {"prompt_ids": [[1, 2, 3]], "gen_len": 2})
+        assert "tokens" in gen and "trace_id" not in gen
+        resp = _send(srv.host, srv.port, {"cmd": "dump_trace"})
+        assert "error" in resp
+    finally:
+        srv.stop()
+
+
+def test_obs_enable_honors_tdt_trace_env(monkeypatch):
+    monkeypatch.setenv("TDT_TRACE", "1")
+    assert not trace.enabled()
+    obs.enable()
+    assert trace.enabled()

@@ -78,6 +78,16 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         os.environ.setdefault("TDT_COMPILE_TIMEOUT_S",
                               str(max(case_timeout * 0.8, 1.0)))
 
+    # Every smoke run records the event timeline and leaves a merged,
+    # validated trace artifact next to the log (docs/observability.md
+    # "Tracing") — a smoke hang then comes with its flight record for
+    # free (the router auto-dumps on the watchdog trip).
+    from triton_dist_tpu import obs as _obs
+    from triton_dist_tpu.obs import trace as _trace
+    if not list_only:
+        _obs.enable()
+        _trace.enable()
+
     results: list[tuple[str, str, str]] = []  # (name, status, detail)
 
     from triton_dist_tpu.runtime.utils import tree_all_finite as _finite
@@ -124,8 +134,11 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
             # the r5 failure mode was one hang wedging every case
             # behind it. The worker thread is abandoned, never killed
             # (killing mid-compile is the known tunnel-wedge trigger).
-            out, ok = run_with_timeout(run_case, case_timeout,
-                                       op=f"smoke:{name}")
+            # The span's un-ended begin event is what a flight record
+            # of a hung case shows as "in flight".
+            with _trace.span(f"smoke.{name}", "op"):
+                out, ok = run_with_timeout(run_case, case_timeout,
+                                           op=f"smoke:{name}")
             dt = time.perf_counter() - t0
             results.append((name, "PASS" if ok else "NONFINITE",
                             f"{dt:.1f}s"))
@@ -583,6 +596,32 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     n_fail = sum(1 for _, st, _ in results if st != "PASS")
     width = max(len(n) for n, _, _ in results) if results else 1
     lines = [f"{n:<{width}}  {st:<9} {d}" for n, st, d in results]
+    # The merged trace artifact: every host's events gathered rank-0
+    # style, written next to the log, schema-validated — so each smoke
+    # run ends with a Perfetto-loadable timeline of what it did.
+    # Single-exact-case runs (--subproc children all share one --log)
+    # get the case name in the path so per-case artifacts don't
+    # clobber each other.
+    try:
+        from triton_dist_tpu.tools import trace_export as _texp
+        suffix = ""
+        if only and only.startswith("="):
+            suffix = "." + only[1:].replace("/", "_")
+        trace_path = ((log_path or "tpu_smoke.log") + suffix
+                      + ".trace.json")
+        chrome = _texp.gather_to_chrome(process_name="tpu_smoke")
+        _texp.write_trace(chrome, trace_path)
+        errors, warns = _texp.validate(chrome)
+        lines.append(
+            f"TRACE {trace_path} "
+            f"({len(chrome['traceEvents'])} events, "
+            f"{len(warns)} in-flight) "
+            + ("valid" if not errors
+               else f"INVALID: {'; '.join(errors[:3])}"))
+        if errors:
+            n_fail += 1
+    except Exception as e:  # noqa: BLE001 — the artifact must not fail the run
+        lines.append(f"TRACE export failed: {type(e).__name__}: {e}")
     lines.append(f"TOTAL {len(results)} ops, {n_fail} failing")
     report = "\n".join(lines)
     print(report)

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from triton_dist_tpu import obs
+from triton_dist_tpu.obs import trace as _trace
 from triton_dist_tpu.models.kv_cache import KVCacheManager
 
 
@@ -221,13 +222,17 @@ class Engine:
         b, s = input_ids.shape
         if gen_len <= 0:
             return input_ids
-        # Telemetry (docs/observability.md). ``tel`` gates every clock
-        # read and block_until_ready: with the default no-op registry
-        # the serve path pays a handful of no-op calls per CALL (not
-        # per token) and the decode loop's span is a shared null
-        # context manager.
+        # Telemetry (docs/observability.md). ``timed`` gates every
+        # clock read and block_until_ready: with the default no-op
+        # registry AND tracing off, the serve path pays a handful of
+        # no-op calls per CALL (not per token) and the decode loop's
+        # span is a shared null context manager. With only tracing on
+        # (the flight-recorder posture) the clocks run and the
+        # histogram observes land in the no-op registry.
         tel = obs.enabled()
-        t_serve0 = time.perf_counter() if tel else 0.0
+        tr = _trace.enabled()
+        timed = tel or tr
+        t_serve0 = time.perf_counter() if timed else 0.0
         obs.counter("engine.serve_calls").inc()
         obs.counter("engine.decode_path.mega" if self.use_mega
                     else "engine.decode_path.plain").inc()
@@ -263,7 +268,7 @@ class Engine:
         if self.prefill_mode == "sp":
             # SP serving has no ragged support (forward_sp's contract).
             assert not bool(kv_start.any()), "sp serving is non-ragged"
-        t_pre0 = time.perf_counter() if tel else 0.0
+        t_pre0 = time.perf_counter() if timed else 0.0
         chunk = self.prefill_chunk
         if chunk and self.prefill_mode == "sp" and s > chunk:
             # Cache-aware chunked prefill: activation memory is bounded
@@ -283,7 +288,7 @@ class Engine:
         self.kv.inc_offset(s)
         token = sample_token(logits[:, -1], self.key, self.temperature,
                              self.top_k, self.top_p)
-        if tel:
+        if timed:
             # Block so prefill/TTFT measure completed device work, not
             # async dispatch — the observer cost of enabling telemetry.
             jax.block_until_ready(token)
@@ -292,6 +297,14 @@ class Engine:
                 (now - t_pre0) * 1e3)
             obs.histogram("engine.ttft_ms").observe(
                 (now - t_serve0) * 1e3)
+            if tr:
+                # Back-dated complete event: the prefill region on the
+                # timeline, under the request's bound trace ID.
+                _trace.complete(
+                    "engine.prefill", "engine",
+                    _trace.perf_to_us(t_pre0), (now - t_pre0) * 1e6,
+                    args={"batch": b, "prompt_len": s,
+                          "chunked": bool(chunk and s > (chunk or 0))})
 
         if self._decode_step is None:
             self._decode_step = self._build_decode_step()
@@ -321,7 +334,7 @@ class Engine:
                         token, caches = self._decode_step(
                             params, caches, token, off, sub, kv_start,
                             table)
-                    if tel:
+                    if timed:
                         # Block INSIDE the span so the histogram holds
                         # real per-token device latency, not the ~µs
                         # async enqueue — the per-step observer cost of
@@ -336,7 +349,7 @@ class Engine:
 
         n_total = gen_len - 1
         steps_run = 0
-        t_dec0 = time.perf_counter() if tel else 0.0
+        t_dec0 = time.perf_counter() if timed else 0.0
         if self.profile_dir and n_total > 1:
             from triton_dist_tpu.tools.profiler import group_profile
             # One REAL warm-up step before the window: it populates the
@@ -354,7 +367,7 @@ class Engine:
             run_steps(n_total - 1 - n_prof)
         else:
             run_steps(n_total)
-        if tel:
+        if timed:
             jax.block_until_ready(token)
             dt = time.perf_counter() - t_dec0
             # Real computed tokens only (first token + executed decode
@@ -366,6 +379,15 @@ class Engine:
                 # Decode-loop throughput (excludes prefill + TTFT,
                 # which have their own histograms above).
                 obs.gauge("engine.tokens_per_s").set(b * steps_run / dt)
+            if tr:
+                now = time.perf_counter()
+                _trace.complete(
+                    "engine.serve", "engine",
+                    _trace.perf_to_us(t_serve0),
+                    (now - t_serve0) * 1e6,
+                    args={"batch": b, "prompt_len": s,
+                          "gen_len": gen_len, "steps_run": steps_run,
+                          "mega": self.use_mega})
         return jnp.concatenate(out, axis=1)
 
 
@@ -589,6 +611,9 @@ class Engine:
                             params, caches, ids, jnp.int32(len(prompt)),
                             jnp.int32(r), sub)
                     obs.counter("engine.stream_admissions").inc()
+                    _trace.instant("engine.stream_admission", "engine",
+                                   args={"row": r, "request": rid,
+                                         "prompt_len": len(prompt)})
                     row_req[r] = rid
                     row_budget[r] = gen_len
                     generated[rid] = []
@@ -606,7 +631,7 @@ class Engine:
                 self.key, sub = jax.random.split(self.key)
                 token, caches, offsets = self._stream_step(
                     params, caches, token, offsets, sub, done, cur_table)
-                if obs.enabled():
+                if obs.enabled() or _trace.enabled():
                     # Real step latency, not the async enqueue (same
                     # observer cost as the serve() decode span).
                     jax.block_until_ready(token)

@@ -1,15 +1,25 @@
-"""Telemetry subsystem: metrics registry + spans + exposition.
+"""Telemetry subsystem: metrics, spans, event tracing, exposition.
 
-The missing fourth observability leg next to ``tools/profiler``'s
-traces: process-local counters / gauges / fixed-bucket histograms
-(``obs.registry``), wall-clock spans that land in both a histogram and
-the xprof trace (``obs.span``), per-host snapshot merge mirroring the
-reference's rank-0 ``gather_object`` trace merge, and a Prometheus
-text exposition path served over the ModelServer protocol
-(``obs.exposition``). Disabled by default at zero hot-path cost; flip
-on with ``obs.enable()`` (the ModelServer does this at construction).
+Process-local counters / gauges / fixed-bucket histograms
+(``obs.registry``), wall-clock spans that land in a histogram, the
+xprof trace, AND the structured event timeline (``obs.span``),
+per-host snapshot merge mirroring the reference's rank-0
+``gather_object`` trace merge, and a Prometheus text exposition path
+served over the ModelServer protocol (``obs.exposition``).
 
-See docs/observability.md for the metric name catalog.
+The timeline side (``obs.trace``) records begin/end + instant events
+into per-thread ring buffers, exports Chrome trace-event / Perfetto
+JSON through ``tools/trace_export.py``, and doubles as a flight
+recorder (``obs.flight``): the most recent event window dumps to disk
+on watchdog trips, breaker opens, serve-loop failures, SIGTERM, or an
+explicit ``{"cmd": "dump_trace"}``.
+
+Disabled by default at zero hot-path cost; flip metrics on with
+``obs.enable()`` (the ModelServer does this at construction;
+``TDT_TRACE=1`` makes that enable tracing too).
+
+See docs/observability.md for the metric name catalog and event
+schema.
 """
 
 from triton_dist_tpu.obs.registry import (  # noqa: F401
@@ -36,4 +46,8 @@ from triton_dist_tpu.obs.exposition import (  # noqa: F401
     aggregate_across_hosts,
     merge_snapshots,
     render_prometheus,
+)
+from triton_dist_tpu.obs import flight, trace  # noqa: F401
+from triton_dist_tpu.obs.trace import (  # noqa: F401
+    enabled as trace_enabled,
 )

@@ -20,8 +20,31 @@ import re
 
 from triton_dist_tpu.obs import registry as _registry
 
-__all__ = ["merge_snapshots", "render_prometheus",
+__all__ = ["allgather_json", "merge_snapshots", "render_prometheus",
            "aggregate_across_hosts"]
+
+
+def allgather_json(obj) -> list:
+    """Every host's ``obj`` (any JSON-able value), as a list indexed
+    by process — the ``gather_object`` analog: JSON bytes through a
+    byte-padded ``process_allgather``, decoded per rank. Every rank
+    returns the same list; single-process returns ``[obj]``. Shared by
+    the metrics merge below and the chrome-trace merge
+    (``tools.trace_export.gather_to_chrome``)."""
+    import jax
+    if jax.process_count() == 1:
+        return [obj]
+    import numpy as np
+    from jax.experimental import multihost_utils
+    data = np.frombuffer(json.dumps(obj).encode(), np.uint8)
+    sizes = np.asarray(multihost_utils.process_allgather(
+        np.array([data.size], np.int64))).reshape(-1)
+    padded = np.zeros(int(sizes.max()), np.uint8)
+    padded[:data.size] = data
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    gathered = gathered.reshape(len(sizes), -1)
+    return [json.loads(bytes(gathered[i, :int(sizes[i])]).decode())
+            for i in range(len(sizes))]
 
 
 def merge_snapshots(snaps) -> dict:
@@ -125,18 +148,4 @@ def aggregate_across_hosts(snap: dict | None = None) -> dict:
     """
     if snap is None:
         snap = _registry.snapshot()
-    import jax
-    if jax.process_count() == 1:
-        return merge_snapshots([snap])
-    import numpy as np
-    from jax.experimental import multihost_utils
-    data = np.frombuffer(json.dumps(snap).encode(), np.uint8)
-    sizes = np.asarray(multihost_utils.process_allgather(
-        np.array([data.size], np.int64))).reshape(-1)
-    padded = np.zeros(int(sizes.max()), np.uint8)
-    padded[:data.size] = data
-    gathered = np.asarray(multihost_utils.process_allgather(padded))
-    gathered = gathered.reshape(len(sizes), -1)
-    snaps = [json.loads(bytes(gathered[i, :int(sizes[i])]).decode())
-             for i in range(len(sizes))]
-    return merge_snapshots(snaps)
+    return merge_snapshots(allgather_json(snap))

@@ -30,6 +30,9 @@ from __future__ import annotations
 import bisect
 import threading
 import time
+import warnings
+
+from triton_dist_tpu.obs import trace as _trace
 
 __all__ = [
     "DEFAULT_MS_BUCKETS", "Counter", "Gauge", "Histogram", "Registry",
@@ -278,12 +281,18 @@ def set_registry(registry) -> None:
 def enable(registry: Registry | None = None) -> Registry:
     """Switch telemetry on. Idempotent: an already-active real registry
     is kept (so a second subsystem enabling telemetry does not wipe the
-    first's counts); pass ``registry`` to replace it explicitly."""
+    first's counts); pass ``registry`` to replace it explicitly.
+
+    ``TDT_TRACE=1`` makes this also switch event tracing on
+    (``obs.trace``), so bench/smoke runs that enable metrics get the
+    timeline for free."""
     global _REGISTRY
     if registry is not None:
         _REGISTRY = registry
     elif _REGISTRY is _NULL_REGISTRY:
         _REGISTRY = Registry()
+    if _trace.env_enabled() and not _trace.enabled():
+        _trace.enable()
     return _REGISTRY
 
 
@@ -334,27 +343,73 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+#: Category a span's trace events land under, by name prefix
+#: (docs/observability.md "Tracing"): the part before the first dot.
+_CAT_BY_PREFIX = {"engine": "engine", "server": "serving",
+                  "serving": "serving", "comms": "comms",
+                  "resilience": "resilience"}
+
+_ANNOTATE_WARNED = False
+
+
+def _enter_annotate(name: str):
+    """Entered ``tools.profiler.annotate(name)`` context, or None when
+    the xprof side is unavailable (no jax profiler in this
+    environment). The span docstring promises composition with xprof —
+    an import/construction failure must not be pure silence, so the
+    first one warns and every one counts into
+    ``obs.span.annotate_unavailable``; histograms (and trace events)
+    keep recording either way."""
+    global _ANNOTATE_WARNED
+    try:
+        from triton_dist_tpu.tools.profiler import annotate
+        cm = annotate(name)
+        cm.__enter__()
+        return cm
+    except Exception as e:  # noqa: BLE001 — degrade, never break the span
+        _REGISTRY.counter("obs.span.annotate_unavailable").inc()
+        if not _ANNOTATE_WARNED:
+            _ANNOTATE_WARNED = True
+            warnings.warn(
+                f"obs.span: xprof annotate unavailable "
+                f"({type(e).__name__}: {e}) — spans record histograms "
+                f"and trace events only", RuntimeWarning, stacklevel=4)
+        return None
+
+
 class _Span:
-    """Times the enclosed region into ``<name>_ms`` and wraps it in
+    """Times the enclosed region into ``<name>_ms``, wraps it in
     ``tools.profiler.annotate(name)`` so the SAME label shows up as a
-    named region in an xprof trace when one is being collected."""
+    named region in an xprof trace when one is being collected, and —
+    when event tracing is on (``obs.trace``) — emits a begin/end pair
+    so the region lands on the Perfetto timeline under the thread's
+    current trace ID. B/E (not one complete event) on purpose: a hang
+    inside the span leaves the un-ended begin in the flight record."""
 
-    __slots__ = ("_hist", "_name", "_t0", "_ann")
+    __slots__ = ("_hist", "_name", "_cat", "_args", "_t0", "_ann",
+                 "_traced")
 
-    def __init__(self, hist, name: str):
+    def __init__(self, hist, name: str, cat: str | None = None,
+                 args: dict | None = None):
         self._hist = hist
         self._name = name
+        self._cat = cat or _CAT_BY_PREFIX.get(
+            name.split(".", 1)[0], "op")
+        self._args = args
         self._ann = None
 
     def __enter__(self):
-        from triton_dist_tpu.tools.profiler import annotate
-        self._ann = annotate(self._name)
-        self._ann.__enter__()
+        self._ann = _enter_annotate(self._name)
+        self._traced = _trace.enabled()
+        if self._traced:
+            _trace.begin(self._name, self._cat, args=self._args)
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         dt_ms = (time.perf_counter() - self._t0) * 1e3
+        if self._traced:
+            _trace.end(self._name, self._cat)
         ann, self._ann = self._ann, None
         try:
             return ann.__exit__(*exc) if ann is not None else False
@@ -362,16 +417,21 @@ class _Span:
             self._hist.observe(dt_ms)
 
 
-def span(name: str, buckets=DEFAULT_MS_BUCKETS):
-    """Context manager timing a region into histogram ``<name>_ms``.
+def span(name: str, buckets=DEFAULT_MS_BUCKETS, cat: str | None = None,
+         args: dict | None = None):
+    """Context manager timing a region into histogram ``<name>_ms``
+    (and onto the event timeline when tracing is enabled; ``cat``
+    overrides the prefix-derived category, ``args`` attach to the
+    begin event).
 
-    Disabled telemetry returns a shared no-op (no clock read, no
-    annotation) — the form the engine decode loop relies on for its
-    zero-overhead-when-disabled contract."""
+    Disabled telemetry AND disabled tracing return a shared no-op (no
+    clock read, no annotation) — the form the engine decode loop
+    relies on for its zero-overhead-when-disabled contract. With only
+    tracing on, the histogram side records into the no-op registry."""
     reg = _REGISTRY
-    if reg is _NULL_REGISTRY:
+    if reg is _NULL_REGISTRY and not _trace.enabled():
         return _NULL_SPAN
-    return _Span(reg.histogram(name + "_ms", buckets), name)
+    return _Span(reg.histogram(name + "_ms", buckets), name, cat, args)
 
 
 def record_comm(op: str, *arrays) -> None:
@@ -383,9 +443,15 @@ def record_comm(op: str, *arrays) -> None:
     reduce_scatter, all_reduce, fast_all_to_all, ag_gemm, gemm_rs,
     gemm_ar). Under ``jax.jit`` these run at trace time, so the counts
     are per program BUILD, not per device launch — see the module
-    docstring. Shapes are static, so tracers report sizes fine."""
+    docstring. Shapes are static, so tracers report sizes fine.
+
+    With event tracing on, the dispatch also lands on the timeline as
+    an instant event (category ``op``) carrying the op name and byte
+    count — the hook that puts every op entry a request touches onto
+    that request's trace-ID track."""
     reg = _REGISTRY
-    if reg is _NULL_REGISTRY:
+    tracing = _trace.enabled()
+    if reg is _NULL_REGISTRY and not tracing:
         return
     nbytes = 0
     for a in arrays:
@@ -398,3 +464,6 @@ def record_comm(op: str, *arrays) -> None:
                 pass
     reg.counter(f"comms.{op}.calls").inc()
     reg.counter(f"comms.{op}.bytes").inc(nbytes)
+    if tracing:
+        _trace.instant(f"comms.{op}", "op",
+                       args={"op": op, "bytes": nbytes})

@@ -696,7 +696,7 @@ def ag_gemm_multi(a: jax.Array, bs,
         from triton_dist_tpu.tools import perf_model as _pm
         record_overlap("ag_gemm", _pm.estimate_ag_gemm_cost(
             cfg, m=m, rows=rows, k=k, n_loc=n_tot_loc, itemsize=item,
-            world=world, ring_dirs=dirs))
+            world=world, ring_dirs=dirs), world=world, dirs=dirs)
 
     if variant == "hbm":
         # Clamp the ctx hint to divisors + the VMEM budget; fall back to
@@ -1202,7 +1202,8 @@ def ag_swiglu(a: jax.Array, w_gate: jax.Array, w_up: jax.Array,
     from triton_dist_tpu.tools import perf_model as _pm
     record_overlap("ag_swiglu", _pm.estimate_ag_swiglu_cost(
         {"block_m": m_blk, "block_n": n_blk}, m=m, rows=rows, k=k,
-        n_loc=n_loc, itemsize=item, world=world, ring_dirs=dirs))
+        n_loc=n_loc, itemsize=item, world=world, ring_dirs=dirs),
+        world=world, dirs=dirs)
 
     kernel = functools.partial(
         _ag_swiglu_hbm_kernel, axis=axis, world=world, rows=rows, k=k,

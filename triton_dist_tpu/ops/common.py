@@ -174,7 +174,8 @@ def cap_config_tiers(budget_cfgs, aggressive_cfgs, n_budget: int = 5,
     return budget_cfgs[:n_budget] + aggressive_cfgs[:n_aggressive]
 
 
-def record_overlap(op: str, cost) -> None:
+def record_overlap(op: str, cost, world: int | None = None,
+                   dirs: int | None = None) -> None:
     """Per-op overlap gauges from a :class:`tools.perf_model
     .FusedGemmCost` breakdown: ``comms.<op>.overlap_pct`` (hidden
     fraction of the ring communication under the chosen tile schedule —
@@ -185,11 +186,23 @@ def record_overlap(op: str, cost) -> None:
     (trace time under jit, like ``record_comm``), not a trace
     decomposition — bench.py's ``comms.<op>.overlap_pct`` extras carry
     the measured counterpart on chip. At world=1 there is no
-    communication to expose, so the gauge reads 100."""
-    if not obs.enabled():
-        return
-    obs.gauge(f"comms.{op}.overlap_pct").set(cost.overlap_pct)
-    obs.gauge(f"comms.{op}.exposed_comm_ms").set(cost.exposed_comm_ms)
+    communication to expose, so the gauge reads 100.
+
+    With event tracing on and ``world``/``dirs`` passed, the ring
+    schedule additionally lands on the timeline as per-chunk
+    begin/end events (``comms.<op>.compute`` / ``comms.<op>.comm``
+    tracks) so ``tools/trace_export.py --overlap`` reconstructs
+    overlap from the trace's interval geometry rather than from this
+    gauge (docs/observability.md "Tracing")."""
+    from triton_dist_tpu.obs import trace as _trace
+    if obs.enabled():
+        obs.gauge(f"comms.{op}.overlap_pct").set(cost.overlap_pct)
+        obs.gauge(f"comms.{op}.exposed_comm_ms").set(
+            cost.exposed_comm_ms)
+    if _trace.enabled() and world is not None and world > 1:
+        _trace.ring_schedule_events(
+            op, world=world, dirs=dirs if dirs is not None else 1,
+            compute_ms=cost.compute_ms, comm_ms=cost.comm_ms)
 
 
 def comm_params(collective_id: int | None = 0,

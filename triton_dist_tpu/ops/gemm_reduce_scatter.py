@@ -702,7 +702,8 @@ def _entry(a, b, ctx, impl, all_gather_epilogue):
         from triton_dist_tpu.tools import perf_model as _pm
         record_overlap(op_name, _pm.estimate_gemm_rs_cost(
             cfg, m=m, rows=rows, k_loc=k_loc, n=n, itemsize=item,
-            world=world, ring_dirs=eff_dirs))
+            world=world, ring_dirs=eff_dirs), world=world,
+            dirs=eff_dirs)
 
     if variant == "hbm":
         # Clamp ctx hints to divisors + the VMEM budget; fall back to the

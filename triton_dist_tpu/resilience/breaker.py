@@ -125,6 +125,12 @@ class CircuitBreaker:
         self._probe_at = None
         self._opened_at = self._clock()
         obs.counter(f"resilience.{self.op}.breaker_opens").inc()
+        obs.trace.instant(f"resilience.{self.op}.breaker_open",
+                          "resilience", args={"op": self.op})
+        # An open breaker means N consecutive infra failures just
+        # happened: leave the timeline of the window that opened it
+        # (rate-limited; no-op when tracing is off).
+        obs.flight.maybe_dump(f"breaker_{self.op}")
         self._emit()
 
 

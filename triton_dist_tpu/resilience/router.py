@@ -234,15 +234,25 @@ def _count_fallback(op: str, reason: str) -> None:
     obs.counter("resilience.fallbacks_total").inc()
     obs.counter(f"resilience.{op}.fallbacks_total").inc()
     obs.counter(f"resilience.{op}.fallback.{reason}").inc()
+    obs.trace.instant(f"resilience.{op}.fallback", "resilience",
+                      args={"op": op, "reason": reason})
 
 
 def _record_failure(op: str, key: str, config: str, exc) -> None:
     get_breaker(op).record_failure()
+    obs.trace.instant(f"resilience.{op}.failure", "resilience",
+                      args={"op": op, "type": type(exc).__name__,
+                            "config": config[:200]})
     if isinstance(exc, CompileTimeout):
         obs.counter("resilience.watchdog.trips").inc()
         obs.counter(f"resilience.{op}.watchdog_trips").inc()
         knownbad.get_cache().record(op, config, device_kind(),
                                     reason=f"compile_timeout: {exc}")
+        # A hang postmortem: dump the trailing event window — what ran
+        # in the seconds before this compile wedged — to disk
+        # (docs/observability.md "Flight recorder"; rate-limited,
+        # never raises, no-op when tracing is off).
+        obs.flight.maybe_dump(f"watchdog_{op}")
     elif _is_compile_error(exc):
         # Deterministic compiler breaks (Mosaic rejection, Pallas
         # lowering failure) re-break on every process restart — record

@@ -131,14 +131,28 @@ def _initialize_with_retry(coord: str, nproc: int, pid: int,
             sleep(min(backoff_s * (2 ** attempt), 30.0))
 
 
+#: Set once this process's ``jax.distributed.initialize`` succeeded —
+#: the in-process idempotence guard. The error-message matching in
+#: ``_already_initialized_error`` cannot cover re-entry on every jax
+#: version: after the first init plus any computation, this jax raises
+#: the generic "must be called before any JAX computations" message,
+#: which looks like (and must not be confused with) a genuine
+#: too-late-init failure from a process that never initialized.
+_MULTIHOST_INITED = False
+
+
 def _maybe_multihost_init() -> None:
     """Call ``jax.distributed.initialize`` iff a coordinator is configured.
 
     Mirrors the reference reading RANK/WORLD_SIZE from torchrun env
     (utils.py:183-186); JAX's equivalent env is set by the TPU pod launcher
     or explicitly via JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
-    JAX_PROCESS_ID.
+    JAX_PROCESS_ID. Re-entry (a second ``initialize_distributed`` to
+    reshape the mesh) is a no-op once this process has initialized.
     """
+    global _MULTIHOST_INITED
+    if _MULTIHOST_INITED:
+        return
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
     nproc = os.environ.get("JAX_NUM_PROCESSES")
     pid = os.environ.get("JAX_PROCESS_ID")
@@ -152,6 +166,7 @@ def _maybe_multihost_init() -> None:
                 "JAX_PROCESS_ID set to valid values; got "
                 f"num_processes={nproc!r}, process_id={pid!r}") from None
         _initialize_with_retry(coord, nproc_i, pid_i)
+        _MULTIHOST_INITED = True
 
 
 def initialize_distributed(

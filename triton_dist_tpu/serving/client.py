@@ -23,9 +23,23 @@ class ChatClient:
         self._file.flush()
         return json.loads(self._file.readline())
 
-    def generate_ids(self, prompt_ids, gen_len: int = 16) -> dict:
-        return self.request({"prompt_ids": prompt_ids,
-                             "gen_len": gen_len})
+    def generate_ids(self, prompt_ids, gen_len: int = 16,
+                     trace_id: str | None = None) -> dict:
+        """Generate; with tracing on server-side the response carries
+        ``trace_id`` (yours if given) for cross-referencing a later
+        flight record (docs/observability.md "Tracing")."""
+        req = {"prompt_ids": prompt_ids, "gen_len": gen_len}
+        if trace_id is not None:
+            req["trace_id"] = trace_id
+        return self.request(req)
+
+    def dump_trace(self, seconds: float | None = None) -> dict:
+        """Ask the server to dump its flight record
+        (``{"cmd": "dump_trace"}``); returns the dump path + stats."""
+        req: dict = {"cmd": "dump_trace"}
+        if seconds is not None:
+            req["seconds"] = seconds
+        return self.request(req)
 
     def chat(self, text: str, gen_len: int = 64) -> str:
         assert self.tokenizer is not None, "text chat needs a tokenizer"

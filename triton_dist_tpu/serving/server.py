@@ -20,6 +20,22 @@ with ``"format": "prometheus"`` adding a ``prometheus`` text-exposition
 field for scrapers. Constructing a ModelServer enables the telemetry
 registry (``telemetry=False`` opts out).
 
+Tracing (docs/observability.md "Tracing"): the server also runs the
+event tracer / flight recorder by default (``TDT_TRACE=0`` opts out).
+Every generation request gets a trace ID — the client's own
+``"trace_id"`` if it sent one, a fresh one otherwise — bound to the
+handling thread for the request's whole life, so its serving span,
+engine prefill/decode spans, op instants, and any resilience
+fallbacks are one filterable story in an exported timeline; the ID
+is echoed back in the response. The flight recorder dumps the last
+``TDT_FLIGHT_SECONDS`` of events on demand —
+
+    → {"cmd": "dump_trace"}
+    ← {"dumped": "/tmp/tdt_trace/flight_cmd_....trace.json", ...}
+
+— and automatically on unhandled per-request failures, watchdog
+trips, breaker opens, and SIGTERM.
+
 Text in/out (tokenizer round trip) is the client's job when a HF
 tokenizer is available; the server moves token ids only, like the
 reference's server.
@@ -37,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from triton_dist_tpu import obs
+from triton_dist_tpu.obs import flight, trace
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -61,6 +78,11 @@ class _Handler(socketserver.StreamRequestHandler):
                     resp = self.server.model_server._serve_request(req)
                 except Exception as e:  # report, keep serving
                     obs.counter("server.errors").inc()
+                    # The request died past parsing — an engine/kernel
+                    # failure, not client garbage: leave a postmortem
+                    # of what the process was doing (rate-limited,
+                    # never raises; no-op when tracing is off).
+                    flight.maybe_dump("serve_error")
                     resp = {"error": str(e) or repr(e),
                             "type": type(e).__name__}
             try:
@@ -95,6 +117,13 @@ class ModelServer:
             # A serving process wants its numbers scrapeable; direct
             # Engine users keep the zero-overhead no-op default.
             obs.enable()
+            # ... and its flight recorder armed: the bounded ring
+            # buffer is the whole cost, and a hang with no recorder is
+            # the round-5 postmortem-less failure class. TDT_TRACE=0
+            # opts out (docs/observability.md "Tracing").
+            if trace.env_enabled(default=True):
+                trace.enable()
+                flight.install_signal_handlers()
         self._lock = threading.Lock()  # one generation at a time
         self._srv = _TCPServer((host, port), _Handler)
         self._srv.model_server = self
@@ -106,10 +135,24 @@ class ModelServer:
             return self._serve_command(req)
         obs.counter("server.requests").inc()
         obs.gauge("server.inflight").inc()
+        # One trace ID per request, bound to the handling thread: the
+        # serving span below plus every engine/op/resilience event the
+        # generation emits (same thread — generation runs under the
+        # lock in this handler) carries it, and the client gets it
+        # back for cross-referencing a later dump.
+        trace_id = str(req.get("trace_id") or trace.new_trace_id())
         try:
-            return self._serve_generate(req)
+            with trace.bind(trace_id), \
+                    trace.span("serving.request", "serving",
+                               args={"gen_len": req.get("gen_len"),
+                                     "batch": len(req.get(
+                                         "prompt_ids", []) or [])}):
+                resp = self._serve_generate(req)
         finally:
             obs.gauge("server.inflight").dec()
+        if trace.enabled():
+            resp.setdefault("trace_id", trace_id)
+        return resp
 
     def _serve_command(self, req: dict) -> dict:
         """Control-plane requests on the same JSON-lines protocol."""
@@ -119,12 +162,24 @@ class ModelServer:
             # registry is internally locked, and a scraper must not
             # queue behind a multi-second generation.
             snap = obs.snapshot()
+            if trace.enabled():
+                # Tracing counts + last flight record ride inside the
+                # snapshot (tools/report.py renders them as the
+                # Tracing section; merge_snapshots ignores the key).
+                snap["trace"] = trace.stats()
             resp = {"metrics": snap}
             if req.get("format") == "prometheus":
                 resp["prometheus"] = obs.render_prometheus(snap)
             return resp
+        if cmd == "dump_trace":
+            if not trace.enabled():
+                obs.counter("server.errors").inc()
+                return {"error": "tracing is disabled (TDT_TRACE)"}
+            path = flight.dump("cmd", last_s=req.get("seconds"))
+            return {"dumped": path, "trace": trace.stats()}
         obs.counter("server.errors").inc()
-        return {"error": f"unknown cmd {cmd!r} (known: metrics)"}
+        return {"error": f"unknown cmd {cmd!r} "
+                         f"(known: metrics, dump_trace)"}
 
     def _serve_generate(self, req: dict) -> dict:
         # Request clock starts BEFORE the generation lock: under load,

@@ -259,6 +259,7 @@ def autotune(make_fn: Callable[..., Callable], configs: Sequence[dict],
             _CACHE[key] = hit
             return hit
 
+    from triton_dist_tpu import obs
     times = []
     errors = []
     for cfg in configs:
@@ -266,13 +267,21 @@ def autotune(make_fn: Callable[..., Callable], configs: Sequence[dict],
         # chip generation) scores inf instead of killing the sweep — the
         # reference's Triton autotuner likewise skips OutOfResources
         # configs. This keeps aggressive candidates safe to list.
-        try:
-            fn = make_fn(**cfg)
-            _, ms = perf_func(fn, iters=iters, warmup_iters=warmup_iters,
-                              return_output=False)
-        except Exception as e:  # noqa: BLE001 — per-config isolation
-            ms = float("inf")
-            errors.append((cfg, repr(e)[:200]))
+        # Each candidate is a span: a sweep that wedges on one Mosaic
+        # compile leaves that candidate's un-ended begin (with its
+        # exact config) in the flight record.
+        with obs.span("autotune.candidate", cat="op",
+                      args={"key": key, **{k: v for k, v in cfg.items()
+                                           if isinstance(v, (int, str,
+                                                             bool))}}):
+            try:
+                fn = make_fn(**cfg)
+                _, ms = perf_func(fn, iters=iters,
+                                  warmup_iters=warmup_iters,
+                                  return_output=False)
+            except Exception as e:  # noqa: BLE001 — per-config isolation
+                ms = float("inf")
+                errors.append((cfg, repr(e)[:200]))
         times.append(ms)
 
     if jax.process_count() > 1:

@@ -77,14 +77,40 @@ def render_resilience(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_tracing(stats: dict | None) -> str:
+    """Summarize the event-tracing / flight-recorder state
+    (``obs.trace.stats()``, carried under the snapshot's ``trace`` key
+    by the server metrics command and bench extras —
+    docs/observability.md "Tracing"): events captured, events dropped
+    to ring overwrites, and the last flight-record path so a
+    postmortem reader knows which file to open in Perfetto. Empty
+    string when the payload carries no tracing stats."""
+    if not stats:
+        return ""
+    lines = ["#### tracing", "| metric | value |", "|---|---|"]
+    for k in ("events_total", "dropped_total", "tracks",
+              "ring_capacity", "flight_dumps"):
+        if k in stats:
+            lines.append(f"| {k} | {stats[k]} |")
+    if stats.get("last_flight_record"):
+        lines.append(
+            f"| last_flight_record | {stats['last_flight_record']} |")
+    return "\n".join(lines)
+
+
 def render_telemetry(snap: dict) -> str:
     """Render an obs snapshot (bench ``extras.telemetry`` / server
     ``{"cmd": "metrics"}`` payload — docs/observability.md) as
     markdown: one counters/gauges table, one histogram summary table,
-    plus a dedicated resilience section when those metrics exist."""
+    plus dedicated resilience and tracing sections when those exist."""
     lines = ["### telemetry"]
     resil = render_resilience(snap)
-    skip = lambda k: k.startswith("resilience.")  # noqa: E731
+    tracing = render_tracing(snap.get("trace"))
+    # trace.* gauges mirror what the tracing section already shows
+    # (they exist for the Prometheus exposition path) — don't render
+    # the same numbers twice when that section is present.
+    skip = lambda k: (k.startswith("resilience.")  # noqa: E731
+                      or (bool(tracing) and k.startswith("trace.")))
     scalars = [("counter", k, v)
                for k, v in sorted(snap.get("counters", {}).items())
                if not skip(k)]
@@ -93,6 +119,8 @@ def render_telemetry(snap: dict) -> str:
                 if not skip(k)]
     if resil:
         lines += [resil, ""]
+    if tracing:
+        lines += [tracing, ""]
     if scalars:
         lines += ["| metric | type | value |", "|---|---|---|"]
         for kind, k, v in scalars:

@@ -1,0 +1,360 @@
+"""Export, merge, and validate Chrome trace-event / Perfetto dumps.
+
+The reference's tracing pipeline writes per-rank chrome traces and
+merges them on rank 0 (``group_profile`` + ``gather_object`` +
+``_merge_json``, python/triton_dist/utils.py:505-592). This module is
+that pipeline for ``obs.trace``'s structured events:
+
+- :func:`to_chrome` — tracer snapshot → Chrome trace-event JSON dict
+  (the ``{"traceEvents": [...]}`` object format Perfetto loads).
+- :func:`gather_to_chrome` — every host contributes its events through
+  a byte-padded ``process_allgather`` (the ``gather_object`` analog;
+  same transport as ``obs.exposition.aggregate_across_hosts``) and the
+  merge runs on every rank; single-process returns the local trace.
+- :func:`validate` — schema check for dumps: balanced B/E pairs per
+  track (unclosed begins are *warnings* — a hang postmortem
+  legitimately ends mid-span), monotonic timestamps per track,
+  well-formed X/instant events.
+- :func:`compute_overlap` — reconstruct per-op comm/compute overlap
+  from the ring-schedule chunk events (``comms.<op>.compute`` /
+  ``comms.<op>.comm`` tracks) by interval arithmetic over the trace,
+  instead of trusting the dispatch-time ``comms.<op>.overlap_pct``
+  gauge.
+
+CLI::
+
+    python -m triton_dist_tpu.tools.trace_export --validate dump.json
+    python -m triton_dist_tpu.tools.trace_export --overlap  dump.json
+    python -m triton_dist_tpu.tools.trace_export a.json b.json --out merged.json
+
+Load any output at https://ui.perfetto.dev (or chrome://tracing); the
+"reading a Perfetto dump" walkthrough lives in docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+__all__ = ["compute_overlap", "gather_to_chrome", "merge_chrome",
+           "to_chrome", "validate", "write_trace"]
+
+
+def to_chrome(collected: dict, pid: int | None = None,
+              process_name: str = "tdt",
+              metadata: dict | None = None) -> dict:
+    """Convert an ``obs.trace.collect()`` snapshot into a Chrome
+    trace-event object. Tracks become tids (named via ``M`` metadata
+    events); event args carry the trace ID under ``args.trace_id`` so
+    Perfetto's query/filter box isolates one request's story."""
+    if pid is None:
+        pid = _host_index()
+    events: list[dict] = [
+        {"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+         "args": {"name": f"{process_name} host{pid}"}},
+    ]
+    for tid, track in enumerate(sorted(collected.get("tracks", {})),
+                                start=1):
+        events.append({"ph": "M", "pid": pid, "tid": tid,
+                       "name": "thread_name", "args": {"name": track}})
+        for ph, ts_us, dur_us, name, cat, trace_id, args in \
+                collected["tracks"][track]:
+            ev: dict = {"ph": ph, "ts": ts_us, "pid": pid, "tid": tid,
+                        "name": name, "cat": cat}
+            if ph == "X":
+                ev["dur"] = 0.0 if dur_us is None else dur_us
+            elif ph == "i":
+                ev["s"] = "t"   # thread-scoped instant
+            if args or trace_id:
+                a = dict(args or {})
+                if trace_id:
+                    a["trace_id"] = trace_id
+                ev["args"] = a
+            events.append(ev)
+    meta = {"events_total": collected.get("events_total", 0),
+            "dropped_total": collected.get("dropped_total", 0),
+            "ring_capacity": collected.get("ring_capacity", 0)}
+    if metadata:
+        meta.update(metadata)
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": meta}
+
+
+def write_trace(chrome: dict, path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(chrome, f)
+    return path
+
+
+def merge_chrome(traces: list[dict]) -> dict:
+    """Merge per-host trace objects into one (the reference's rank-0
+    ``_merge_json``). Colliding pids across sources are re-based so
+    two hosts that both called themselves pid 0 stay distinct rows."""
+    traces = [t for t in traces if t]
+    events: list[dict] = []
+    metadata: dict = {"hosts": len(traces)}
+    used_pids: set = set()
+    for i, t in enumerate(traces):
+        pids = {e.get("pid", 0) for e in t.get("traceEvents", [])}
+        remap = {}
+        for p in sorted(pids, key=str):
+            q = p
+            while q in used_pids:
+                q = (q if isinstance(q, int) else 0) + 1000 * (i + 1)
+            remap[p] = q
+            used_pids.add(q)
+        for e in t.get("traceEvents", []):
+            e = dict(e)
+            e["pid"] = remap.get(e.get("pid", 0), e.get("pid", 0))
+            events.append(e)
+        for k, v in (t.get("metadata") or {}).items():
+            metadata.setdefault(k, v)
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": metadata}
+
+
+def _host_index() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:  # noqa: BLE001 — no backend
+        return 0
+
+
+def gather_to_chrome(last_s: float | None = None,
+                     process_name: str = "tdt") -> dict:
+    """Every host's buffered events, merged into one trace object.
+
+    The transport mirrors ``obs.exposition.aggregate_across_hosts``
+    (JSON bytes through a padded ``process_allgather`` — the
+    ``gather_object`` chrome-trace merge of the reference); every rank
+    returns the same merged trace. Single-process: the local trace."""
+    from triton_dist_tpu.obs import trace as _trace
+    from triton_dist_tpu.obs.exposition import allgather_json
+    local = to_chrome(_trace.collect(last_s=last_s),
+                      process_name=process_name)
+    gathered = allgather_json(local)
+    return local if len(gathered) == 1 else merge_chrome(gathered)
+
+
+# ---------------------------------------------------------------------------
+# Validation.
+# ---------------------------------------------------------------------------
+
+_KNOWN_PH = frozenset("BEXiM")
+
+
+def validate(chrome: dict) -> tuple[list[str], list[str]]:
+    """Check a dump against the trace-event schema this pipeline emits.
+
+    Returns ``(errors, warnings)``. Errors: malformed events, an ``E``
+    whose name differs from the open ``B`` it closes, non-monotonic
+    begin/end/instant timestamps within a track, X events with
+    negative duration. Warnings — the truncation modes a flight
+    record produces BY DESIGN and must not be rejected for: begins
+    left unclosed at the end of the dump (a hang record legitimately
+    ends mid-span; the unclosed span IS the postmortem's answer), an
+    ``E`` with no open begin (its ``B`` fell before the
+    ``TDT_FLIGHT_SECONDS`` window or was ring-overwritten), and
+    unknown phases.
+    """
+    errors: list[str] = []
+    warnings: list[str] = []
+    evs = chrome.get("traceEvents")
+    if not isinstance(evs, list):
+        return (["traceEvents missing or not a list"], [])
+    stacks: dict[tuple, list] = {}
+    last_ts: dict[tuple, float] = {}
+    for i, e in enumerate(evs):
+        ph = e.get("ph")
+        if ph == "M":
+            continue
+        if ph not in _KNOWN_PH:
+            warnings.append(f"event {i}: unknown phase {ph!r}")
+            continue
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)):
+            errors.append(f"event {i}: non-numeric ts {ts!r}")
+            continue
+        key = (e.get("pid", 0), e.get("tid", 0))
+        if ph == "X":
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"event {i}: X with bad dur {dur!r}")
+            continue   # X may be emitted retrospectively (back-dated)
+        prev = last_ts.get(key)
+        if prev is not None and ts < prev:
+            errors.append(
+                f"event {i}: ts went backwards on track {key} "
+                f"({ts} < {prev})")
+        last_ts[key] = ts
+        if ph == "B":
+            stacks.setdefault(key, []).append((e.get("name"), i))
+        elif ph == "E":
+            stack = stacks.get(key)
+            if not stack:
+                warnings.append(
+                    f"event {i}: E {e.get('name')!r} with no open B "
+                    f"on track {key} — begin fell outside the "
+                    f"recorded window")
+                continue
+            b_name, b_i = stack.pop()
+            name = e.get("name")
+            if name is not None and b_name is not None \
+                    and name != b_name:
+                errors.append(
+                    f"event {i}: E {name!r} closes B {b_name!r} "
+                    f"(event {b_i}) on track {key}")
+    for key, stack in stacks.items():
+        for name, i in stack:
+            warnings.append(
+                f"unclosed B {name!r} (event {i}) on track {key} — "
+                f"in-flight when the dump was taken")
+    return errors, warnings
+
+
+# ---------------------------------------------------------------------------
+# Overlap reconstruction from ring-schedule chunk events.
+# ---------------------------------------------------------------------------
+
+_SCHED_TRACK = re.compile(r"^comms\.(?P<op>[\w.]+)\.(?P<kind>comm|compute)$")
+
+
+def _union(intervals: list[tuple[float, float]]) \
+        -> list[tuple[float, float]]:
+    """Merge intervals into a disjoint sorted union (events on one
+    track may overlap each other; double-counting would overstate
+    coverage)."""
+    merged: list[list[float]] = []
+    for a, b in sorted(intervals):
+        if merged and a <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], b)
+        else:
+            merged.append([a, b])
+    return [(a, b) for a, b in merged]
+
+
+def _union_len(intervals: list[tuple[float, float]]) -> float:
+    return sum(b - a for a, b in _union(intervals))
+
+
+def _intersect(comm: list[tuple[float, float]],
+               compute: list[tuple[float, float]]) -> float:
+    """Length of union(comm) ∩ union(compute)."""
+    covered = 0.0
+    compute = _union(compute)
+    for a, b in _union(comm):
+        for c, d in compute:
+            if d <= a:
+                continue
+            if c >= b:
+                break
+            covered += min(b, d) - max(a, c)
+    return covered
+
+
+def compute_overlap(chrome: dict) -> dict:
+    """Reconstruct per-op overlap from the ``comms.<op>.{comm,compute}``
+    chunk tracks: ``exposed_comm_ms`` is comm-interval time not covered
+    by any compute interval of the same (host, op); ``overlap_pct`` is
+    ``100 * (1 - exposed / comm)`` — measured over the trace's
+    geometry, independent of the dispatch-time gauge.
+
+    The interval arithmetic runs per (pid, op) — a merged multi-host
+    trace has each host's schedule on its own pid, and SPMD hosts run
+    near-simultaneously on wall-anchored clocks, so pooling them would
+    let host B's compute slices mask host A's exposed comm. Per-op
+    numbers are the SUM of the per-host terms (worst case surfaces in
+    the total rather than averaging away)."""
+    track_of: dict[tuple, str] = {}
+    for e in chrome.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            track_of[(e.get("pid", 0), e.get("tid", 0))] = \
+                e.get("args", {}).get("name", "")
+    per_host_op: dict[tuple, dict[str, list]] = {}
+    for e in chrome.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        pid = e.get("pid", 0)
+        m = _SCHED_TRACK.match(track_of.get((pid, e.get("tid", 0)), ""))
+        if not m:
+            continue
+        iv = (float(e["ts"]), float(e["ts"]) + float(e.get("dur", 0)))
+        per_host_op.setdefault((pid, m["op"]),
+                               {"comm": [], "compute": []})[
+            m["kind"]].append(iv)
+    agg: dict[str, dict] = {}
+    for (pid, op), kinds in sorted(per_host_op.items(), key=str):
+        comm_us = _union_len(kinds["comm"])
+        covered_us = _intersect(kinds["comm"], kinds["compute"])
+        a = agg.setdefault(op, {"comm_us": 0.0, "exposed_us": 0.0,
+                                "n_chunks": 0, "n_hosts": 0})
+        a["comm_us"] += comm_us
+        a["exposed_us"] += max(comm_us - covered_us, 0.0)
+        a["n_chunks"] += len(kinds["compute"])
+        a["n_hosts"] += 1
+    out = {}
+    for op, a in sorted(agg.items()):
+        comm_us, exposed_us = a["comm_us"], a["exposed_us"]
+        out[op] = {
+            "comm_ms": round(comm_us / 1e3, 6),
+            "exposed_comm_ms": round(exposed_us / 1e3, 6),
+            "overlap_pct": round(100.0 * (1 - exposed_us / comm_us), 2)
+            if comm_us > 0 else 100.0,
+            "n_chunks": a["n_chunks"],
+            "n_hosts": a["n_hosts"],
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI.
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Validate / analyze / merge tdt trace dumps")
+    ap.add_argument("paths", nargs="+", help="trace JSON file(s)")
+    ap.add_argument("--validate", action="store_true",
+                    help="schema-check each dump; rc!=0 on errors "
+                         "(unclosed begins are warnings, not errors)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="reconstruct per-op comm/compute overlap "
+                         "from ring-schedule chunk events")
+    ap.add_argument("--out", default=None,
+                    help="merge the inputs into this file")
+    args = ap.parse_args(argv)
+    traces = []
+    for p in args.paths:
+        with open(p) as f:
+            traces.append(json.load(f))
+    rc = 0
+    if args.validate:
+        for p, t in zip(args.paths, traces):
+            errors, warns = validate(t)
+            for w in warns:
+                print(f"{p}: WARN {w}")
+            for e in errors:
+                print(f"{p}: ERROR {e}")
+            n = len(t.get("traceEvents", []))
+            print(f"{p}: {'INVALID' if errors else 'valid'} "
+                  f"({n} events, {len(errors)} errors, "
+                  f"{len(warns)} warnings)")
+            rc = rc or (1 if errors else 0)
+    if args.overlap:
+        merged = merge_chrome(traces) if len(traces) > 1 else traces[0]
+        print(json.dumps(compute_overlap(merged), indent=2))
+    if args.out:
+        merged = merge_chrome(traces) if len(traces) > 1 else traces[0]
+        write_trace(merged, args.out)
+        print(f"wrote {args.out} "
+              f"({len(merged['traceEvents'])} events)")
+    if not (args.validate or args.overlap or args.out):
+        ap.error("nothing to do: pass --validate, --overlap, or --out")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
