@@ -64,6 +64,24 @@ def main():
     print(f"{len(prompts)} requests through a 2-row window; "
           "all token-exact vs solo serving")
 
+    # CROSS-REQUEST continuous batching (ISSUE 5): the serving
+    # scheduler shares one decode batch across concurrent clients — no
+    # shared prompt list needed up front. submit() from any thread; a
+    # short request admitted mid-flight retires while longer ones are
+    # still decoding (docs/serving.md "Scheduler").
+    from triton_dist_tpu.serving import Scheduler
+    eng2 = Engine(model, batch=2, max_seq=32, prefill_mode="xla_ar",
+                  decode_mode="gemm_ar")
+    sched = Scheduler(eng2, params).start()
+    futures = [sched.submit(p, 6) for p in prompts]
+    for prompt, fut in zip(prompts, futures):
+        want = np.asarray(solo.serve(
+            params, jnp.asarray([prompt], jnp.int32), 6))[0].tolist()
+        assert fut.result(timeout=300) == want[len(prompt):]
+    sched.stop()
+    print(f"{len(prompts)} concurrent submissions through the "
+          "scheduler; token-exact vs solo serving")
+
     # The same stream through the LONG-CONTEXT engine: sequence-parallel
     # model + vLLM-style paged KV pools. Admission allocates the row's
     # pages and prefills straight into them; retirement hands the pages

@@ -57,7 +57,8 @@ def main() -> None:
 
     print("## Bench captures (hw_*.out streamed JSON)\n")
     rows = []
-    for path in sorted(glob.glob(os.path.join(ROOT, "hw_*.out"))):
+    for path in sorted(glob.glob(os.path.join(ROOT, "hw_*.out"))
+                       + glob.glob(os.path.join(ROOT, "artifacts", "hw_*.out"))):
         d = _last_json_line(path)
         if not d:
             continue
@@ -94,7 +95,8 @@ def main() -> None:
     print("\n## Smoke logs (tpu_smoke_r5*.log)\n")
     print("| log | PASS | FAIL | TIMEOUT |")
     print("|---|---|---|---|")
-    for path in sorted(glob.glob(os.path.join(ROOT, "tpu_smoke_r5*.log"))):
+    for path in sorted(glob.glob(os.path.join(ROOT, "tpu_smoke_r5*.log"))
+                       + glob.glob(os.path.join(ROOT, "artifacts", "tpu_smoke_r5*.log"))):
         try:
             with open(path, errors="replace") as f:
                 text = f.read()

@@ -37,11 +37,16 @@ def main() -> None:
         # The raw captures below still get committed.
         print("chip_report failed:", report.stderr[-500:], file=sys.stderr)
 
-    for name in sorted(os.listdir(ROOT)):
-        if (name.startswith(("tpu_smoke_r5", "hw_")) and
-                name.endswith((".log", ".out")) and
-                name not in ("hw_watch.out", "hw_watch.log")):
-            paths.append(os.path.join(ROOT, name))
+    # Run artifacts live under artifacts/ since ISSUE 5 (repo-root
+    # strays are gitignored now); scan both for older runs' leftovers.
+    for base in (ROOT, os.path.join(ROOT, "artifacts")):
+        if not os.path.isdir(base):
+            continue
+        for name in sorted(os.listdir(base)):
+            if (name.startswith(("tpu_smoke_r5", "hw_")) and
+                    name.endswith((".log", ".out")) and
+                    name not in ("hw_watch.out", "hw_watch.log")):
+                paths.append(os.path.join(base, name))
     paths.extend(sorted(glob.glob(
         os.path.join(ROOT, ".bench_progress_watcher*.json"))))
 

@@ -19,7 +19,8 @@ import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG = os.path.join(ROOT, "hw_watch.log")
+ARTIFACTS = os.path.join(ROOT, "artifacts")
+LOG = os.path.join(ARTIFACTS, "hw_watch.log")
 
 # (name, argv, deadline_s, env) — run in order; stop the queue if a
 # step wedges (probe after each step to know).
@@ -57,7 +58,7 @@ QUEUE = [
     ("smoke_resume",
      [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "420",
       "--start-after", "flash_decode/paged",
-      "--log", "tpu_smoke_r5_resume.log"],
+      "--log", "artifacts/tpu_smoke_r5_resume.log"],
      7200.0, {}),
     # Position 4: re-validate cases 1-27 under the round-5 kernel
     # changes (these passed pre-change; the 24 MB budget alters
@@ -65,7 +66,7 @@ QUEUE = [
     ("smoke_revalidate",
      [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "420",
       "--skip", "flash_decode/paged",
-      "--log", "tpu_smoke_r5_reval.log"],
+      "--log", "artifacts/tpu_smoke_r5_reval.log"],
      7200.0, {}),
     # Position 5, LAST because it is the known wedge trigger: the
     # paged-KV compile with a 40-min case budget (r3's train compile
@@ -73,7 +74,7 @@ QUEUE = [
     ("smoke_paged",
      [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "2400",
       "--only", "=flash_decode/paged",
-      "--log", "tpu_smoke_r5_paged.log"],
+      "--log", "artifacts/tpu_smoke_r5_paged.log"],
      2700.0, {}),
 ]
 
@@ -100,6 +101,7 @@ def commit_evidence() -> None:
 def log(msg: str) -> None:
     line = f"{time.strftime('%H:%M:%S')} {msg}"
     print(line, flush=True)
+    os.makedirs(ARTIFACTS, exist_ok=True)
     with open(LOG, "a") as f:
         f.write(line + "\n")
 
@@ -122,7 +124,8 @@ def run_step(name: str, argv: list[str], deadline_s: float,
     env = dict(os.environ, **(env_extra or {}))
     # Keep every step's stdout (the bench's streamed cumulative JSON
     # lines are machine-captured evidence, not noise — review r4a-2).
-    out = open(os.path.join(ROOT, f"hw_{name}.out"), "ab")
+    os.makedirs(ARTIFACTS, exist_ok=True)
+    out = open(os.path.join(ARTIFACTS, f"hw_{name}.out"), "ab")
     child = subprocess.Popen(argv, cwd=ROOT, env=env,
                              stdout=out, stderr=subprocess.STDOUT)
     t0 = time.monotonic()

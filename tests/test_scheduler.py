@@ -1,0 +1,459 @@
+"""Cross-request continuous batching (serving/scheduler.py, ISSUE 5).
+
+Quick tier: the scheduler is pure Python orchestration over the proven
+stream-session programs, and the xla-impl tiny model keeps every test
+CPU-cheap. Covered here:
+
+- equivalence: scheduler results are bit-identical (greedy) to
+  per-request ``Engine.serve()`` for uniform, ragged, and over-batch
+  workloads, including chunked prefill;
+- fairness: a short request admitted while a long generation is
+  mid-decode retires while the long one is still running, under ONE
+  shared batch;
+- backpressure: a full admission queue yields a structured
+  ``queue_full`` reply and the server survives;
+- observability: ``{"cmd": "metrics"}`` exposes queue_depth /
+  batch_occupancy / ttft_ms / queue_wait_ms, and a trace dump from a
+  loaded server shows admit/retire events interleaved;
+- the ``gen_len`` clamp echo + counter, and the client ``timeout=``.
+"""
+
+import json
+import socket
+import socketserver
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.models import DenseLLM, Engine, ModelConfig
+from triton_dist_tpu.serving import (ChatClient, ModelServer, QueueFull,
+                                     Scheduler, fanout)
+
+
+@pytest.fixture()
+def tiny(mesh8, key):
+    cfg = ModelConfig(hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=8,
+                      num_key_value_heads=8, head_dim=4, vocab_size=64,
+                      max_position_embeddings=64, dtype=jnp.float32)
+    model = DenseLLM(cfg, mesh=mesh8, axis="tp", impl="xla")
+    return model, model.init(key)
+
+
+def _engine(model, batch=2, max_seq=64):
+    return Engine(model, batch=batch, max_seq=max_seq,
+                  prefill_mode="xla_ar", decode_mode="gemm_ar")
+
+
+def _solo(model, params, prompt, gen_len, stop=()):
+    """Golden: the prompt served alone, trimmed to the exact-retire
+    contract (generated tokens end at the first stop token)."""
+    out = np.asarray(_engine(model, batch=1).serve(
+        params, jnp.asarray([prompt], jnp.int32), gen_len,
+        stop_tokens=stop))[0].tolist()
+    gen = out[len(prompt):]
+    for i, t in enumerate(gen):
+        if t in set(stop):
+            return gen[:i + 1]
+    return gen
+
+
+def _wait_until(pred, timeout=60.0, what="condition"):
+    t0 = time.monotonic()
+    while not pred():
+        assert time.monotonic() - t0 < timeout, f"timed out on {what}"
+        time.sleep(0.02)
+
+
+# ---------------------------------------------------------------------------
+# Equivalence: scheduler == per-request serve(), greedy.
+# ---------------------------------------------------------------------------
+
+def test_scheduler_matches_solo_serve(tiny):
+    """Uniform, ragged, AND over-batch in one workload: 6 mixed-length
+    prompts through a 2-row window, all submitted concurrently, each
+    bit-identical to serving it alone."""
+    model, params = tiny
+    sched = Scheduler(_engine(model), params).start()
+    try:
+        prompts = [[1, 2, 3], [9, 8], [4, 5, 6, 7], [11], [23, 29],
+                   [7, 7, 7]]
+        reqs = [sched.submit(p, 5) for p in prompts]
+        for p, r in zip(prompts, reqs):
+            assert r.result(timeout=180) == _solo(model, params, p, 5)
+    finally:
+        sched.stop()
+
+
+def test_scheduler_stop_tokens_exact_retire(tiny):
+    """Per-request stop sets retire rows exactly at the stop token."""
+    model, params = tiny
+    probe = _solo(*tiny, [1, 2], 6)
+    stop = (probe[1],)      # 2nd generated token of the first prompt
+    sched = Scheduler(_engine(model), params).start()
+    try:
+        prompts = [[1, 2], [3, 4], [5, 6]]
+        reqs = [sched.submit(p, 6, stop_tokens=stop) for p in prompts]
+        for p, r in zip(prompts, reqs):
+            want = _solo(model, params, p, 6, stop=stop)
+            assert r.result(timeout=180) == want, (p, want)
+    finally:
+        sched.stop()
+
+
+def test_scheduler_chunked_prefill_matches_solo(tiny):
+    """Chunked admission (TDT_PREFILL_CHUNK path): a long prompt
+    prefills in slices interleaved with decode steps and still decodes
+    bit-identically; a second request rides along mid-prefill."""
+    model, params = tiny
+    eng = _engine(model)
+    sched = Scheduler(eng, params, prefill_chunk=4).start()
+    try:
+        long_p = list(range(1, 15))          # 14 tokens → 4 chunks of 4
+        short_p = [5, 9]
+        r_long = sched.submit(long_p, 5)
+        r_short = sched.submit(short_p, 5)
+        assert r_long.result(timeout=180) == _solo(model, params,
+                                                   long_p, 5)
+        assert r_short.result(timeout=180) == _solo(model, params,
+                                                    short_p, 5)
+        assert eng._admit_chunk is not None  # the chunked path ran
+    finally:
+        sched.stop()
+
+
+def test_server_scheduler_roundtrip_matches_solo(tiny):
+    """The whole stack — socket protocol → scheduler → shared batch —
+    returns per-request results equal to solo serving; the response
+    echoes the effective gen_len."""
+    model, params = tiny
+    srv = ModelServer(_engine(model), params, port=0).start()
+    try:
+        prompts = [[1, 2, 3], [9, 8], [4, 5, 6, 7]]
+        outs = fanout(srv.host, srv.port,
+                      [{"prompt_ids": [p], "gen_len": 4}
+                       for p in prompts], timeout=180)
+        for p, o in zip(prompts, outs):
+            assert o.get("gen_len") == 4, o
+            assert o["tokens"][0] == _solo(model, params, p, 4)
+        # multi-prompt request: one connection, rows still per-prompt
+        c = ChatClient(srv.host, srv.port, timeout=180)
+        r = c.generate_ids(prompts, gen_len=3)
+        for p, row in zip(prompts, r["tokens"]):
+            assert row == _solo(model, params, p, 3)
+        c.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fairness: no head-of-line blocking under one shared batch.
+# ---------------------------------------------------------------------------
+
+def test_short_request_retires_while_long_decodes(tiny):
+    """ISSUE 5 acceptance: a short request admitted while a long
+    generation is mid-decode completes while the long one is STILL
+    decoding — the serialized-lock server could never do this."""
+    model, params = tiny
+    sched = Scheduler(_engine(model, batch=2), params).start()
+    try:
+        r_long = sched.submit([1, 2, 3], 55)
+        # wait until the long generation is genuinely mid-decode
+        _wait_until(lambda: len(r_long.tokens) >= 3, what="long decode")
+        r_short = sched.submit([9, 8], 2)
+        short_out = r_short.result(timeout=180)
+        assert not r_long.done.is_set(), \
+            "short request should retire while the long one decodes"
+        assert short_out == _solo(model, params, [9, 8], 2)
+        r_long.result(timeout=180)      # and the long one finishes too
+    finally:
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# Backpressure.
+# ---------------------------------------------------------------------------
+
+def test_scheduler_queue_full_raises(tiny):
+    model, params = tiny
+    sched = Scheduler(_engine(model, batch=1), params,
+                      max_waiting=2).start()
+    try:
+        r_a = sched.submit([1, 2, 3], 50)
+        # A must leave the queue (admitted into the one row) first so
+        # the fill below is deterministic.
+        _wait_until(lambda: sched.queue_depth() == 0, what="A admitted")
+        r_b = sched.submit([4, 5], 4)           # queue slot 1
+        r_c = sched.submit([6, 7], 4)           # queue slot 2 → full
+        with pytest.raises(QueueFull):
+            sched.submit([6], 2)
+        # submit_many is atomic: a 2-prompt batch (which FITS capacity,
+        # so it is retryable) into a full queue rejects BOTH — no
+        # half-admitted client batch.
+        with pytest.raises(QueueFull):
+            sched.submit_many([[7], [8]], 2)
+        # ... while a batch LARGER than capacity can never be admitted
+        # and refuses as non-retryable ValueError instead.
+        with pytest.raises(ValueError, match="split the batch"):
+            sched.submit_many([[7], [8], [9]], 2)
+        assert r_a.result(timeout=180) and r_b.result(timeout=180)
+        assert r_c.result(timeout=180)
+    finally:
+        sched.stop()
+
+
+def test_server_backpressure_structured_reply(tiny):
+    """The protocol-level contract: a full queue answers a structured
+    queue_full reply and the server keeps serving afterwards."""
+    model, params = tiny
+    srv = ModelServer(_engine(model, batch=1), params, port=0,
+                      max_waiting=1).start()
+    try:
+        c = ChatClient(srv.host, srv.port, timeout=180)
+        done: dict = {}
+
+        def bg(name, prompt, gen):
+            cc = ChatClient(srv.host, srv.port, timeout=180)
+            done[name] = cc.generate_ids([prompt], gen_len=gen)
+            cc.close()
+
+        ta = threading.Thread(target=bg, args=("a", [1, 2, 3], 55),
+                              daemon=True)
+        ta.start()
+        # wait until A occupies the row (metrics don't take any lock)
+        _wait_until(lambda: c.request({"cmd": "metrics"})["metrics"]
+                    ["gauges"].get("serving.batch_occupancy", 0) >= 1,
+                    what="A occupying the batch")
+        tb = threading.Thread(target=bg, args=("b", [4, 5], 40),
+                              daemon=True)
+        tb.start()
+        _wait_until(lambda: c.request({"cmd": "metrics"})["metrics"]
+                    ["gauges"].get("serving.queue_depth", 0) >= 1,
+                    what="B queued")
+        rej = c.generate_ids([[6]], gen_len=2)
+        assert rej.get("type") == "queue_full", rej
+        assert "max_waiting" in rej and "queue_depth" in rej
+        ta.join(timeout=180)
+        tb.join(timeout=180)
+        assert "tokens" in done["a"] and "tokens" in done["b"]
+        ok = c.generate_ids([[5]], gen_len=2)   # server survives
+        assert "tokens" in ok
+        m = c.request({"cmd": "metrics"})["metrics"]
+        assert m["counters"].get("server.backpressure_replies", 0) >= 1
+        c.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Observability: metrics + trace acceptance.
+# ---------------------------------------------------------------------------
+
+def test_metrics_and_trace_show_batch_churn(tiny):
+    """ISSUE 5 acceptance: metrics expose queue_depth /
+    batch_occupancy / ttft_ms / queue_wait_ms, and a trace dump from a
+    loaded server shows admit/retire instants interleaved — some
+    request admitted between another's admit and retire."""
+    model, params = tiny
+    srv = ModelServer(_engine(model, batch=2), params, port=0).start()
+    try:
+        outs = fanout(srv.host, srv.port,
+                      [{"prompt_ids": [[1 + i, 2 + i]], "gen_len": 6}
+                       for i in range(5)], timeout=180)
+        assert all("tokens" in o for o in outs), outs
+        c = ChatClient(srv.host, srv.port, timeout=180)
+        m = c.request({"cmd": "metrics"})["metrics"]
+        assert "serving.queue_depth" in m["gauges"]
+        assert "serving.batch_occupancy" in m["gauges"]
+        assert m["histograms"]["serving.ttft_ms"]["count"] >= 5
+        assert m["histograms"]["serving.queue_wait_ms"]["count"] >= 5
+        assert m["counters"]["serving.admitted"] >= 5
+        assert m["counters"]["serving.retired"] >= 5
+        d = c.dump_trace(seconds=600)
+        c.close()
+        with open(d["dumped"]) as f:
+            evs = json.load(f)["traceEvents"]
+        admits = sorted((e["ts"], e["args"]["rid"]) for e in evs
+                        if e["name"] == "serving.admit")
+        retires = {e["args"]["rid"]: e["ts"] for e in evs
+                   if e["name"] == "serving.retire"}
+        assert len(admits) >= 5 and len(retires) >= 5
+        # interleaving: some OTHER request was admitted inside another
+        # request's admit→retire window (rows churn through the batch)
+        assert any(ts_a < ts_b < retires[rid_a]
+                   for ts_a, rid_a in admits
+                   for ts_b, rid_b in admits
+                   if rid_a != rid_b and rid_a in retires), \
+            "no admission interleaved with a live request"
+        # every admit instant carries the request's trace id
+        tids = {e["args"].get("trace_id") for e in evs
+                if e["name"] == "serving.admit"}
+        assert all(tids) and len(tids) >= 5
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Satellites: gen_len clamp echo, client timeout, legacy path.
+# ---------------------------------------------------------------------------
+
+def test_gen_len_clamp_echo_and_counter(tiny):
+    model, params = tiny
+    srv = ModelServer(_engine(model, batch=1, max_seq=16), params,
+                      port=0).start()
+    try:
+        c = ChatClient(srv.host, srv.port, timeout=180)
+        r = c.generate_ids([[1, 2, 3]], gen_len=500)
+        assert r["gen_len"] == 13            # max_seq 16 − prompt 3
+        assert len(r["tokens"][0]) <= 13
+        m = c.request({"cmd": "metrics"})["metrics"]
+        assert m["counters"]["server.gen_len_clamped"] == 1
+        r2 = c.generate_ids([[1, 2]], gen_len=4)   # unclamped echoes
+        assert r2["gen_len"] == 4                  # the request as-is
+        m = c.request({"cmd": "metrics"})["metrics"]
+        assert m["counters"]["server.gen_len_clamped"] == 1
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_client_timeout_on_wedged_server():
+    """A server that accepts but never answers must raise TimeoutError
+    within the client timeout, not block forever (the satellite fix)."""
+    class _Mute(socketserver.BaseRequestHandler):
+        def handle(self):
+            time.sleep(30)
+
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _Mute)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        host, port = srv.server_address
+        c = ChatClient(host, port, timeout=0.3)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            c.request({"prompt_ids": [[1]], "gen_len": 1})
+        assert time.monotonic() - t0 < 5.0
+        # per-call override on a fresh connection
+        c2 = ChatClient(host, port)
+        with pytest.raises(TimeoutError):
+            c2.request({"cmd": "metrics"}, timeout=0.2)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_scheduler_stop_unblocks_waiters(tiny):
+    model, params = tiny
+    sched = Scheduler(_engine(model, batch=1), params).start()
+    r = sched.submit([1, 2, 3], 60)
+    _wait_until(lambda: len(r.tokens) >= 1, what="decode started")
+    sched.stop()
+    with pytest.raises(RuntimeError, match="scheduler stopped"):
+        r.result(timeout=30)
+    with pytest.raises(RuntimeError, match="not running"):
+        sched.submit([1], 1)
+
+
+def test_scheduler_invalid_requests_fail_fast(tiny):
+    model, params = tiny
+    sched = Scheduler(_engine(model, batch=1, max_seq=16), params).start()
+    try:
+        with pytest.raises(ValueError, match="non-empty"):
+            sched.submit([], 4)
+        with pytest.raises(ValueError, match="max_seq"):
+            sched.submit(list(range(1, 15)), 10)
+        r = sched.submit([1, 2], 0)          # gen_len 0: trivially done
+        assert r.result(timeout=5) == []
+        out = sched.generate([1, 2, 3], 3)   # scheduler still healthy
+        assert out == _solo(model, params, [1, 2, 3], 3)
+    finally:
+        sched.stop()
+
+
+def test_pump_death_unblocks_and_stops_accepting(tiny, monkeypatch):
+    """A pump thread that dies (even during SESSION CONSTRUCTION — an
+    oversubscribed paged pool is legal for plain serve() yet asserts
+    in a stream session) must fail every waiter and flip the scheduler
+    to not-running, not leave handlers blocked on result() forever
+    (review finding)."""
+    model, params = tiny
+    eng = _engine(model, batch=1)
+    monkeypatch.setattr(
+        eng, "stream_session",
+        lambda p: (_ for _ in ()).throw(RuntimeError("pool exhausted")))
+    sched = Scheduler(eng, params).start()
+    try:
+        r = sched.submit([1, 2], 4)
+    except RuntimeError:
+        pass                    # pump already died — submit refused
+    else:
+        with pytest.raises(RuntimeError, match="scheduler"):
+            r.result(timeout=30)
+    _wait_until(lambda: not sched._running, what="pump marked dead")
+    with pytest.raises(RuntimeError, match="not running"):
+        sched.submit([1], 1)
+    sched.stop()
+
+
+def test_oversized_batch_is_not_retryable_queue_full(tiny):
+    """A single request with more prompts than max_waiting can NEVER
+    be admitted — it must fail as a non-retryable error, not a
+    'retry later' queue_full reply (review finding)."""
+    model, params = tiny
+    srv = ModelServer(_engine(model, batch=1), params, port=0,
+                      max_waiting=2).start()
+    try:
+        c = ChatClient(srv.host, srv.port, timeout=180)
+        r = c.generate_ids([[1], [2], [3]], gen_len=2)
+        assert "error" in r and r.get("type") != "queue_full", r
+        assert "split the batch" in r["error"]
+        ok = c.generate_ids([[1], [2]], gen_len=2)  # fits → served
+        assert "tokens" in ok
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_oversubscribed_paged_pool_falls_back_to_serialized():
+    """Auto-detect must NOT enable the scheduler for a paged engine
+    whose pool can't pre-allocate every lane (legal for plain serve();
+    a stream session would die at pump startup and brick generation —
+    review finding). Explicit scheduler=True still fails loudly."""
+    class _KV:
+        batch, max_seq = 2, 16
+        slots_per_dev, pages_per_seq_dev = 2, 2   # needs 4, has 2
+
+    class _Eng:
+        kv = _KV()
+        use_mega = False
+        paged = True
+
+    srv = ModelServer(_Eng(), None, port=0).start()
+    try:
+        assert srv.scheduler is None
+    finally:
+        srv.stop()
+
+
+def test_server_serialized_path_still_works(tiny):
+    """scheduler=False keeps the pre-scheduler serialized route (the
+    mega-engine fallback) intact, clamp echo included."""
+    model, params = tiny
+    srv = ModelServer(_engine(model, batch=1, max_seq=16), params,
+                      port=0, scheduler=False).start()
+    try:
+        assert srv.scheduler is None
+        c = ChatClient(srv.host, srv.port, timeout=180)
+        r = c.generate_ids([[1, 2, 3]], gen_len=4)
+        assert r["tokens"][0] == _solo(model, params, [1, 2, 3], 4)
+        assert r["gen_len"] == 4
+        r2 = c.generate_ids([[1, 2, 3]], gen_len=500)
+        assert r2["gen_len"] == 13
+        c.close()
+    finally:
+        srv.stop()

@@ -14,12 +14,12 @@ from triton_dist_tpu.models.config import ModelConfig
 from triton_dist_tpu.models.dense import DenseLLM
 from triton_dist_tpu.models.qwen_moe import Qwen3MoE
 from triton_dist_tpu.models.kv_cache import KVCacheManager
-from triton_dist_tpu.models.engine import Engine, sample_token
+from triton_dist_tpu.models.engine import Engine, StreamSession, sample_token
 from triton_dist_tpu.models.train import make_train_step, cross_entropy_loss
 from triton_dist_tpu.models import presets
 
 __all__ = ["ModelConfig", "DenseLLM", "Qwen3MoE", "KVCacheManager",
-           "Engine", "sample_token", "AutoLLM", "make_train_step", "presets",
+           "Engine", "StreamSession", "sample_token", "AutoLLM", "make_train_step", "presets",
            "cross_entropy_loss"]
 
 

@@ -142,6 +142,8 @@ class Engine:
         self._decode_step_stop = None
         self._stream_step = None
         self._admit = None
+        self._admit_chunk = None
+        self._admit_finish = None
 
     # -- decode step (jit once = graph capture, engine.py:75-105) ----------
     def _get_mega(self):
@@ -460,12 +462,56 @@ class Engine:
             return first[0], pools
         return admit
 
+    def _build_admit_chunk(self):
+        """One slice of a CHUNKED admission prefill: forward ``chunk``
+        positions into the batch-1 scratch cache at ``offset`` (rope
+        and causal mask from the absolute position — the plain
+        ``_attention_core`` chunk-at-offset path). Compiled once per
+        (chunk, scratch-length) pair; the serving scheduler interleaves
+        these between shared decode steps so a long prompt's admission
+        never stalls the rows already decoding (docs/serving.md)."""
+        model, mode = self.model, self.prefill_mode
+
+        @jax.jit
+        def chunk_step(params, small, ids, offset):
+            return model.forward(params, ids, small, offset, mode=mode)
+        return chunk_step
+
+    def _build_admit_finish(self):
+        """Tail of a chunked admission: sample the first token at the
+        prompt's true last position inside the final chunk's logits,
+        then scatter the scratch prefix into row ``row``'s lane — the
+        same pad-slot safety argument as ``_build_admit`` (pad K/V are
+        causally invisible and overwritten before any mask exposes
+        them)."""
+
+        @jax.jit
+        def finish(caches, small, logits, idx, row, key):
+            last = jax.lax.dynamic_slice_in_dim(logits, idx, 1,
+                                                axis=1)[:, 0]
+            first = sample_token(last, key, self.temperature, self.top_k,
+                                 self.top_p)
+            new_caches = []
+            for (ck, cv), (sk, sv) in zip(caches, small):
+                ck = jax.lax.dynamic_update_slice(ck, sk, (row, 0, 0, 0))
+                cv = jax.lax.dynamic_update_slice(cv, sv, (row, 0, 0, 0))
+                new_caches.append((ck, cv))
+            return first[0], new_caches
+        return finish
+
     @staticmethod
     def _bucket_len(n: int) -> int:
         b = 8
         while b < n:
             b *= 2
         return b
+
+    def stream_session(self, params) -> "StreamSession":
+        """Open an incremental continuous-batching session over this
+        engine's decode window (resets the KV cache). The serving
+        scheduler drives one of these; ``serve_stream`` is the
+        single-caller convenience driver."""
+        return StreamSession(self, params)
 
     def serve_stream(self, params, prompts, gen_len: int,
                      stop_tokens=None) -> list:
@@ -497,13 +543,7 @@ class Engine:
             always land in pages the row owns and can never corrupt
             another sequence.
         """
-        if self.use_mega:
-            raise ValueError(
-                "use_mega decodes uniform-offset batches only — "
-                "continuous batching runs every row at its own "
-                "cache offset; serve_stream needs use_mega=False")
         obs.counter("engine.serve_stream_calls").inc()
-        paged = self.paged
         b = self.kv.batch
         if stop_tokens is None:
             eos = getattr(self.model.config, "eos_token_id", -1)
@@ -515,49 +555,8 @@ class Engine:
         assert all(len(p) for p in prompts), "prompts must be non-empty"
         assert all(len(p) + gen_len <= self.kv.max_seq for p in prompts), \
             "prompt + gen_len must fit max_seq"
-        # sp prefill shards S over the sp axis: buckets must divide.
-        # Keyed on EITHER mode being "sp" (init asserts they only come
-        # together, but the prefill is what shards S — advisor r3).
-        sp_world = (self.model.mesh.shape[self.model.sp_axis]
-                    if "sp" in (self.prefill_mode, self.decode_mode)
-                    else 1)
 
-        self.kv.reset()
-        cur_table = None
-        if paged:
-            # Fail with a sizing message BEFORE touching the allocator:
-            # streaming pre-allocates every lane (see below), so an
-            # oversubscribed pool (legal for plain serve) would
-            # otherwise die mid-loop with a bare "device pool
-            # exhausted" (ADVICE r4-2).
-            need = b * self.kv.pages_per_seq_dev
-            assert self.kv.slots_per_dev >= need, (
-                f"serve_stream pre-allocates pages for every batch row: "
-                f"pool has {self.kv.slots_per_dev} slots/device, needs "
-                f"{need} (batch {b} x {self.kv.pages_per_seq_dev} "
-                f"pages/seq/device). Construct the paged pool with "
-                f"full-batch capacity for streaming, or lower batch.")
-            for row in self.kv.owned_rows():
-                self.kv.free_seq(row)
-            # Every lane must own its pages from step 0: the decode step
-            # runs the per-row KV write for ALL rows (frozen rows
-            # included), and a lane that was never admitted would write
-            # through a zeroed table entry that aliases slot 0 of a live
-            # row (advisor r3, medium). Pre-owning all rows makes frozen
-            # writes land in pages nobody else holds; admission below
-            # then free+reallocs per row as before.
-            for row in range(b):
-                self.kv.alloc_seq(row)
-            cur_table = self.kv.block_table()
-        caches = self.kv.init()
-        if self._stream_step is None:
-            self._stream_step = self._build_stream_step()
-        if self._admit is None:
-            self._admit = (self._build_admit_paged() if paged
-                           else self._build_admit())
-
-        token = jnp.zeros((b,), jnp.int32)
-        offsets = jnp.zeros((b,), jnp.int32)
+        sess = self.stream_session(params)
         row_req = [None] * b                 # request id occupying a row
         row_budget = [0] * b                 # tokens left to generate
         results: list[list[int] | None] = [None] * n_req
@@ -575,67 +574,30 @@ class Engine:
             if row_budget[r] <= 0 or tok in stop_set:
                 results[rid] = list(prompts[rid]) + generated.pop(rid)
                 row_req[r] = None
+                sess.retire_row(r)
                 return True
             return False
 
         def admit_free_rows():
-            nonlocal next_req, token, offsets, caches, cur_table
+            nonlocal next_req
             for r in range(b):
                 if next_req >= n_req:
                     return
                 while row_req[r] is None and next_req < n_req:
                     rid = next_req
                     next_req += 1
-                    prompt = prompts[rid]
-                    lb = self._bucket_len(len(prompt))
-                    lb = -(-lb // sp_world) * sp_world   # round UP to a
-                    lb = min(lb, self.kv.max_seq)        # world multiple
-                    padded = list(prompt) + [0] * (lb - len(prompt))
-                    self.key, sub = jax.random.split(self.key)
-                    ids = jnp.asarray([padded], jnp.int32)
-                    if paged:
-                        # Atomic row turnover: the retiree's pages are
-                        # released and the newcomer's allocated in one
-                        # place, so no frozen row ever writes through a
-                        # table lane it no longer owns.
-                        if r in self.kv.owned_rows():
-                            self.kv.free_seq(r)
-                        self.kv.alloc_seq(r)
-                        cur_table = self.kv.block_table()
-                        first, caches = self._admit(
-                            params, caches, ids,
-                            jnp.int32(len(prompt)),
-                            cur_table[:, r:r + 1], sub)
-                    else:
-                        first, caches = self._admit(
-                            params, caches, ids, jnp.int32(len(prompt)),
-                            jnp.int32(r), sub)
-                    obs.counter("engine.stream_admissions").inc()
-                    _trace.instant("engine.stream_admission", "engine",
-                                   args={"row": r, "request": rid,
-                                         "prompt_len": len(prompt)})
+                    first = sess.prefill_into_row(r, prompts[rid])
                     row_req[r] = rid
                     row_budget[r] = gen_len
                     generated[rid] = []
-                    token = token.at[r].set(first)
-                    offsets = offsets.at[r].set(len(prompt))
                     # gen_len == 1 or an immediate stop frees the row
                     # again; the inner while then admits the next
                     # request into the same row.
-                    record(r, int(first))
+                    record(r, first)
 
         admit_free_rows()
         while any(rid is not None for rid in row_req):
-            done = jnp.asarray([row_req[r] is None for r in range(b)])
-            with obs.span("engine.stream_step"):
-                self.key, sub = jax.random.split(self.key)
-                token, caches, offsets = self._stream_step(
-                    params, caches, token, offsets, sub, done, cur_table)
-                if obs.enabled() or _trace.enabled():
-                    # Real step latency, not the async enqueue (same
-                    # observer cost as the serve() decode span).
-                    jax.block_until_ready(token)
-            toks = np.asarray(token)
+            toks = sess.decode_step()
             for r in range(b):
                 if row_req[r] is not None:
                     record(r, int(toks[r]))
@@ -665,3 +627,216 @@ class Engine:
                                     stop_tokens=stop_tokens,
                                     kv_start=kv_start))
         return [out[i, s - lens[i]:] for i in range(b)]
+
+
+class StreamSession:
+    """Incremental row-level API over an Engine's fixed decode window.
+
+    Owns the mutable continuous-batching state (caches, per-row
+    offsets, last tokens, live mask) that ``Engine.serve_stream`` used
+    to keep in locals, exposed as the three verbs a scheduler drives:
+
+    * :meth:`prefill_into_row` — admit a prompt into a free row: the
+      whole prompt in one admission program, or (``chunk=N``) the
+      first N tokens with the rest advanced by :meth:`prefill_step`
+      between decode steps, so a long prompt's admission never stalls
+      the rows already decoding;
+    * :meth:`decode_step` — ONE shared decode step for every live row
+      (frozen rows re-emit their token and do not advance);
+    * :meth:`retire_row` — free a finished row for the next admission.
+
+    ``Engine.serve_stream`` is a thin single-caller driver over this
+    class; the serving scheduler (``serving/scheduler.py``) is another
+    — one that feeds rows from MANY client connections into the same
+    batch. Exactly one thread may drive a session (the engine state is
+    not locked).
+    """
+
+    def __init__(self, engine: Engine, params):
+        if engine.use_mega:
+            raise ValueError(
+                "use_mega decodes uniform-offset batches only — "
+                "continuous batching runs every row at its own "
+                "cache offset; serve_stream / stream sessions need "
+                "use_mega=False")
+        self.engine = engine
+        self.params = params
+        b = engine.kv.batch
+        # sp prefill shards S over the sp axis: buckets must divide.
+        # Keyed on EITHER mode being "sp" (init asserts they only come
+        # together, but the prefill is what shards S — advisor r3).
+        self._sp_world = (
+            engine.model.mesh.shape[engine.model.sp_axis]
+            if "sp" in (engine.prefill_mode, engine.decode_mode) else 1)
+        engine.kv.reset()
+        self.cur_table = None
+        if engine.paged:
+            # Fail with a sizing message BEFORE touching the allocator:
+            # streaming pre-allocates every lane (see below), so an
+            # oversubscribed pool (legal for plain serve) would
+            # otherwise die mid-loop with a bare "device pool
+            # exhausted" (ADVICE r4-2).
+            need = b * engine.kv.pages_per_seq_dev
+            assert engine.kv.slots_per_dev >= need, (
+                f"a stream session pre-allocates pages for every batch "
+                f"row: pool has {engine.kv.slots_per_dev} slots/device, "
+                f"needs {need} (batch {b} x "
+                f"{engine.kv.pages_per_seq_dev} pages/seq/device). "
+                f"Construct the paged pool with full-batch capacity "
+                f"for streaming, or lower batch.")
+            for row in engine.kv.owned_rows():
+                engine.kv.free_seq(row)
+            # Every lane must own its pages from step 0: the decode step
+            # runs the per-row KV write for ALL rows (frozen rows
+            # included), and a lane that was never admitted would write
+            # through a zeroed table entry that aliases slot 0 of a live
+            # row (advisor r3, medium). Pre-owning all rows makes frozen
+            # writes land in pages nobody else holds; admission below
+            # then free+reallocs per row as before.
+            for row in range(b):
+                engine.kv.alloc_seq(row)
+            self.cur_table = engine.kv.block_table()
+        self.caches = engine.kv.init()
+        if engine._stream_step is None:
+            engine._stream_step = engine._build_stream_step()
+        if engine._admit is None:
+            engine._admit = (engine._build_admit_paged() if engine.paged
+                             else engine._build_admit())
+        self.token = jnp.zeros((b,), jnp.int32)
+        self.offsets = jnp.zeros((b,), jnp.int32)
+        self.live = [False] * b
+        self._pending: dict[int, dict] = {}   # row → chunked-prefill state
+
+    @property
+    def batch(self) -> int:
+        return self.engine.kv.batch
+
+    def free_rows(self) -> list:
+        """Rows with no occupant (neither live nor mid-prefill)."""
+        return [r for r in range(self.batch)
+                if not self.live[r] and r not in self._pending]
+
+    # -- admission ---------------------------------------------------------
+    def prefill_into_row(self, row: int, prompt, chunk: int | None = None):
+        """Admit ``prompt`` into free row ``row``.
+
+        Whole-prompt (``chunk=None``): runs the admission prefill now
+        and returns the first sampled token (int). Chunked: runs only
+        the first ``chunk``-token slice and returns ``None``; call
+        :meth:`prefill_step` (between decode steps) until it returns
+        the first token. Chunking applies to the non-paged, non-sp
+        scratch-prefill path; other engine families fall back to the
+        one-shot admission.
+        """
+        assert not self.live[row] and row not in self._pending, \
+            f"row {row} is occupied"
+        prompt = [int(t) for t in prompt]
+        assert prompt, "prompts must be non-empty"
+        eng = self.engine
+        if (chunk and not eng.paged and eng.prefill_mode != "sp"
+                and len(prompt) > chunk
+                and -(-len(prompt) // chunk) * chunk <= eng.kv.max_seq):
+            return self._start_chunked(row, prompt, int(chunk))
+        return self._admit_whole(row, prompt)
+
+    def _admit_whole(self, row: int, prompt: list) -> int:
+        eng = self.engine
+        lb = eng._bucket_len(len(prompt))
+        lb = -(-lb // self._sp_world) * self._sp_world   # round UP to a
+        lb = min(lb, eng.kv.max_seq)                     # world multiple
+        padded = prompt + [0] * (lb - len(prompt))
+        eng.key, sub = jax.random.split(eng.key)
+        ids = jnp.asarray([padded], jnp.int32)
+        if eng.paged:
+            # Atomic row turnover: the retiree's pages are released
+            # and the newcomer's allocated in one place, so no frozen
+            # row ever writes through a table lane it no longer owns.
+            if row in eng.kv.owned_rows():
+                eng.kv.free_seq(row)
+            eng.kv.alloc_seq(row)
+            self.cur_table = eng.kv.block_table()
+            first, self.caches = eng._admit(
+                self.params, self.caches, ids, jnp.int32(len(prompt)),
+                self.cur_table[:, row:row + 1], sub)
+        else:
+            first, self.caches = eng._admit(
+                self.params, self.caches, ids, jnp.int32(len(prompt)),
+                jnp.int32(row), sub)
+        self._mark_admitted(row, len(prompt))
+        self.token = self.token.at[row].set(first)
+        return int(first)
+
+    def _start_chunked(self, row: int, prompt: list, chunk: int):
+        eng = self.engine
+        if eng._admit_chunk is None:
+            eng._admit_chunk = eng._build_admit_chunk()
+            eng._admit_finish = eng._build_admit_finish()
+        n_chunks = -(-len(prompt) // chunk)
+        lb = n_chunks * chunk
+        padded = prompt + [0] * (lb - len(prompt))
+        eng.key, sub = jax.random.split(eng.key)
+        self._pending[row] = {
+            "ids": np.asarray([padded], np.int32), "len": len(prompt),
+            "chunk": chunk, "pos": 0, "key": sub,
+            "small": [(jnp.zeros((1, lb) + ck.shape[2:], ck.dtype),
+                       jnp.zeros((1, lb) + cv.shape[2:], cv.dtype))
+                      for ck, cv in self.caches]}
+        return self.prefill_step(row)
+
+    def prefill_step(self, row: int):
+        """Advance row ``row``'s chunked admission by one slice; returns
+        the first sampled token (int) once the last slice lands, else
+        ``None``."""
+        eng = self.engine
+        st = self._pending[row]
+        c = st["chunk"]
+        ids_chunk = jnp.asarray(st["ids"][:, st["pos"]:st["pos"] + c])
+        logits, st["small"] = eng._admit_chunk(
+            self.params, st["small"], ids_chunk, jnp.int32(st["pos"]))
+        st["pos"] += c
+        if st["pos"] < st["ids"].shape[1]:
+            return None
+        del self._pending[row]
+        idx = st["len"] - 1 - (st["pos"] - c)   # last real token's index
+        first, self.caches = eng._admit_finish(  # in the final chunk
+            self.caches, st["small"], logits, jnp.int32(idx),
+            jnp.int32(row), st["key"])
+        self._mark_admitted(row, st["len"])
+        self.token = self.token.at[row].set(first)
+        return int(first)
+
+    def cancel_prefill(self, row: int) -> None:
+        """Drop a mid-chunk admission (its scratch cache was never
+        scattered into the batch, so the session stays consistent)."""
+        self._pending.pop(row, None)
+
+    def _mark_admitted(self, row: int, prompt_len: int) -> None:
+        obs.counter("engine.stream_admissions").inc()
+        _trace.instant("engine.stream_admission", "engine",
+                       args={"row": row, "prompt_len": prompt_len})
+        self.offsets = self.offsets.at[row].set(prompt_len)
+        self.live[row] = True
+
+    # -- decode / retire ---------------------------------------------------
+    def decode_step(self) -> np.ndarray:
+        """One shared decode step: every live row decodes at its own
+        cache position, frozen rows re-emit their token. Returns the
+        (batch,) token vector as numpy."""
+        eng = self.engine
+        done = jnp.asarray([not alive for alive in self.live])
+        with obs.span("engine.stream_step"):
+            eng.key, sub = jax.random.split(eng.key)
+            self.token, self.caches, self.offsets = eng._stream_step(
+                self.params, self.caches, self.token, self.offsets, sub,
+                done, self.cur_table)
+            if obs.enabled() or _trace.enabled():
+                # Real step latency, not the async enqueue (same
+                # observer cost as the serve() decode span).
+                jax.block_until_ready(self.token)
+        return np.asarray(self.token)
+
+    def retire_row(self, row: int) -> None:
+        """Free a finished row; the next admission may reuse its lane
+        immediately (a paged retiree keeps its pages until the
+        replacement is admitted — atomic turnover)."""
+        self.live[row] = False

@@ -44,6 +44,7 @@ from triton_dist_tpu.obs.registry import (  # noqa: F401
 )
 from triton_dist_tpu.obs.exposition import (  # noqa: F401
     aggregate_across_hosts,
+    histogram_quantile,
     merge_snapshots,
     render_prometheus,
 )

@@ -20,8 +20,38 @@ import re
 
 from triton_dist_tpu.obs import registry as _registry
 
-__all__ = ["allgather_json", "merge_snapshots", "render_prometheus",
-           "aggregate_across_hosts"]
+__all__ = ["allgather_json", "histogram_quantile", "merge_snapshots",
+           "render_prometheus", "aggregate_across_hosts"]
+
+
+def histogram_quantile(h: dict, q: float) -> float | None:
+    """Estimate the ``q``-quantile of a snapshot histogram dict
+    (fixed upper-bound ``buckets`` + per-bucket ``counts`` — the shape
+    :meth:`Histogram.to_dict` emits) by linear interpolation inside
+    the containing bucket; the +Inf tail reports the recorded ``max``
+    (the only honest point estimate there). ``None`` on an empty or
+    malformed histogram. This is how bench.py turns the server's
+    ``serving.ttft_ms`` histogram into p50/p99 without shipping raw
+    samples."""
+    counts = h.get("counts") or []
+    buckets = h.get("buckets") or []
+    total = h.get("count", 0)
+    if not total or not counts:
+        return None
+    target = q * total
+    cum = 0
+    lo = 0.0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target and c:
+            if i >= len(buckets):
+                return float(h["max"]) if h.get("max") is not None else None
+            hi = buckets[i]
+            frac = (target - (cum - c)) / c
+            return lo + (hi - lo) * frac
+        if i < len(buckets):
+            lo = buckets[i]
+    return float(h["max"]) if h.get("max") is not None else None
 
 
 def allgather_json(obj) -> list:

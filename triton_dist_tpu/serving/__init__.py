@@ -1,5 +1,9 @@
-"""Serving demo (reference: mega_triton_kernel/test/models/model_server.py
-socket server, chat.py client, bench_qwen3.py; SURVEY.md §2.7)."""
+"""Serving stack: continuous-batching scheduler, TCP server, client
+(reference: mega_triton_kernel/test/models/model_server.py socket
+server, chat.py client, bench_qwen3.py; SURVEY.md §2.7 — extended with
+cross-request continuous batching, docs/serving.md)."""
 
 from triton_dist_tpu.serving.server import ModelServer  # noqa: F401
-from triton_dist_tpu.serving.client import ChatClient  # noqa: F401
+from triton_dist_tpu.serving.client import ChatClient, fanout  # noqa: F401
+from triton_dist_tpu.serving.scheduler import (  # noqa: F401
+    QueueFull, Request, Scheduler)

@@ -1,37 +1,69 @@
 """Chat client for the ModelServer (reference chat.py,
 mega_triton_kernel/test/models/chat.py). Token-id protocol; plugs a HF
-tokenizer in when available for text chat."""
+tokenizer in when available for text chat.
+
+``timeout=`` (constructor or per call) bounds every protocol round
+trip — a wedged server raises ``TimeoutError`` instead of blocking the
+client forever. :func:`fanout` is the small concurrent-client helper
+the serving bench and the scheduler load tests drive their traffic
+through: one connection + thread per request, responses in request
+order.
+"""
 
 from __future__ import annotations
 
 import json
 import socket
+import threading
+
+#: Sentinel distinguishing "no per-call timeout given" from an explicit
+#: ``timeout=None`` (= block forever).
+_UNSET = object()
 
 
 class ChatClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 8777,
-                 tokenizer=None):
+                 tokenizer=None, timeout: float | None = None):
+        """``timeout``: seconds each protocol round trip may take
+        (connect included) before ``TimeoutError``; ``None`` blocks
+        indefinitely (the historical behavior)."""
         self.addr = (host, port)
         self.tokenizer = tokenizer
-        self._sock = socket.create_connection(self.addr)
+        self.timeout = timeout
+        self._sock = socket.create_connection(self.addr, timeout=timeout)
         self._file = self._sock.makefile("rwb")
 
-    def request(self, req: dict) -> dict:
+    def request(self, req: dict, timeout=_UNSET) -> dict:
         """One protocol round trip with an arbitrary request object
-        (generation or control-plane, e.g. ``{"cmd": "metrics"}``)."""
-        self._file.write((json.dumps(req) + "\n").encode())
-        self._file.flush()
-        return json.loads(self._file.readline())
+        (generation or control-plane, e.g. ``{"cmd": "metrics"}``).
+        ``timeout`` overrides the client default for this call only
+        (``socket.timeout`` is a ``TimeoutError``; the connection is
+        left in an undefined protocol state after one — reconnect)."""
+        if timeout is not _UNSET:
+            self._sock.settimeout(timeout)
+        try:
+            self._file.write((json.dumps(req) + "\n").encode())
+            self._file.flush()
+            line = self._file.readline()
+        finally:
+            if timeout is not _UNSET:
+                self._sock.settimeout(self.timeout)
+        if not line:
+            raise ConnectionError("server closed the connection")
+        return json.loads(line)
 
     def generate_ids(self, prompt_ids, gen_len: int = 16,
-                     trace_id: str | None = None) -> dict:
+                     trace_id: str | None = None,
+                     timeout=_UNSET) -> dict:
         """Generate; with tracing on server-side the response carries
         ``trace_id`` (yours if given) for cross-referencing a later
-        flight record (docs/observability.md "Tracing")."""
+        flight record (docs/observability.md "Tracing"), and
+        ``gen_len`` echoes the server's effective (possibly clamped)
+        value."""
         req = {"prompt_ids": prompt_ids, "gen_len": gen_len}
         if trace_id is not None:
             req["trace_id"] = trace_id
-        return self.request(req)
+        return self.request(req, timeout=timeout)
 
     def dump_trace(self, seconds: float | None = None) -> dict:
         """Ask the server to dump its flight record
@@ -54,18 +86,50 @@ class ChatClient:
         self._sock.close()
 
 
+def fanout(host: str, port: int, requests: list,
+           timeout: float | None = None) -> list:
+    """Issue ``requests`` (protocol dicts) CONCURRENTLY — one fresh
+    connection and thread per request — and return the responses in
+    request order. A request that fails client-side (timeout, refused
+    connection) yields an ``{"error", "type"}`` dict in its slot, so
+    the caller can count failures without unwinding the others. This
+    is the concurrent-client helper behind bench.py's
+    ``serving_throughput`` probe and the scheduler load tests."""
+    results: list = [None] * len(requests)
+
+    def worker(i: int, payload: dict) -> None:
+        try:
+            c = ChatClient(host, port, timeout=timeout)
+            try:
+                results[i] = c.request(payload)
+            finally:
+                c.close()
+        except Exception as e:  # noqa: BLE001 — per-slot isolation
+            results[i] = {"error": str(e) or repr(e),
+                          "type": type(e).__name__}
+
+    threads = [threading.Thread(target=worker, args=(i, r), daemon=True)
+               for i, r in enumerate(requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
 def main():  # pragma: no cover - manual demo
     import argparse
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8777)
     ap.add_argument("--tokenizer-dir", default=None)
+    ap.add_argument("--timeout", type=float, default=None)
     args = ap.parse_args()
     tok = None
     if args.tokenizer_dir:
         from transformers import AutoTokenizer
         tok = AutoTokenizer.from_pretrained(args.tokenizer_dir)
-    client = ChatClient(args.host, args.port, tok)
+    client = ChatClient(args.host, args.port, tok, timeout=args.timeout)
     try:
         while True:
             text = input("you> ")

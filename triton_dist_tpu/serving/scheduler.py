@@ -1,0 +1,390 @@
+"""Cross-request continuous batching: one shared decode loop for every
+connection.
+
+The reference's model server — and our ``ModelServer`` before this
+module — holds a global lock for an entire generation: concurrent
+clients queue head-of-line behind whichever generation got there first,
+even though the engine's continuous-batching machinery
+(``Engine.serve_stream`` / ``StreamSession``) already knows how to
+admit a new prompt into a freed decode row mid-flight. This module
+closes that gap at the REQUEST level: a single scheduler thread owns
+the engine's fixed decode batch and pumps one shared decode loop, while
+handler threads enqueue requests into a bounded FIFO admission queue
+and block on per-request futures. A 4-token request submitted while a
+4096-token generation is mid-decode completes in milliseconds, not
+minutes — T3's fine-grained-interleaving lesson (PAPERS.md) applied at
+the request level: throughput under load is gated by the scheduler,
+not the kernels.
+
+Design:
+
+- **One engine thread.** Only the pump thread touches the Engine's
+  ``StreamSession``; handler threads interact through the queue and
+  per-request done-events, so no generation lock exists at all.
+- **Fair FIFO admission with backpressure.** :meth:`Scheduler.submit`
+  appends to a bounded queue (``max_waiting`` / ``TDT_MAX_WAITING``,
+  default 64); a full queue raises :class:`QueueFull`, which the
+  server answers with a structured ``queue_full`` reply instead of
+  stalling the connection. Admission order is strictly
+  first-come-first-served.
+- **Chunked prefill.** With ``prefill_chunk`` (``TDT_PREFILL_CHUNK``)
+  set, long prompts prefill ``chunk`` tokens at a time — one slice per
+  pump iteration, interleaved with the shared decode step — so
+  admitting a long prompt cannot stall the token cadence of the rows
+  already decoding (``StreamSession.prefill_step``).
+- **Observability** (docs/observability.md): ``serving.queue_depth``
+  and ``serving.batch_occupancy`` gauges, per-request
+  ``serving.ttft_ms`` and ``serving.queue_wait_ms`` histograms,
+  ``serving.admitted`` / ``serving.retired`` /
+  ``serving.rejected_queue_full`` counters, and ``serving.admit`` /
+  ``serving.retire`` instants on the trace timeline carrying each
+  request's trace ID — a Perfetto dump of a loaded server shows rows
+  churning through the batch.
+
+Greedy results are bit-identical to per-request ``Engine.serve()``
+(tests/test_scheduler.py): the scheduler drives the same
+admission/decode programs ``serve_stream`` is proven on
+(tests/test_engine_stream.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import threading
+import time
+import warnings
+
+from triton_dist_tpu import obs
+from triton_dist_tpu.obs import trace
+
+__all__ = ["DEFAULT_MAX_WAITING", "QueueFull", "Request", "Scheduler"]
+
+DEFAULT_MAX_WAITING = 64
+
+
+class QueueFull(RuntimeError):
+    """Admission queue is at ``max_waiting`` — backpressure; the caller
+    should retry later (the server turns this into a structured
+    ``queue_full`` reply)."""
+
+
+class Request:
+    """One prompt's life through the shared batch: queued → admitted →
+    decoding → done. Handler threads block on :meth:`result`; only the
+    pump thread mutates the other fields."""
+
+    __slots__ = ("prompt", "gen_len", "stop_set", "trace_id", "rid",
+                 "t_submit", "t_admit", "t_first", "tokens", "error",
+                 "done")
+
+    def __init__(self, prompt, gen_len: int, stop_set, trace_id, rid):
+        self.prompt = prompt
+        self.gen_len = gen_len
+        self.stop_set = stop_set
+        self.trace_id = trace_id
+        self.rid = rid
+        self.t_submit = time.perf_counter()
+        self.t_admit = None
+        self.t_first = None
+        self.tokens: list[int] = []     # generated tokens (no prompt)
+        self.error: BaseException | None = None
+        self.done = threading.Event()
+
+    def result(self, timeout: float | None = None) -> list[int]:
+        """Block until the request finishes; returns the generated
+        tokens (ending at, and including, the first stop token).
+        Raises the scheduler-side failure if the request degraded, or
+        ``TimeoutError`` if ``timeout`` elapses first."""
+        if not self.done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.rid} not done within {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.tokens
+
+
+class Scheduler:
+    """Continuous-batching serving scheduler over one Engine.
+
+    ``submit()`` from any thread; a single pump thread drives the
+    engine's :class:`~triton_dist_tpu.models.engine.StreamSession` so
+    prompts from different connections coexist in one decode batch.
+    """
+
+    def __init__(self, engine, params, max_waiting: int | None = None,
+                 prefill_chunk: int | None = None):
+        if getattr(engine, "use_mega", False):
+            raise ValueError(
+                "use_mega decodes uniform-offset batches only — the "
+                "continuous-batching scheduler needs use_mega=False")
+        self.engine = engine
+        self.params = params
+        if max_waiting is None:
+            max_waiting = int(os.environ.get("TDT_MAX_WAITING",
+                                             DEFAULT_MAX_WAITING))
+        if max_waiting <= 0:
+            raise ValueError(f"max_waiting must be positive: {max_waiting}")
+        self.max_waiting = max_waiting
+        if prefill_chunk is None:
+            v = os.environ.get("TDT_PREFILL_CHUNK", "").strip()
+            prefill_chunk = int(v) if v else None
+        if prefill_chunk is not None and prefill_chunk <= 0:
+            raise ValueError(
+                f"prefill_chunk must be positive: {prefill_chunk}")
+        self.prefill_chunk = prefill_chunk
+        self._cond = threading.Condition()
+        self._queue: collections.deque[Request] = collections.deque()
+        self._rid = 0
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._session = None
+
+    # -- client side -------------------------------------------------------
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def _make_request(self, prompt, gen_len, stop_tokens, trace_id):
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompts must be non-empty")
+        gen_len = int(gen_len)
+        if len(prompt) + max(gen_len, 0) > self.engine.kv.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + gen_len ({gen_len}) must fit "
+                f"max_seq ({self.engine.kv.max_seq})")
+        if stop_tokens is None:
+            eos = getattr(self.engine.model.config, "eos_token_id", -1)
+            stop_set = {eos} if eos >= 0 else set()
+        else:
+            stop_set = {int(t) for t in stop_tokens}
+        self._rid += 1
+        return Request(prompt, gen_len, stop_set, trace_id, self._rid)
+
+    def submit(self, prompt, gen_len: int, stop_tokens=None,
+               trace_id: str | None = None) -> Request:
+        """Enqueue one prompt; returns its :class:`Request` future.
+        Raises :class:`QueueFull` when ``max_waiting`` requests are
+        already queued, ``ValueError`` on an unservable request."""
+        return self.submit_many([prompt], gen_len, stop_tokens=stop_tokens,
+                                trace_id=trace_id)[0]
+
+    def submit_many(self, prompts, gen_len: int, stop_tokens=None,
+                    trace_id: str | None = None) -> list[Request]:
+        """Atomically enqueue several prompts (one client request's
+        batch): either every prompt is queued or none is — a
+        half-admitted batch is worse than a clean ``queue_full``
+        reply."""
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("scheduler is not running")
+            reqs = [self._make_request(p, gen_len, stop_tokens, trace_id)
+                    for p in prompts]
+            live = [r for r in reqs if r.gen_len > 0]
+            for r in reqs:
+                if r.gen_len <= 0:      # nothing to generate
+                    r.done.set()
+            if len(live) > self.max_waiting:
+                # NOT QueueFull: retrying can never help — the batch
+                # exceeds queue capacity even when idle. The server
+                # turns ValueError into a non-retryable structured
+                # error instead of a "retry later" reply.
+                raise ValueError(
+                    f"request batches {len(live)} prompts but the "
+                    f"admission queue holds max_waiting="
+                    f"{self.max_waiting} — split the batch")
+            if live:
+                if len(self._queue) + len(live) > self.max_waiting:
+                    obs.counter("serving.rejected_queue_full").inc(
+                        len(live))
+                    raise QueueFull(
+                        f"admission queue full "
+                        f"({len(self._queue)} waiting, "
+                        f"max_waiting {self.max_waiting})")
+                self._queue.extend(live)
+                obs.gauge("serving.queue_depth").set(len(self._queue))
+                self._cond.notify()
+        return reqs
+
+    def generate(self, prompt, gen_len: int, stop_tokens=None,
+                 trace_id: str | None = None,
+                 timeout: float | None = None) -> list[int]:
+        """submit() + result(): the generated tokens for one prompt."""
+        return self.submit(prompt, gen_len, stop_tokens=stop_tokens,
+                           trace_id=trace_id).result(timeout)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "Scheduler":
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+        self._thread = threading.Thread(target=self._pump,
+                                        name="tdt-scheduler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Stop the pump thread; queued and in-flight requests fail
+        with a "scheduler stopped" error (their handlers unblock)."""
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    # -- the pump ----------------------------------------------------------
+    def _bind(self, req: Request):
+        """Per-request trace binding around that request's OWN engine
+        work (admission prefill): its stream_admission instant — and,
+        on the first compile, the op instants the programs emit — land
+        under the request's trace ID. The shared decode step serves
+        many requests at once and stays unbound."""
+        return (trace.bind(req.trace_id) if req.trace_id
+                else contextlib.nullcontext())
+
+    def _fail(self, req: Request, exc: BaseException) -> None:
+        req.error = exc
+        req.done.set()
+
+    def _pump(self) -> None:
+        """Pump-thread entry: however the loop exits — clean stop, a
+        session that cannot even be CONSTRUCTED (e.g. an oversubscribed
+        paged pool, legal for plain serve()), or an unexpected crash —
+        every queued and in-flight waiter is unblocked with an error
+        and the scheduler stops accepting work. A dead pump with
+        ``_running`` still True would otherwise hang every
+        ``result()`` caller forever."""
+        rows: dict[int, Request] = {}        # occupied rows (any state)
+        exc: BaseException | None = None
+        try:
+            self._pump_loop(rows)
+        except BaseException as e:  # noqa: BLE001 — drain, then surface
+            exc = e
+            obs.counter("serving.pump_errors").inc()
+        finally:
+            with self._cond:
+                self._running = False
+                leftovers = list(self._queue)
+                self._queue.clear()
+                obs.gauge("serving.queue_depth").set(0)
+            err = RuntimeError("scheduler stopped" if exc is None
+                               else f"scheduler died: {exc!r}")
+            for req in leftovers + list(rows.values()):
+                self._fail(req, err)
+            obs.gauge("serving.batch_occupancy").set(0)
+        if exc is not None:
+            # The waiters already carry the exception; re-raising from
+            # a daemon thread would only add unhandled-thread noise.
+            warnings.warn(f"scheduler pump died: {exc!r}",
+                          RuntimeWarning, stacklevel=2)
+
+    def _pump_loop(self, rows: dict) -> None:
+        sess = self.engine.stream_session(self.params)
+        self._session = sess
+        budgets: dict[int, int] = {}
+        prefilling: set[int] = set()         # rows mid-chunked-prefill
+        occupancy = obs.gauge("serving.batch_occupancy")
+
+        def record(row: int, req: Request, tok: int) -> None:
+            req.tokens.append(tok)
+            if req.t_first is None:
+                req.t_first = time.perf_counter()
+                obs.histogram("serving.ttft_ms").observe(
+                    (req.t_first - req.t_submit) * 1e3)
+            budgets[row] -= 1
+            if budgets[row] <= 0 or tok in req.stop_set:
+                sess.retire_row(row)
+                rows.pop(row)
+                budgets.pop(row)
+                obs.counter("serving.retired").inc()
+                trace.emit("i", "serving.retire", "serving",
+                           args={"row": row, "rid": req.rid,
+                                 "tokens": len(req.tokens)},
+                           trace_id=req.trace_id)
+                req.done.set()
+
+        def admit(row: int, req: Request) -> None:
+            req.t_admit = time.perf_counter()
+            obs.histogram("serving.queue_wait_ms").observe(
+                (req.t_admit - req.t_submit) * 1e3)
+            obs.counter("serving.admitted").inc()
+            trace.emit("i", "serving.admit", "serving",
+                       args={"row": row, "rid": req.rid,
+                             "prompt_len": len(req.prompt),
+                             "queued_ms": round(
+                                 (req.t_admit - req.t_submit) * 1e3, 3)},
+                       trace_id=req.trace_id)
+            try:
+                with self._bind(req):
+                    first = sess.prefill_into_row(
+                        row, req.prompt, chunk=self.prefill_chunk)
+            except Exception as e:  # noqa: BLE001 — degrade THIS request
+                sess.cancel_prefill(row)
+                obs.counter("serving.admit_errors").inc()
+                self._fail(req, e)
+                return
+            rows[row] = req
+            budgets[row] = req.gen_len
+            if first is None:
+                prefilling.add(row)
+            else:
+                record(row, req, first)
+
+        while True:
+            admits = []
+            with self._cond:
+                while self._running and not self._queue and not rows:
+                    self._cond.wait()
+                if not self._running:
+                    break
+                free = sess.free_rows()
+                while self._queue and free:
+                    admits.append((free.pop(0), self._queue.popleft()))
+                obs.gauge("serving.queue_depth").set(len(self._queue))
+            # Engine work happens OUTSIDE the lock: submitters only ever
+            # wait on queue capacity, never on device time.
+            for row, req in admits:
+                admit(row, req)
+            for row in sorted(prefilling):   # one slice each, FIFO-ish
+                req = rows[row]
+                try:
+                    with self._bind(req):
+                        first = sess.prefill_step(row)
+                except Exception as e:  # noqa: BLE001
+                    sess.cancel_prefill(row)
+                    prefilling.discard(row)
+                    rows.pop(row)
+                    budgets.pop(row, None)
+                    obs.counter("serving.admit_errors").inc()
+                    self._fail(req, e)
+                    continue
+                if first is not None:
+                    prefilling.discard(row)
+                    record(row, req, first)
+            occupancy.set(len(rows))
+            live = [(r, rows[r]) for r in sorted(rows)
+                    if r not in prefilling]
+            if live:
+                try:
+                    toks = sess.decode_step()
+                except Exception as e:  # noqa: BLE001
+                    # The SHARED step died: every occupant degrades (the
+                    # cache state is suspect) and the session restarts
+                    # fresh; the scheduler itself keeps serving.
+                    obs.counter("serving.pump_errors").inc()
+                    for _, req in list(rows.items()):
+                        self._fail(req, e)
+                    rows.clear()
+                    budgets.clear()
+                    prefilling.clear()
+                    sess = self.engine.stream_session(self.params)
+                    self._session = sess
+                    occupancy.set(0)
+                    continue
+                for row, req in live:
+                    if rows.get(row) is req:   # not failed above
+                        record(row, req, int(toks[row]))
+            occupancy.set(len(rows))

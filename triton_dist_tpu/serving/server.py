@@ -1,14 +1,27 @@
-"""Minimal generation server.
+"""Generation server with cross-request continuous batching.
 
 TPU-native analog of the reference's demo server
 (python/triton_dist/mega_triton_kernel/test/models/model_server.py: a
-socket server feeding the megakernel model, with chat.py as the client).
+socket server feeding the megakernel model, with chat.py as the
+client) — extended past it: generation routes through the
+continuous-batching scheduler (serving/scheduler.py) by default, so
+prompts from DIFFERENT connections coexist in one decode batch
+instead of queueing whole generations behind a lock
+(docs/serving.md "Scheduler").
 Protocol: newline-delimited JSON over TCP —
 
     → {"prompt_ids": [[...]], "gen_len": 16, "stop_tokens": [151645]}
-    ← {"tokens": [[...]], "latency_ms": 12.3}
+    ← {"tokens": [[...]], "gen_len": 16, "latency_ms": 12.3}
 
-``stop_tokens`` is optional (default: the model config's eos).
+``stop_tokens`` is optional (default: the model config's eos). The
+response's ``gen_len`` echoes the EFFECTIVE value — requests past the
+protocol cap (4096) or the engine's room (max_seq − longest prompt)
+are clamped, counted into ``server.gen_len_clamped``, never silent.
+A full admission queue answers a structured backpressure reply
+instead of stalling the connection —
+
+    ← {"error": ..., "type": "queue_full", "queue_depth": N,
+       "max_waiting": M}
 
 Telemetry (docs/observability.md): a metrics request on the same
 protocol returns the process-local registry snapshot —
@@ -23,11 +36,13 @@ registry (``telemetry=False`` opts out).
 Tracing (docs/observability.md "Tracing"): the server also runs the
 event tracer / flight recorder by default (``TDT_TRACE=0`` opts out).
 Every generation request gets a trace ID — the client's own
-``"trace_id"`` if it sent one, a fresh one otherwise — bound to the
-handling thread for the request's whole life, so its serving span,
-engine prefill/decode spans, op instants, and any resilience
-fallbacks are one filterable story in an exported timeline; the ID
-is echoed back in the response. The flight recorder dumps the last
+``"trace_id"`` if it sent one, a fresh one otherwise — carried by its
+``serving.request`` span (handler thread) and by its scheduler-side
+``serving.admit`` / ``serving.retire`` instants and admission events
+(pump thread, re-bound per admission), so the request's
+queue → admit → retire story filters to one ID in an exported
+timeline; the shared decode-step spans serve many requests at once
+and stay unbound. The ID is echoed back in the response. The flight recorder dumps the last
 ``TDT_FLIGHT_SECONDS`` of events on demand —
 
     → {"cmd": "dump_trace"}
@@ -107,10 +122,23 @@ class _TCPServer(socketserver.ThreadingTCPServer):
 
 
 class ModelServer:
-    """Wraps an Engine behind a TCP JSON-lines protocol."""
+    """Wraps an Engine behind a TCP JSON-lines protocol.
+
+    By default generation runs through the continuous-batching
+    :class:`~triton_dist_tpu.serving.scheduler.Scheduler`: every
+    connection's prompts share ONE decode batch, so a short request
+    admitted while a long generation is mid-decode completes without
+    queueing behind it (docs/serving.md "Scheduler"). ``scheduler=False``
+    restores the serialized-lock path (one generation at a time;
+    ``use_mega`` engines fall back to it automatically — the mega
+    program decodes uniform-offset batches only).
+    """
 
     def __init__(self, engine, params, host: str = "127.0.0.1",
-                 port: int = 0, telemetry: bool = True):
+                 port: int = 0, telemetry: bool = True,
+                 scheduler: bool | None = None,
+                 max_waiting: int | None = None,
+                 prefill_chunk: int | None = None):
         self.engine = engine
         self.params = params
         if telemetry:
@@ -124,7 +152,27 @@ class ModelServer:
             if trace.env_enabled(default=True):
                 trace.enable()
                 flight.install_signal_handlers()
-        self._lock = threading.Lock()  # one generation at a time
+        if scheduler is None:
+            # Auto: on for engines a stream session can actually
+            # serve. Test doubles without a kv and mega engines keep
+            # the serialized path — and so does a paged engine whose
+            # pool is oversubscribed (legal for plain serve(), but a
+            # stream session pre-allocates every lane and would die at
+            # pump startup, bricking generation entirely). Explicit
+            # scheduler=True still fails loudly for those.
+            kv = getattr(engine, "kv", None)
+            scheduler = (kv is not None
+                         and not getattr(engine, "use_mega", False)
+                         and not (getattr(engine, "paged", False)
+                                  and kv.slots_per_dev
+                                  < kv.batch * kv.pages_per_seq_dev))
+        self.scheduler = None
+        if scheduler:
+            from triton_dist_tpu.serving.scheduler import Scheduler
+            self.scheduler = Scheduler(
+                engine, params, max_waiting=max_waiting,
+                prefill_chunk=prefill_chunk).start()
+        self._lock = threading.Lock()  # serialized path only
         self._srv = _TCPServer((host, port), _Handler)
         self._srv.model_server = self
         self.host, self.port = self._srv.server_address
@@ -181,15 +229,60 @@ class ModelServer:
         return {"error": f"unknown cmd {cmd!r} "
                          f"(known: metrics, dump_trace)"}
 
+    def _effective_gen_len(self, req: dict, prompts) -> int:
+        """Clamp the requested gen_len to the protocol cap (4096) AND
+        the engine's room (max_seq − longest prompt). The clamp is no
+        longer silent: the response echoes the effective value under
+        ``"gen_len"`` and every clamped request counts into
+        ``server.gen_len_clamped``, so clients can tell they asked for
+        more than they got."""
+        requested = int(req.get("gen_len", 16))
+        room = self.engine.kv.max_seq - max(
+            (len(p) for p in prompts), default=0)
+        gen_len = max(0, min(requested, 4096, room))
+        if gen_len != requested:
+            obs.counter("server.gen_len_clamped").inc()
+        return gen_len
+
     def _serve_generate(self, req: dict) -> dict:
-        # Request clock starts BEFORE the generation lock: under load,
-        # queue wait is the dominant latency component and
-        # server.request_ms must show it (client-facing latency_ms
-        # keeps its original generation-only meaning).
         t_req0 = time.perf_counter()
         prompts = req["prompt_ids"]
-        gen_len = max(0, min(int(req.get("gen_len", 16)), 4096))
+        gen_len = self._effective_gen_len(req, prompts)
         stop = req.get("stop_tokens")  # None → engine default (eos)
+        if self.scheduler is not None:
+            from triton_dist_tpu.serving.scheduler import QueueFull
+            try:
+                futures = self.scheduler.submit_many(
+                    prompts, gen_len, stop_tokens=stop,
+                    trace_id=trace.current_trace_id())
+            except QueueFull:
+                # Structured backpressure, not an exception page: the
+                # client sees WHY and can retry; the connection (and
+                # every other request in flight) is untouched.
+                obs.counter("server.backpressure_replies").inc()
+                return {"error": "admission queue full — retry later",
+                        "type": "queue_full",
+                        "queue_depth": self.scheduler.queue_depth(),
+                        "max_waiting": self.scheduler.max_waiting}
+            # Rows retire exactly at their first stop token, so the
+            # uniform client contract (tokens end at and include the
+            # first stop token) needs no trimming here.
+            tokens = [f.result() for f in futures]
+            ms = (time.perf_counter() - t_req0) * 1e3
+            obs.histogram("server.request_ms").observe(ms)
+            return {"tokens": tokens, "gen_len": gen_len,
+                    "latency_ms": round(ms, 3)}
+        return self._serve_generate_serialized(req, prompts, gen_len,
+                                               stop, t_req0)
+
+    def _serve_generate_serialized(self, req, prompts, gen_len, stop,
+                                   t_req0) -> dict:
+        # The pre-scheduler path (scheduler=False / mega engines): a
+        # global lock serializes whole generations. The request clock
+        # starts BEFORE the lock: under load, queue wait is the
+        # dominant latency component and server.request_ms must show
+        # it (client-facing latency_ms keeps its original
+        # generation-only meaning here).
         lens = [len(p) for p in prompts]
         ragged = len(set(lens)) > 1
         batch = self.engine.kv.batch
@@ -234,7 +327,7 @@ class ModelServer:
             ms = (time.perf_counter() - t0) * 1e3
         obs.histogram("server.request_ms").observe(
             (time.perf_counter() - t_req0) * 1e3)
-        return {"tokens": [trim(r) for r in tokens],
+        return {"tokens": [trim(r) for r in tokens], "gen_len": gen_len,
                 "latency_ms": round(ms, 3)}
 
     def start(self):
@@ -246,6 +339,8 @@ class ModelServer:
     def stop(self):
         self._srv.shutdown()
         self._srv.server_close()
+        if self.scheduler is not None:
+            self.scheduler.stop()
 
 
 def main():  # pragma: no cover - manual demo

@@ -77,6 +77,43 @@ def render_resilience(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_serving(snap: dict) -> str:
+    """Summarize the continuous-batching scheduler's ``serving.*``
+    metrics (docs/serving.md "Scheduler"): queue depth / batch
+    occupancy gauges, admitted / retired / backpressure counters, and
+    TTFT + queue-wait percentiles interpolated from the snapshot
+    histograms. Empty string when the snapshot carries no serving
+    metrics (a scheduler-less process)."""
+    counters = {k: v for k, v in snap.get("counters", {}).items()
+                if k.startswith("serving.")}
+    gauges = {k: v for k, v in snap.get("gauges", {}).items()
+              if k.startswith("serving.")}
+    hists = {k: h for k, h in snap.get("histograms", {}).items()
+             if k.startswith("serving.")}
+    if not counters and not gauges and not hists:
+        return ""
+    from triton_dist_tpu.obs import histogram_quantile
+    lines = ["#### serving", "| metric | value |", "|---|---|"]
+    for k in sorted(gauges):
+        v = gauges[k]
+        lines.append(f"| {k} | "
+                     f"{int(v) if float(v) == int(v) else round(v, 4)} |")
+    for k in sorted(counters):
+        v = counters[k]
+        lines.append(f"| {k} | "
+                     f"{int(v) if float(v) == int(v) else v} |")
+    for k in sorted(hists):
+        h = hists[k]
+        p50 = histogram_quantile(h, 0.50)
+        p99 = histogram_quantile(h, 0.99)
+        lines.append(
+            f"| {k} | n={h.get('count', 0)} "
+            f"p50={round(p50, 3) if p50 is not None else '-'} "
+            f"p99={round(p99, 3) if p99 is not None else '-'} "
+            f"max={h.get('max')} |")
+    return "\n".join(lines)
+
+
 def render_tracing(stats: dict | None) -> str:
     """Summarize the event-tracing / flight-recorder state
     (``obs.trace.stats()``, carried under the snapshot's ``trace`` key
@@ -105,11 +142,14 @@ def render_telemetry(snap: dict) -> str:
     plus dedicated resilience and tracing sections when those exist."""
     lines = ["### telemetry"]
     resil = render_resilience(snap)
+    serving = render_serving(snap)
     tracing = render_tracing(snap.get("trace"))
     # trace.* gauges mirror what the tracing section already shows
     # (they exist for the Prometheus exposition path) — don't render
-    # the same numbers twice when that section is present.
+    # the same numbers twice when that section is present; ditto the
+    # serving.* metrics and their dedicated section.
     skip = lambda k: (k.startswith("resilience.")  # noqa: E731
+                      or (bool(serving) and k.startswith("serving."))
                       or (bool(tracing) and k.startswith("trace.")))
     scalars = [("counter", k, v)
                for k, v in sorted(snap.get("counters", {}).items())
@@ -119,6 +159,8 @@ def render_telemetry(snap: dict) -> str:
                 if not skip(k)]
     if resil:
         lines += [resil, ""]
+    if serving:
+        lines += [serving, ""]
     if tracing:
         lines += [tracing, ""]
     if scalars:
@@ -126,7 +168,8 @@ def render_telemetry(snap: dict) -> str:
         for kind, k, v in scalars:
             vv = int(v) if float(v) == int(v) else round(float(v), 4)
             lines.append(f"| {k} | {kind} | {vv} |")
-    hists = snap.get("histograms", {})
+    hists = {k: h for k, h in snap.get("histograms", {}).items()
+             if not skip(k)}
     if hists:
         lines += ["", "| histogram | count | mean | min | max |",
                   "|---|---|---|---|---|"]
