@@ -37,7 +37,8 @@ What it benches (BASELINE.md north star; reference e2e_dense.md:21-38):
   -32B per-chip TP8 slice dims — reference e2e table rows), overlap
   (ag_gemm DMA-under-MXU proxy), moe_ag_gg, mega (incl. 32-layer deep
   config), serving (continuous-batching scheduler vs serialized lock,
-  8 concurrent clients — valid on the CPU tier), sp_attn, train. On a single chip the collective parts
+  8 concurrent clients — valid on the CPU tier), prefix (shared-preamble
+  clients, prefix cache warm vs cold — also CPU-valid), sp_attn, train. On a single chip the collective parts
   collapse, so the numbers measure Mosaic-kernel vs XLA compute
   quality; on a real slice the same code measures overlap.
 
@@ -170,7 +171,7 @@ def _probe_backend_subprocess(timeout_s: float) -> bool:
 #: can only cost the tail.
 _PART_ORDER = ("ag_gemm", "gemm_rs", "gemm_ar", "flash_decode", "tp_mlp",
                "layer_8b", "layer_32b", "overlap", "moe_ag_gg", "mega",
-               "serving", "sp_attn", "train")
+               "serving", "prefix", "sp_attn", "train")
 
 #: Sweep-heavy parts get longer deadlines: ag_gemm/gemm_rs autotune
 #: 6-8 candidates at ~25 s Mosaic compile each on a COLD cache (the
@@ -891,6 +892,37 @@ def _bench_mega_vs_engine(mesh, n, on_tpu, extras):
     return t_mega, t_engine / t_mega
 
 
+def _scrape_metrics(host, port):
+    from triton_dist_tpu.serving.client import ChatClient
+    c = ChatClient(host, port)
+    try:
+        return c.request({"cmd": "metrics"})["metrics"]
+    finally:
+        c.close()
+
+
+def _hist_delta(before, after, name):
+    """The timed window's own histogram: warmup requests share the
+    process-global registry, and their cold-compile TTFTs would
+    otherwise put jit time into the reported p99."""
+    a = (before or {}).get("histograms", {}).get(name)
+    b = (after or {}).get("histograms", {}).get(name)
+    if not b:
+        return None
+    if not a:
+        return b
+    return {"buckets": b["buckets"],
+            "counts": [y - x for x, y in zip(a["counts"],
+                                             b["counts"])],
+            "count": b["count"] - a["count"],
+            "sum": b["sum"] - a["sum"],
+            # The window's extrema are unknowable from cumulative
+            # snapshots; the lifetime max is the warmup's compile
+            # time — exactly what this delta excludes. None makes
+            # a +Inf-tail quantile report None (honest) instead.
+            "min": None, "max": None}
+
+
 def _bench_serving(mesh, n, on_tpu, extras):
     """Serving throughput under concurrency (ISSUE 5): N concurrent
     clients with mixed prompt/gen lengths against (a) the
@@ -939,32 +971,9 @@ def _bench_serving(mesh, n, on_tpu, extras):
             for i, (pl, g) in enumerate(zip(prompt_lens, gens))]
 
     def scrape(host, port):
-        c = ChatClient(host, port)
-        try:
-            return c.request({"cmd": "metrics"})["metrics"]
-        finally:
-            c.close()
+        return _scrape_metrics(host, port)
 
-    def hist_delta(before, after, name):
-        """The timed window's own histogram: warmup requests share the
-        process-global registry, and their cold-compile TTFTs would
-        otherwise put jit time into the reported p99."""
-        a = (before or {}).get("histograms", {}).get(name)
-        b = (after or {}).get("histograms", {}).get(name)
-        if not b:
-            return None
-        if not a:
-            return b
-        return {"buckets": b["buckets"],
-                "counts": [y - x for x, y in zip(a["counts"],
-                                                 b["counts"])],
-                "count": b["count"] - a["count"],
-                "sum": b["sum"] - a["sum"],
-                # The window's extrema are unknowable from cumulative
-                # snapshots; the lifetime max is the warmup's compile
-                # time — exactly what this delta excludes. None makes
-                # a +Inf-tail quantile report None (honest) instead.
-                "min": None, "max": None}
+    hist_delta = _hist_delta
 
     def run(use_scheduler):
         # Serialized baseline decodes one request at a time → its
@@ -1020,6 +1029,128 @@ def _bench_serving(mesh, n, on_tpu, extras):
         extras["serving_queue_wait_p50_ms"] = (round(p50, 3) if p50
                                                else None)
     return tps_sched, extras.get("serving_sched_vs_serial")
+
+
+def _bench_prefix(mesh, n, on_tpu, extras):
+    """Cross-request prefix caching (ISSUE 6): 8 clients sharing one
+    long system preamble against the paged block-granular scheduler,
+    warm (cache on — the warmup indexes the preamble blocks, so each
+    timed request prefills only its few-token suffix) vs cold (cache
+    off — every request prefills the full prompt). Both paths run the
+    identical xla-impl sp-paged engine, so kernel quality cancels and
+    ``serving_prefix_ttft_vs_cold`` prices the prefill tokens SKIPPED —
+    valid on the CPU tier, where the acceptance gate is >= 2x warm TTFT
+    p50 (BASELINE.json cpu floor, tools/bench_ops.py --regress)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from triton_dist_tpu.models import DenseLLM, Engine, ModelConfig
+    from triton_dist_tpu.obs import histogram_quantile
+    from triton_dist_tpu.serving import ModelServer
+
+    if on_tpu:
+        cfg = ModelConfig(hidden_size=512, intermediate_size=1024,
+                          num_hidden_layers=2, num_attention_heads=8,
+                          num_key_value_heads=8, head_dim=64,
+                          vocab_size=2048, max_position_embeddings=1024,
+                          dtype=jnp.bfloat16)
+        page, preamble_len, tail_len, gen = 16, 512, 8, 8
+    else:
+        # Sized so prefill COMPUTE dominates dispatch overhead on the
+        # CPU tier (a 32-wide 1-layer model admits in ~3 ms regardless
+        # of prompt length — all dispatch — and the ratio this part
+        # prices would drown): ~30 ms cold vs ~7 ms warm admissions.
+        cfg = ModelConfig(hidden_size=128, intermediate_size=256,
+                          num_hidden_layers=2, num_attention_heads=8,
+                          num_key_value_heads=8, head_dim=16,
+                          vocab_size=256, max_position_embeddings=512,
+                          dtype=jnp.float32)
+        page, preamble_len, tail_len, gen = 16, 448, 4, 4
+    # sp mode needs an sp axis; keep tp trivial so the part runs on any
+    # device count (the sp world is what pages shard over).
+    devs = np.asarray([d for d in mesh.devices.flat])
+    mesh2 = Mesh(devs.reshape(1, -1), ("tp", "sp"))
+    max_seq = cfg.max_position_embeddings
+    assert max_seq % (len(devs) * page) == 0
+    model = DenseLLM(cfg, mesh=mesh2, axis="tp", sp_axis="sp",
+                     impl="xla", fwd_mode="sp")
+    params = model.init(jax.random.PRNGKey(0))
+    clients, batch = 8, 8
+    preamble = [(13 * j) % (cfg.vocab_size - 1) + 1
+                for j in range(preamble_len)]
+    prompts = [preamble + [(7 * i + j) % 61 + 1
+                           for j in range(tail_len)]
+               for i in range(clients)]
+
+    def run(cache_on):
+        eng = Engine(model, batch=batch, max_seq=max_seq,
+                     prefill_mode="sp", decode_mode="sp", paged=True,
+                     page_size=page, prefix_cache=cache_on)
+        srv = ModelServer(eng, params, port=0).start()
+        try:
+            from triton_dist_tpu.serving.client import ChatClient
+            c = ChatClient(srv.host, srv.port, timeout=600)
+            # Warmup compiles every program the timed window touches —
+            # the cold full-prompt admission bucket, the decode step,
+            # and (cache on) the suffix admission bucket; with the
+            # cache on it ALSO indexes the preamble blocks, which is
+            # exactly the warm-cache condition this part prices.
+            c.generate_ids(prompts[:2], gen_len=2)
+            warm = _scrape_metrics(srv.host, srv.port)
+            # ONE atomic 8-prompt request: all rows admit back-to-back
+            # inside a single pump iteration, BEFORE the first shared
+            # decode step — so per-row TTFT prices admission prefill
+            # alone. (With 8 separate connections the arrivals trickle
+            # and each admission queues behind ~O(max_seq) gathered
+            # decode steps, which drowns the warm/cold difference.)
+            t0 = time.perf_counter()
+            out = c.generate_ids(prompts, gen_len=gen)
+            dt = time.perf_counter() - t0
+            c.close()
+            errors = [] if "tokens" in out else [out]
+            snap = _scrape_metrics(srv.host, srv.port)
+            return dt, errors, warm, snap
+        finally:
+            srv.stop()
+
+    def saved_delta(warm, snap):
+        key = "serving.prefill_tokens_saved"
+        return (snap.get("counters", {}).get(key, 0)
+                - (warm or {}).get("counters", {}).get(key, 0))
+
+    dt_cold, err_cold, warm_c, snap_c = run(False)
+    dt_warm, err_warm, warm_w, snap_w = run(True)
+    extras["serving_prefix_clients"] = clients
+    extras["serving_prefix_preamble_tokens"] = preamble_len
+    extras["serving_prefix_tokens_saved"] = int(saved_delta(warm_w,
+                                                            snap_w))
+    extras["serving_prefix_hit_rate"] = snap_w.get("gauges", {}).get(
+        "serving.prefix_hit_rate")
+    if err_cold or err_warm:
+        extras["serving_prefix_errors"] = [
+            str(e)[:120] for e in (err_cold + err_warm)[:4]]
+    ratio = None
+    for tag, warm_s, snap_s in (("cold", warm_c, snap_c),
+                                ("warm", warm_w, snap_w)):
+        h = _hist_delta(warm_s, snap_s, "serving.ttft_ms")
+        if h:
+            p50 = histogram_quantile(h, 0.50)
+            p99 = histogram_quantile(h, 0.99)
+            extras[f"serving_prefix_{tag}_ttft_p50_ms"] = (
+                round(p50, 3) if p50 else None)
+            extras[f"serving_prefix_{tag}_ttft_p99_ms"] = (
+                round(p99, 3) if p99 else None)
+    p50c = extras.get("serving_prefix_cold_ttft_p50_ms")
+    p50w = extras.get("serving_prefix_warm_ttft_p50_ms")
+    if p50c and p50w:
+        ratio = round(p50c / p50w, 4)
+    elif dt_warm > 0:
+        # Histogram-bucket degenerate case (both p50s in the lowest
+        # bucket): fall back to wall-clock batch time, same workload.
+        ratio = round(dt_cold / dt_warm, 4)
+    extras["serving_prefix_ttft_vs_cold"] = ratio
+    return ratio, ratio
 
 
 def _bench_tp_mlp(mesh, n, on_tpu, extras):
@@ -1549,6 +1680,8 @@ def main():
              lambda: _bench_mega_vs_engine(mesh, n, on_tpu, extras)),
             ("serving",
              lambda: _bench_serving(mesh, n, on_tpu, extras)),
+            ("prefix",
+             lambda: _bench_prefix(mesh, n, on_tpu, extras)),
             ("sp_attn",
              lambda: _bench_sp_attention(mesh, n, on_tpu, extras)),
             ("train", lambda: _bench_train(mesh, n, on_tpu, extras)),

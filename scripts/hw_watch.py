@@ -52,30 +52,17 @@ QUEUE = [
       "TDT_BENCH_PARTS": "ag_gemm,gemm_rs,gemm_ar,flash_decode,tp_mlp",
       "TDT_BENCH_PROGRESS":
           os.path.join(ROOT, ".bench_progress_headline2.json")}),
-    # Position 3: smoke cases after the hang point (29-43: serving
-    # shape, SP attention incl. the fixed fused kernel, ep/pp/models,
-    # fp8 a2a, train) — never covered this round.
-    ("smoke_resume",
+    # Position 3: the full smoke queue. The former flash_decode/paged
+    # DIRECT-kernel canary — the round-5 wedge trigger the old queue
+    # had to --start-after / --skip / quarantine at position 5 — is
+    # retired from tpu_smoke.py entirely (ISSUE 6; docs/resilience.md
+    # "Retired canary"), so the queue no longer needs a hang-point
+    # partition: the production paged route is smoked as
+    # flash_decode/paged_gathered like any other case.
+    ("smoke_full",
      [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "420",
-      "--start-after", "flash_decode/paged",
-      "--log", "artifacts/tpu_smoke_r5_resume.log"],
+      "--log", "artifacts/tpu_smoke_r6.log"],
      7200.0, {}),
-    # Position 4: re-validate cases 1-27 under the round-5 kernel
-    # changes (these passed pre-change; the 24 MB budget alters
-    # default tiles).
-    ("smoke_revalidate",
-     [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "420",
-      "--skip", "flash_decode/paged",
-      "--log", "artifacts/tpu_smoke_r5_reval.log"],
-     7200.0, {}),
-    # Position 5, LAST because it is the known wedge trigger: the
-    # paged-KV compile with a 40-min case budget (r3's train compile
-    # needed 35 min; this may be the same class of slow Mosaic pass).
-    ("smoke_paged",
-     [sys.executable, "tpu_smoke.py", "--subproc", "--case-timeout", "2400",
-      "--only", "=flash_decode/paged",
-      "--log", "artifacts/tpu_smoke_r5_paged.log"],
-     2700.0, {}),
 ]
 
 

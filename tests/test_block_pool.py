@@ -1,0 +1,266 @@
+"""Block-granular paged-KV allocator + prefix-cache invariants
+(ISSUE 6 satellite; models/kv_cache.py block substrate,
+models/prefix_cache.py index).
+
+Pure host-side state machines — no device programs — so the randomized
+property tests run in the quick tier. The invariants checked after
+EVERY operation:
+
+- partition: each device's slots split disjointly into
+  {free stack} ⊎ {referenced (ref > 0)} ⊎ {evictable (cached, ref 0)};
+- refcount conservation: a slot's refcount equals the number of table
+  lanes referencing it across allocated row blocks (the sentinel is a
+  reserved physical page OUTSIDE the accounted pool — it never appears
+  in the refcounts);
+- write-block privacy (the COW discipline): the block holding any live
+  row's next write position has refcount exactly 1 — indexed/shared
+  blocks are immutable by construction, so the "copy" of copy-on-write
+  is statically unreachable;
+- commitment solvency: free + evictable always covers the decode
+  blocks committed to live rows (no admission can starve a live row);
+- leak-freedom: once every row retires, free + evictable equals the
+  whole pool — a stranded block is a slow production OOM.
+"""
+
+import numpy as np
+import pytest
+
+from triton_dist_tpu.models.kv_cache import PagedKVCacheManager
+from triton_dist_tpu.models.prefix_cache import PrefixCache
+
+
+def _mgr(mesh8, batch=4, page=4, ppsd=4, slots=10):
+    return PagedKVCacheManager(1, batch, page, ppsd, 2, 8, mesh=mesh8,
+                               axis="tp", slots_per_dev=slots)
+
+
+def _check_invariants(m, write_pos):
+    """Full-state audit; ``write_pos[b]`` is row b's next write
+    position (None for unoccupied rows)."""
+    w, slots, ppsd = m.world, m.slots_per_dev, m.pages_per_seq_dev
+    expected_ref = np.zeros((w, slots), np.int64)
+    for b in range(m.batch):
+        for j in range(int(m._row_blocks[b])):
+            r, lp = j // ppsd, j % ppsd
+            expected_ref[r, m._table[r, b, lp]] += 1
+    np.testing.assert_array_equal(m._ref, expected_ref)
+    for r in range(w):
+        free = set(int(s) for s in m._stack[r, :m._top[r]])
+        evict = set(m.prefix._evictable[r]) if m.prefix else set()
+        refd = set(int(s) for s in np.nonzero(m._ref[r])[0])
+        assert not free & evict and not free & refd and not evict & refd
+        assert len(free) + len(evict) + len(refd) == slots, \
+            (r, sorted(free), sorted(evict), sorted(refd))
+        if m.prefix:
+            # every evictable slot is indexed; index/slot maps agree
+            for s in evict:
+                assert m.prefix.is_indexed(r, s)
+    avail = m.available_per_dev()
+    assert (avail >= m._committed).all(), (avail, m._committed)
+    # COW discipline: any block a row can WRITE is privately owned.
+    for b, pos in enumerate(write_pos):
+        if pos is None:
+            continue
+        j = pos // m.page_size
+        if j < int(m._row_blocks[b]):
+            r, lp = j // ppsd, j % ppsd
+            assert m._ref[r, m._table[r, b, lp]] == 1, (b, pos)
+    a = m.block_audit()
+    assert a["active"] >= 0 and a["free"] + a["evictable"] + \
+        a["active"] == a["total"]
+
+
+def test_block_pool_randomized_interleavings(mesh8):
+    """Randomized admit(fork-shared prefixes)/decode/retire
+    interleavings never double-free or leak (the satellite's property
+    test). Prompts draw from a few shared families so admissions fork
+    off cached prefixes; the pool is tight enough that the free stacks
+    run dry and LRU eviction engages."""
+    m = _mgr(mesh8, batch=4, page=4, ppsd=4, slots=10)
+    m.stream_setup(prefix_cache=True)
+    rng = np.random.default_rng(11)
+    families = [list(rng.integers(1, 64, size=16)) for _ in range(3)]
+    live: dict = {}          # row -> {pos, end}
+    for step in range(600):
+        op = rng.choice(["admit", "decode", "retire"])
+        free = [b for b in range(m.batch) if b not in live]
+        if op == "admit" and free:
+            b = int(rng.choice(free))
+            fam = families[int(rng.integers(len(families)))]
+            pl = int(rng.integers(1, 14))
+            gen = int(rng.integers(1, 8))
+            prompt = fam[:pl]
+            if not m.can_admit(pl, gen):
+                continue
+            cached = m.admit_row(b, prompt, gen_budget=gen)
+            assert cached % m.page_size == 0 and cached < pl + \
+                m.page_size
+            m.register_prefix(b, prompt)
+            live[b] = {"pos": pl, "end": pl + gen - 1}
+        elif op == "decode" and live:
+            b = int(rng.choice(list(live)))
+            st = live[b]
+            if st["pos"] < st["end"]:
+                m.ensure_position(b, st["pos"])
+                st["pos"] += 1
+        elif op == "retire" and live:
+            b = int(rng.choice(list(live)))
+            m.release_row(b)
+            del live[b]
+        _check_invariants(
+            m, [live[b]["pos"] if b in live else None
+                for b in range(m.batch)])
+    for b in list(live):
+        m.release_row(b)
+    a = m.block_audit()
+    assert a["active"] == 0 and a["committed"] == 0
+    assert a["free"] + a["evictable"] == a["total"]
+
+
+def test_prefix_fork_shares_slots(mesh8):
+    """Two admissions forking from one preamble reference the SAME
+    physical slots for the shared full blocks (refcount 2), and both
+    retire without returning a still-shared slot to the free stack."""
+    m = _mgr(mesh8, batch=2, page=4, ppsd=4, slots=12)
+    m.stream_setup(prefix_cache=True)
+    pre = list(range(1, 13))            # 3 full blocks
+    cached = m.admit_row(0, pre + [20], gen_budget=2)
+    assert cached == 0                   # cold
+    m.register_prefix(0, pre + [20])
+    cached = m.admit_row(1, pre + [30], gen_budget=2)
+    assert cached == 12                  # all 3 preamble blocks shared
+    ppsd = m.pages_per_seq_dev
+    for j in range(3):
+        r, lp = j // ppsd, j % ppsd
+        assert m._table[r, 0, lp] == m._table[r, 1, lp]
+        assert m._ref[r, m._table[r, 0, lp]] == 2
+    m.release_row(0)
+    for j in range(3):                   # row 1 still holds the prefix
+        r, lp = j // ppsd, j % ppsd
+        assert m._ref[r, m._table[r, 1, lp]] == 1
+    m.release_row(1)
+    a = m.block_audit()
+    assert a["active"] == 0
+    assert a["evictable"] == 3           # the indexed prefix stays cached
+
+
+def test_lru_eviction_order_and_reclaim(mesh8):
+    """Eviction takes the LEAST recently released indexed block first,
+    drops it from the index (a later probe misses), and hands its slot
+    to the allocator; blocks referenced by live rows are never
+    evicted."""
+    m = _mgr(mesh8, batch=3, page=4, ppsd=8, slots=8)
+    m.stream_setup(prefix_cache=True)   # all 8 usable (sentinel outside)
+    # 10 tokens = 2 full blocks + a partial tail, so BOTH full blocks
+    # are probe-eligible (an exact-multiple prompt always recomputes
+    # its last block and would cap the probe at n_full - 1).
+    a_p, b_p = list(range(1, 11)), list(range(11, 21))
+    m.admit_row(0, a_p, gen_budget=1)
+    m.register_prefix(0, a_p)
+    m.release_row(0)                    # A's 2 indexed blocks -> evictable
+    m.admit_row(0, b_p, gen_budget=1)
+    m.register_prefix(0, b_p)
+    m.release_row(0)                    # LRU order now: A, then B
+    assert m.prefix_probe(a_p) == 2 and m.prefix_probe(b_p) == 2
+    # Claim B live so only A is evictable, then exhaust the stack.
+    assert m.admit_row(1, b_p, gen_budget=1) == 8
+    free_now = int(m._top[0])
+    m.admit_row(2, list(range(21, 21 + 4 * free_now + 2)),
+                gen_budget=1)           # forces one eviction
+    assert m._evicted_total == 1
+    assert m.prefix_probe(a_p) < 2      # A lost its LRU block (block 0)
+    assert m.prefix_probe(b_p) == 2     # B untouched: live-referenced
+    m.release_row(1)
+    m.release_row(2)
+    a = m.block_audit()
+    assert a["active"] == 0 and a["free"] + a["evictable"] == a["total"]
+
+
+def test_admission_rollback_on_exhaustion(mesh8):
+    """A failed admission (pool short) is all-or-nothing: hit refs roll
+    back, lanes return to the sentinel, and nothing leaks."""
+    m = _mgr(mesh8, batch=2, page=4, ppsd=8, slots=4)
+    m.stream_setup(prefix_cache=True)   # all 4 usable
+    pre = list(range(1, 9))             # 2 blocks
+    m.admit_row(0, pre, gen_budget=1)   # wait: 8 % 4 == 0 -> last block
+    m.register_prefix(0, pre)           # recomputed; 1 block indexed
+    before = m.block_audit()
+    with pytest.raises(RuntimeError, match="exhausted"):
+        m.admit_row(1, pre + list(range(31, 40)), gen_budget=8)
+    assert m.block_audit() == before
+    assert (m._table[:, 1, :] == m._sentinel[:, None]).all()
+    m.release_row(0)
+
+
+def test_commitment_blocks_starvation(mesh8):
+    """Admission control counts live rows' UNallocated decode tails:
+    a second request that would eat the first row's committed blocks
+    is refused until the first retires."""
+    m = _mgr(mesh8, batch=2, page=4, ppsd=8, slots=6)   # 6 usable
+    m.stream_setup(prefix_cache=False)
+    # Row 0: prompt 4 (1 block now) + budget 17 -> commits 4 more
+    # (positions 4..20 span blocks 1..5... ceil(20/4)=5 blocks total).
+    assert m.can_admit(4, 17)
+    m.admit_row(0, [1, 2, 3, 4], gen_budget=17)
+    assert int(m._committed[0]) == 4
+    assert not m.can_admit(4, 4)        # needs 2, only 1 uncommitted
+    assert m.can_admit(1, 1)            # needs 1 -> fits
+    # The committed row can always grow to its budget: G=17 decode
+    # steps write positions 4..19 (the last token is sampled from the
+    # step that writes position L+G-2).
+    for pos in range(4, 20):
+        m.ensure_position(0, pos)
+    assert int(m._committed[0]) == 0
+    m.release_row(0)
+    assert m.can_admit(4, 4)
+
+
+def test_fits_pool_and_never_admissible(mesh8):
+    m = _mgr(mesh8, batch=2, page=4, ppsd=8, slots=2)   # 2 usable
+    m.stream_setup(prefix_cache=True)
+    assert m.fits_pool(4, 4)            # 2 blocks
+    assert not m.fits_pool(8, 4)        # 3 blocks > 2 usable
+    assert m.can_admit(4, 4)
+
+
+def test_full_capacity_request_fits(mesh8):
+    """The sentinel must not steal request capacity: a request whose
+    worst case needs EVERY accounted slot on every device (batch=1
+    default-sized pool, prompt + gen == max_seq) is servable — the
+    sentinel page rides outside the pool."""
+    m = _mgr(mesh8, batch=1, page=4, ppsd=2, slots=2)   # default sizing
+    m.stream_setup(prefix_cache=True)   # max_seq = 4 * 2 * 8 = 64
+    assert m.fits_pool(32, 32)          # 2 blocks on every device
+    assert m.can_admit(32, 32)
+    m.admit_row(0, list(range(1, 33)), gen_budget=32)
+    for pos in range(32, 63):           # decode writes [L, L+G-1)
+        m.ensure_position(0, pos)
+    _check_invariants(m, [63])
+    m.release_row(0)
+    a = m.block_audit()
+    assert a["active"] == 0 and a["free"] + a["evictable"] == a["total"]
+
+
+def test_prefix_cache_hash_chain_semantics():
+    """Index-level contract: hashes chain (a prefix match is exact),
+    only full blocks hash, first writer wins, claim/release round-trip
+    keeps the LRU consistent."""
+    pc = PrefixCache(world=2, page_size=4)
+    a = pc.block_hashes([1, 2, 3, 4, 5, 6, 7, 8, 9])
+    b = pc.block_hashes([1, 2, 3, 4, 5, 6, 99])
+    assert len(a) == 2 and len(b) == 1      # partial tails don't hash
+    assert a[0] == b[0] and a[1] != b[0]
+    assert pc.probe(a) == 0
+    assert pc.register(a[0], 0, 3)
+    assert not pc.register(a[0], 0, 4)      # first writer wins (hash)
+    assert not pc.register(a[1], 0, 3)      # ... and slot
+    assert pc.probe(a) == 1 and pc.probe(b) == 1
+    assert pc.lookup(a) == [(0, 3)]
+    pc.release(0, 3)
+    assert pc.evictable_count(0) == 1
+    pc.claim(0, 3)
+    assert pc.evictable_count(0) == 0 and pc.probe(b) == 1
+    pc.release(0, 3)
+    assert pc.evict_lru(0) == 3
+    assert pc.probe(a) == 0 and pc.evict_lru(0) is None
+    assert pc.stats()["evictions"] == 1

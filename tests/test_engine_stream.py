@@ -126,8 +126,8 @@ def _solo_sp(model, params, prompt, gen_len):
 def test_stream_sp_and_paged(sp_model, paged):
     """Continuous batching over the long-context engine families: the
     seq-sharded cache (per-row scatter through forward_sp) and the
-    vLLM-style paged pools (admission prefills straight into the
-    admitted row's pages; retired rows keep pages until replacement)."""
+    vLLM-style paged pools (block-granular admission prefills straight
+    into the admitted row's pages; retired rows release eagerly)."""
     model, params = sp_model
     prompts = [[1, 2, 3], [9, 8], [4, 5, 6, 7], [11], [23, 29]]
     gen_len = 5
@@ -143,12 +143,10 @@ def test_stream_sp_and_paged(sp_model, paged):
 def test_stream_paged_fewer_requests_than_rows(sp_model):
     """n_req < batch (advisor r3, medium): lanes that are NEVER admitted
     still run the per-row KV write each decode step through their
-    block-table lane. Before the fix those lanes held zeros — pointing
-    at slot 0, unowned only by the accident of stack pop order, and
-    aliasable by a live row under a tight (non-default) pool. Stream
-    start now pre-owns pages for EVERY lane, making the
-    frozen-writes-own-their-pages invariant structural; the lone
-    request must decode exactly as when served alone."""
+    block-table lane. Under block-granular admission (ISSUE 6) those
+    lanes point at the per-device SENTINEL block, so frozen writes are
+    structurally harmless; the lone request must decode exactly as
+    when served alone."""
     model, params = sp_model
     prompt = [4, 5, 6, 7]
     gen_len = 6

@@ -16,6 +16,13 @@ CPU-cheap. Covered here:
   batch_occupancy / ttft_ms / queue_wait_ms, and a trace dump from a
   loaded server shows admit/retire events interleaved;
 - the ``gen_len`` clamp echo + counter, and the client ``timeout=``.
+
+ISSUE 6 (paged-native scheduling + prefix caching) adds: greedy
+bit-exactness with the prefix cache on vs off (shared / partial / no
+overlap, uniform and ragged), oversubscribed pools running through the
+shared-batch path, prefix + block-pool metrics through the metrics
+command and tools/report.py, and an autouse leak audit asserting every
+paged engine's block pool is fully returned after each scenario.
 """
 
 import json
@@ -43,9 +50,68 @@ def tiny(mesh8, key):
     return model, model.init(key)
 
 
+@pytest.fixture()
+def paged_tiny(mesh8, key):
+    """xla-impl sp model on a (tp=1, sp=8) grid — the paged engine
+    family, cheap enough for the quick tier."""
+    from jax.sharding import Mesh
+    devs = [d for d in mesh8.devices.flat]
+    mesh = Mesh(np.array(devs).reshape(1, 8), ("tp", "sp"))
+    cfg = ModelConfig(hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=4,
+                      num_key_value_heads=2, head_dim=16, vocab_size=64,
+                      max_position_embeddings=64, dtype=jnp.float32)
+    model = DenseLLM(cfg, mesh=mesh, axis="tp", sp_axis="sp",
+                     impl="xla", fwd_mode="sp")
+    return model, model.init(key)
+
+
+#: Paged engines created this test session — the leak-audit fixture
+#: below checks every one of them after each scenario.
+_PAGED_ENGINES: list = []
+
+
+@pytest.fixture(autouse=True)
+def _block_pool_leak_audit():
+    """ISSUE 6 satellite: after EVERY scenario in this file, each paged
+    engine's block pool must be back to fully-returned state — zero
+    active blocks, zero outstanding commitment, free + evictable
+    covering the whole pool. A retired (or
+    stop()-killed) request that strands blocks is a slow production
+    OOM."""
+    _PAGED_ENGINES.clear()
+    yield
+    for eng in _PAGED_ENGINES:
+        a = eng.kv.block_audit()
+        assert a["active"] == 0 and a["committed"] == 0, a
+        assert a["free"] + a["evictable"] == a["total"], a
+    _PAGED_ENGINES.clear()
+
+
 def _engine(model, batch=2, max_seq=64):
     return Engine(model, batch=batch, max_seq=max_seq,
                   prefill_mode="xla_ar", decode_mode="gemm_ar")
+
+
+def _paged_engine(model, batch=2, max_seq=64, page=4, slots=None,
+                  prefix=True):
+    eng = Engine(model, batch=batch, max_seq=max_seq,
+                 prefill_mode="sp", decode_mode="sp", paged=True,
+                 page_size=page, prefix_cache=prefix,
+                 kv_slots_per_dev=slots)
+    _PAGED_ENGINES.append(eng)
+    return eng
+
+
+def _solo_paged_golden(model, params, prompt, gen_len):
+    """Golden for the sp-paged family: the plain tp engine on the same
+    params (token-equal across families; accepts prompt lengths that
+    don't divide the sp world)."""
+    eng = Engine(model, batch=1, max_seq=64, prefill_mode="xla",
+                 decode_mode="xla_ar")
+    out = np.asarray(eng.serve(params, jnp.asarray([prompt], jnp.int32),
+                               gen_len))[0].tolist()
+    return out[len(prompt):]
 
 
 def _solo(model, params, prompt, gen_len, stop=()):
@@ -419,23 +485,166 @@ def test_oversized_batch_is_not_retryable_queue_full(tiny):
         srv.stop()
 
 
-def test_oversubscribed_paged_pool_falls_back_to_serialized():
-    """Auto-detect must NOT enable the scheduler for a paged engine
-    whose pool can't pre-allocate every lane (legal for plain serve();
-    a stream session would die at pump startup and brick generation —
-    review finding). Explicit scheduler=True still fails loudly."""
-    class _KV:
-        batch, max_seq = 2, 16
-        slots_per_dev, pages_per_seq_dev = 2, 2   # needs 4, has 2
+# ---------------------------------------------------------------------------
+# Paged-native scheduling + cross-request prefix caching (ISSUE 6).
+# ---------------------------------------------------------------------------
 
-    class _Eng:
-        kv = _KV()
-        use_mega = False
-        paged = True
+def test_paged_prefix_cache_bit_exact(paged_tiny):
+    """Tentpole acceptance: greedy outputs are bit-identical with the
+    prefix cache enabled vs disabled, across shared-, partial-, and
+    no-overlap prompts of mixed (ragged) lengths — and both match the
+    solo golden, so they can't be identically wrong."""
+    model, params = paged_tiny
+    pre = list(range(1, 9))                 # 8 tokens = 2 full pages
+    prompts = [pre + [20],                  # full shared prefix
+               pre + [30, 31],              # ... ragged length
+               pre[:4] + [40, 41],          # partial overlap (1 page)
+               [50, 51, 52],                # no overlap
+               pre + [60]]                  # another full hit
+    outs = {}
+    for flag in (True, False):
+        sched = Scheduler(_paged_engine(model, prefix=flag),
+                          params).start()
+        try:
+            reqs = [sched.submit(p, 5) for p in prompts]
+            outs[flag] = [r.result(timeout=180) for r in reqs]
+        finally:
+            sched.stop()
+    assert outs[True] == outs[False]
+    for p, row in zip(prompts, outs[True]):
+        assert row == _solo_paged_golden(model, params, p, 5), p
 
-    srv = ModelServer(_Eng(), None, port=0).start()
+
+def test_paged_prefix_cache_uniform_prompts_bit_exact(paged_tiny):
+    """Same acceptance, uniform lengths: every prompt shares the full
+    preamble and the warm admissions demonstrably skipped prefill."""
+    model, params = paged_tiny
+    pre = list(range(3, 11))
+    prompts = [pre + [t] for t in (21, 22, 23, 24)]
+    eng = _paged_engine(model, prefix=True)
+    sched = Scheduler(eng, params).start()
     try:
-        assert srv.scheduler is None
+        reqs = [sched.submit(p, 4) for p in prompts]
+        got = [r.result(timeout=180) for r in reqs]
+    finally:
+        sched.stop()
+    for p, row in zip(prompts, got):
+        assert row == _solo_paged_golden(model, params, p, 4), p
+    st = eng.kv.prefix.stats()
+    assert st["hit_blocks"] >= 6, st     # requests 2..4 each hit 2 blocks
+
+
+def test_oversubscribed_pool_runs_shared_batch(paged_tiny):
+    """ISSUE 6 acceptance: a paged engine whose pool CANNOT hold every
+    row (the engine the old auto-detect sent to the serialized lock)
+    runs through the shared-batch scheduler path — more concurrent
+    requests than whole-row capacity, correct results, no fallback."""
+    model, params = paged_tiny
+    # batch=3 rows x 2 blocks/dev whole-row = 6; the pool has 5 slots
+    # (all usable — the sentinel page rides outside the pool) ->
+    # whole-row streaming could hold at most 2 lanes and the OLD
+    # session refused to start at all.
+    eng = _paged_engine(model, batch=3, slots=5)
+    srv = ModelServer(eng, params, port=0).start()
+    try:
+        assert srv.scheduler is not None   # auto-detect: no fallback
+        prompts = [[2 * i + 1, 2 * i + 2] for i in range(5)]
+        outs = fanout(srv.host, srv.port,
+                      [{"prompt_ids": [p], "gen_len": 6}
+                       for p in prompts], timeout=180)
+        for p, o in zip(prompts, outs):
+            assert o["tokens"][0] == _solo_paged_golden(
+                model, params, p, 6), (p, o)
+        c = ChatClient(srv.host, srv.port, timeout=180)
+        m = c.request({"cmd": "metrics"})["metrics"]
+        c.close()
+        assert m["counters"]["serving.admitted"] >= 5
+        # block-pool occupancy gauges ride the same snapshot
+        assert "kv.blocks_free" in m["gauges"]
+        assert "kv.blocks_active" in m["gauges"]
+    finally:
+        srv.stop()
+
+
+def test_oversubscribed_requests_wait_not_die(paged_tiny):
+    """Block-granular backpressure: when the pool is too tight for two
+    concurrent generations, the second request WAITS for the first
+    row's eager block release instead of failing — and a request that
+    could never fit fails fast as a non-retryable error."""
+    model, params = paged_tiny
+    eng = _paged_engine(model, batch=2, slots=1)  # 1 block/dev
+    sched = Scheduler(eng, params).start()
+    try:
+        # Each needs 1 block on device 0 -> strictly one at a time.
+        reqs = [sched.submit([7 + i, 8], 2) for i in range(3)]
+        for i, r in enumerate(reqs):
+            got = r.result(timeout=180)
+            assert got == _solo_paged_golden(model, params,
+                                             [7 + i, 8], 2)
+        with pytest.raises(ValueError, match="never fit"):
+            sched.submit([1, 2, 3, 4, 5], 4)   # 2 blocks on device 0
+    finally:
+        sched.stop()
+
+
+def test_admission_upload_failure_releases_blocks(paged_tiny,
+                                                  monkeypatch):
+    """A failure in the block-table device upload during paged
+    admission must release the row's just-allocated blocks and leave
+    the lane clean for the next admission (review regression: the
+    upload sat OUTSIDE _admit_paged's rollback window, so it stranded
+    the blocks and every later admission into that row tripped the
+    already-holds-blocks assert)."""
+    model, params = paged_tiny
+    eng = _paged_engine(model, batch=2)
+    sched = Scheduler(eng, params).start()
+    try:
+        # Warm: session construction + one clean admission/retire
+        # cycle consume their block_table() calls before we arm.
+        golden = _solo_paged_golden(model, params, [1, 2, 3], 2)
+        assert sched.submit([1, 2, 3], 2).result(timeout=180) == golden
+        orig, armed = eng.kv.block_table, {"left": 1}
+
+        def flaky():
+            if armed["left"]:
+                armed["left"] -= 1
+                raise RuntimeError("injected device upload failure")
+            return orig()
+
+        monkeypatch.setattr(eng.kv, "block_table", flaky)
+        with pytest.raises(RuntimeError, match="injected"):
+            sched.submit([1, 2, 3], 2).result(timeout=180)
+        # The degraded row's blocks came back: same prompt admits into
+        # the same lane and matches the golden (the autouse leak audit
+        # re-checks the pool after teardown).
+        assert sched.submit([1, 2, 3], 2).result(timeout=180) == golden
+    finally:
+        sched.stop()
+
+
+def test_paged_prefix_metrics_and_report(paged_tiny):
+    """ISSUE 6 acceptance: serving.prefix_hit_rate /
+    serving.prefill_tokens_saved and the kv.* block gauges are visible
+    through {"cmd": "metrics"} and render in tools/report.py."""
+    model, params = paged_tiny
+    eng = _paged_engine(model, batch=2)
+    srv = ModelServer(eng, params, port=0).start()
+    try:
+        pre = list(range(1, 9))
+        outs = fanout(srv.host, srv.port,
+                      [{"prompt_ids": [pre + [30 + i]], "gen_len": 3}
+                       for i in range(4)], timeout=180)
+        assert all("tokens" in o for o in outs), outs
+        c = ChatClient(srv.host, srv.port, timeout=180)
+        m = c.request({"cmd": "metrics"})["metrics"]
+        c.close()
+        assert m["counters"]["serving.prefill_tokens_saved"] >= 24
+        assert m["gauges"]["serving.prefix_hit_rate"] > 0
+        assert m["gauges"]["kv.blocks_cached"] >= 2  # preamble resident
+        from triton_dist_tpu.tools.report import render_telemetry
+        md = render_telemetry(m)
+        assert "kv block pool" in md and "kv.blocks_free" in md
+        assert "serving.prefix_hit_rate" in md
     finally:
         srv.stop()
 

@@ -363,24 +363,17 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         jnp.tile(jnp.arange(bq * n_pages, dtype=jnp.int32
                             ).reshape(1, bq, n_pages), (world, 1, 1)),
         P("tp"))
-    # Pin "direct" explicitly: the context default is now "gathered"
-    # (production must not wedge on the un-root-caused direct compile
-    # hang), but THIS case is the compile watchdog's LIVE CANARY — it
-    # re-enters the direct block-table kernel every smoke run, and the
-    # per-case watchdog turns a recurrence of the r5 hang into one
-    # TIMEOUT line + a known-bad record while the queue advances.
-    import dataclasses as _dc
-    fd_paged = _dc.replace(
-        create_flash_decode_context(mesh, "tp", interpret=interpret),
-        paged_variant="direct")
-    case("flash_decode/paged",
-         lambda: gqa_fwd_batch_decode_paged(
-             q, pool_k, pool_v, table,
-             jnp.int32(world * n_pages * page // 2), fd_paged))
-
-    # The default path: table-gather view + the proven dense tiled
-    # kernel (the production paged route).
-    fd_paged_g = _dc.replace(fd_paged, paged_variant="gathered")
+    # The production paged route: table-gather view + the proven dense
+    # tiled kernel (paged_variant="gathered", the context default).
+    # The former "flash_decode/paged" case — the DIRECT block-table
+    # kernel pinned as the compile watchdog's live canary — is RETIRED
+    # after wedging two rounds of smoke queues without producing a
+    # root cause; docs/resilience.md "Retired canary" has the full
+    # rationale. The direct kernel itself remains available as the
+    # TDT_PAGED_VARIANT="direct" opt-in, guarded by the known-bad
+    # cache like every other config.
+    fd_paged_g = create_flash_decode_context(mesh, "tp",
+                                             interpret=interpret)
     case("flash_decode/paged_gathered",
          lambda: gqa_fwd_batch_decode_paged(
              q, pool_k, pool_v, table,

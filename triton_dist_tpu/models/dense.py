@@ -236,10 +236,6 @@ class DenseLLM:
         # traced offset conservatively selects the chunked path.
         chunked = s > 1 and (isinstance(offset, jax.core.Tracer)
                              or int(offset) != 0)
-        if chunked:
-            assert block_table is None, (
-                "chunked sp prefill supports the linear seq-sharded "
-                "cache (paged prefill stages from position 0)")
         offset = jnp.asarray(offset, jnp.int32)
         # (B,) per-row offsets supported for decode (continuous
         # batching, Engine.serve_stream — same contract as the dense tp
@@ -308,6 +304,20 @@ class DenseLLM:
                         block_table, offset, ck.shape[1], spd)
                 ck = ck.at[g, ip].set(kc[:, 0])
                 cv = cv.at[g, ip].set(vc[:, 0])
+            elif chunked:
+                # Paged chunked prefill (prefix-cache suffix admission,
+                # ISSUE 6): scatter ONLY positions offset+[0, S) into
+                # the row's private pages — a full-table scatter here
+                # would zero the shared cached-prefix blocks out from
+                # under every other request referencing them.
+                from triton_dist_tpu.models.kv_cache import (
+                    PagedKVCacheManager)
+                spd = ck.shape[0] // self.mesh.shape[sp]
+                posn = offset + jnp.arange(s, dtype=jnp.int32)
+                g, ip = PagedKVCacheManager.position_to_slot(
+                    block_table, posn, ck.shape[1], spd)   # (S, B), (S,)
+                ck = ck.at[g, ip[:, None]].set(kc.swapaxes(0, 1))
+                cv = cv.at[g, ip[:, None]].set(vc.swapaxes(0, 1))
             else:
                 ck = self._paged_scatter(ck, kc, block_table,
                                          nestable_shard_map)
@@ -330,8 +340,25 @@ class DenseLLM:
                 # rotated KV is sliced to the world-aligned live prefix
                 # — a 512-token chunk at the front of a 64k cache must
                 # not ppermute 64k mostly-masked positions per layer.
-                ck_att, cv_att = ck, cv
-                if not isinstance(offset, jax.core.Tracer):
+                if block_table is not None:
+                    # Paged: reconstruct the contiguous per-row view —
+                    # shared prefix blocks and this chunk's fresh
+                    # writes land in one (B, T, Hkv, D) tensor; the
+                    # kv_len mask below hides positions past the live
+                    # length (gathered_view's docstring has the cost
+                    # story).
+                    from triton_dist_tpu.models.kv_cache import (
+                        PagedKVCacheManager)
+                    w = self.mesh.shape[sp]
+                    csh = P(None, sp, None, None)
+                    ck_att = constrain(PagedKVCacheManager.gathered_view(
+                        ck, block_table, w), csh)
+                    cv_att = constrain(PagedKVCacheManager.gathered_view(
+                        cv, block_table, w), csh)
+                else:
+                    ck_att, cv_att = ck, cv
+                if (block_table is None
+                        and not isinstance(offset, jax.core.Tracer)):
                     # Slice the cache to the live prefix, rounded up to
                     # a length sp_ag_attention accepts: a multiple of
                     # BOTH the cache shard size (so the slice lands on

@@ -69,10 +69,23 @@ class Engine:
                  profile_dir: str | None = None, profile_steps: int = 64,
                  paged: bool = False, page_size: int = 16,
                  prefill_chunk: int | None = None,
-                 use_mega: bool = False):
+                 use_mega: bool = False,
+                 prefix_cache: bool | None = None,
+                 kv_slots_per_dev: int | None = None):
         self.model = model
         c = model.config
         self.paged = paged
+        # Cross-request prefix caching (ISSUE 6; paged stream sessions
+        # only): full prompt blocks are indexed by token-hash chain and
+        # shared across requests, so a warm shared-prefix admission
+        # prefills only its suffix. Default on; TDT_PREFIX_CACHE=0 (or
+        # prefix_cache=False) opts out — greedy outputs are
+        # bit-identical either way (tests/test_scheduler.py).
+        if prefix_cache is None:
+            import os
+            prefix_cache = os.environ.get("TDT_PREFIX_CACHE",
+                                          "1").strip() != "0"
+        self.prefix_cache = bool(prefix_cache) and paged
         # use_mega: decode through the MegaQwen3 fused one-program step
         # (the task-graph megakernel analog) — measured 1.49x the plain
         # jitted decode step on chip (docs/perf.md "First chip
@@ -104,11 +117,17 @@ class Engine:
                 assert max_seq % (world * page_size) == 0, (
                     f"max_seq {max_seq} must divide into "
                     f"{world} devices x {page_size}-token pages")
+                # kv_slots_per_dev sizes the allocatable pool (default:
+                # whole-batch capacity; the sentinel page rides outside
+                # it). SMALLER pools are legal — oversubscription
+                # streams through block-granular admission; plain
+                # serve() still needs whole rows.
                 self.kv = PagedKVCacheManager(
                     c.num_hidden_layers, batch, page_size,
                     max_seq // (world * page_size),
                     c.num_key_value_heads, c.head_dim, mesh=model.mesh,
-                    axis=model.sp_axis, dtype=c.dtype)
+                    axis=model.sp_axis, dtype=c.dtype,
+                    slots_per_dev=kv_slots_per_dev)
             else:
                 self.kv = KVCacheManager(
                     c.num_hidden_layers, batch, max_seq,
@@ -142,6 +161,7 @@ class Engine:
         self._decode_step_stop = None
         self._stream_step = None
         self._admit = None
+        self._admit_prefix = None
         self._admit_chunk = None
         self._admit_finish = None
 
@@ -258,11 +278,11 @@ class Engine:
         self.kv.reset()
         table = None
         if self.paged:
-            # Admission control per serve() call: release the previous
-            # call's rows, then reserve this batch's pages atomically
-            # (rollback on exhaustion — csrc/kvpool alloc_many).
-            for row in self.kv.owned_rows():
-                self.kv.free_seq(row)
+            # Admission control per serve() call: reset the pool (a
+            # prior stream session may have left it block-granular),
+            # then reserve this batch's whole rows atomically (rollback
+            # on exhaustion — csrc/kvpool alloc_many).
+            self.kv.reset_pool()
             self.kv.alloc_many(range(b))
             table = self.kv.block_table()
         caches = self.kv.init()
@@ -462,6 +482,31 @@ class Engine:
             return first[0], pools
         return admit
 
+    def _build_admit_paged_prefix(self):
+        """Prefix-cache-hit admission: only the prompt SUFFIX runs.
+
+        The suffix's K/V scatter at absolute positions start+[0, S) and
+        the attention over the shared cached-prefix blocks both go
+        through the paged chunked-prefill path (dense.forward_sp: a
+        traced nonzero offset with S > 1). ``start``/``length`` are
+        traced, so jit compiles once per padded SUFFIX bucket — the pad
+        tail is causally invisible to the real positions and its
+        scattered pages sit beyond kv_len until decode overwrites
+        them (the standard pad-slot safety argument)."""
+        model, mode = self.model, self.prefill_mode
+
+        @jax.jit
+        def admit(params, pools, ids, start, length, table_row, key):
+            logits, pools = model.forward(params, ids, pools, start,
+                                          mode=mode,
+                                          block_table=table_row)
+            last = jax.lax.dynamic_slice_in_dim(logits, length - 1, 1,
+                                                axis=1)[:, 0]
+            first = sample_token(last, key, self.temperature,
+                                 self.top_k, self.top_p)
+            return first[0], pools
+        return admit
+
     def _build_admit_chunk(self):
         """One slice of a CHUNKED admission prefill: forward ``chunk``
         positions into the batch-1 scratch cache at ``offset`` (rope
@@ -533,15 +578,16 @@ class Engine:
             scratch prefill into the freed row's private lane;
           * sp (seq-sharded cache) — same, through ``forward_sp``'s
             per-row write/mask/rope path;
-          * sp + paged — every lane is page-backed for the whole
-            stream: stream start pre-allocates pages for ALL rows (so
-            lanes that are never admitted when n_req < batch still own
-            what they write into), and admission free+reallocs a row's
-            pages atomically before prefilling STRAIGHT into the pool
-            via its table slice; a retired row keeps its pages until
-            its replacement is admitted. Frozen-row writes therefore
-            always land in pages the row owns and can never corrupt
-            another sequence.
+          * sp + paged — BLOCK-granular (ISSUE 6): admission maps any
+            cached shared-prefix blocks into the row's lanes and
+            allocates private blocks for the rest of the prompt, the
+            table grows one block at a time as decode crosses page
+            boundaries, and retirement returns blocks to the pool
+            immediately. Unoccupied rows' lanes point at a per-device
+            SENTINEL block, so frozen-row writes are harmless by
+            construction; an oversubscribed pool simply admits fewer
+            rows at a time instead of refusing to stream
+            (docs/serving.md "Block-granular admission").
         """
         obs.counter("engine.serve_stream_calls").inc()
         b = self.kv.batch
@@ -555,6 +601,15 @@ class Engine:
         assert all(len(p) for p in prompts), "prompts must be non-empty"
         assert all(len(p) + gen_len <= self.kv.max_seq for p in prompts), \
             "prompt + gen_len must fit max_seq"
+        if self.paged:
+            # Rejecting a never-fitting request up front keeps the
+            # admission loop below deadlock-free: a queued head always
+            # becomes admissible once enough rows retire.
+            bad = [i for i, p in enumerate(prompts)
+                   if not self.kv.fits_pool(len(p), gen_len)]
+            assert not bad, (
+                f"prompts {bad} can never fit the block pool "
+                f"({self.kv.slots_per_dev} slots/device)")
 
         sess = self.stream_session(params)
         row_req = [None] * b                 # request id occupying a row
@@ -584,9 +639,15 @@ class Engine:
                 if next_req >= n_req:
                     return
                 while row_req[r] is None and next_req < n_req:
+                    if not sess.can_admit(len(prompts[next_req]),
+                                          gen_len):
+                        # Not enough blocks yet: FIFO order holds, the
+                        # head re-checks after the next retirement.
+                        return
                     rid = next_req
                     next_req += 1
-                    first = sess.prefill_into_row(r, prompts[rid])
+                    first = sess.prefill_into_row(r, prompts[rid],
+                                                  gen_budget=gen_len)
                     row_req[r] = rid
                     row_budget[r] = gen_len
                     generated[rid] = []
@@ -602,6 +663,9 @@ class Engine:
                 if row_req[r] is not None:
                     record(r, int(toks[r]))
             admit_free_rows()
+        assert all(r is not None for r in results), (
+            "stream ended with unserved prompts — admission stalled "
+            "with no live rows (block-pool accounting bug)")
         return results
 
     def serve_ragged(self, params, prompts, gen_len: int,
@@ -671,30 +735,15 @@ class StreamSession:
         engine.kv.reset()
         self.cur_table = None
         if engine.paged:
-            # Fail with a sizing message BEFORE touching the allocator:
-            # streaming pre-allocates every lane (see below), so an
-            # oversubscribed pool (legal for plain serve) would
-            # otherwise die mid-loop with a bare "device pool
-            # exhausted" (ADVICE r4-2).
-            need = b * engine.kv.pages_per_seq_dev
-            assert engine.kv.slots_per_dev >= need, (
-                f"a stream session pre-allocates pages for every batch "
-                f"row: pool has {engine.kv.slots_per_dev} slots/device, "
-                f"needs {need} (batch {b} x "
-                f"{engine.kv.pages_per_seq_dev} pages/seq/device). "
-                f"Construct the paged pool with full-batch capacity "
-                f"for streaming, or lower batch.")
-            for row in engine.kv.owned_rows():
-                engine.kv.free_seq(row)
-            # Every lane must own its pages from step 0: the decode step
-            # runs the per-row KV write for ALL rows (frozen rows
-            # included), and a lane that was never admitted would write
-            # through a zeroed table entry that aliases slot 0 of a live
-            # row (advisor r3, medium). Pre-owning all rows makes frozen
-            # writes land in pages nobody else holds; admission below
-            # then free+reallocs per row as before.
-            for row in range(b):
-                engine.kv.alloc_seq(row)
+            # Block-granular mode (ISSUE 6): no lane pre-allocation —
+            # the pool resets, every row's table lanes point at the
+            # per-device sentinel block (so the shared decode step's
+            # frozen-row writes are harmless by construction), and
+            # admission/decode/retirement move individual blocks. An
+            # oversubscribed pool streams fine: it just admits fewer
+            # rows at a time (docs/serving.md "Block-granular
+            # admission").
+            engine.kv.stream_setup(prefix_cache=engine.prefix_cache)
             self.cur_table = engine.kv.block_table()
         self.caches = engine.kv.init()
         if engine._stream_step is None:
@@ -705,6 +754,7 @@ class StreamSession:
         self.token = jnp.zeros((b,), jnp.int32)
         self.offsets = jnp.zeros((b,), jnp.int32)
         self.live = [False] * b
+        self._host_off = [0] * b     # host shadow of per-row offsets
         self._pending: dict[int, dict] = {}   # row → chunked-prefill state
 
     @property
@@ -716,8 +766,28 @@ class StreamSession:
         return [r for r in range(self.batch)
                 if not self.live[r] and r not in self._pending]
 
+    def can_admit(self, prompt_len: int, gen_len: int,
+                  extra=None) -> bool:
+        """Block-granular admission control (paged engines): enough
+        free/evictable blocks for this request's worst-case demand,
+        net of live rows' commitments and of ``extra`` (an
+        accumulated per-device demand for same-batch admissions not
+        yet executed). Non-paged sessions always admit."""
+        if not self.engine.paged:
+            return True
+        return self.engine.kv.can_admit(prompt_len, gen_len,
+                                        extra=extra)
+
+    def admission_need(self, prompt_len: int, gen_len: int):
+        """Per-device worst-case block demand (the ``extra`` operand
+        for :meth:`can_admit`); ``None`` for non-paged sessions."""
+        if not self.engine.paged:
+            return None
+        return self.engine.kv.need_per_dev(prompt_len, gen_len)
+
     # -- admission ---------------------------------------------------------
-    def prefill_into_row(self, row: int, prompt, chunk: int | None = None):
+    def prefill_into_row(self, row: int, prompt, chunk: int | None = None,
+                         gen_budget: int | None = None):
         """Admit ``prompt`` into free row ``row``.
 
         Whole-prompt (``chunk=None``): runs the admission prefill now
@@ -727,6 +797,13 @@ class StreamSession:
         the first token. Chunking applies to the non-paged, non-sp
         scratch-prefill path; other engine families fall back to the
         one-shot admission.
+
+        ``gen_budget`` (paged engines): the tokens this request may
+        still generate — the block-granular admission commits that many
+        future blocks so a later admission cannot starve this row
+        mid-decode. Both shipped drivers (serve_stream, the serving
+        scheduler) pass it; omitting it risks a mid-decode pool
+        exhaustion on a tight pool.
         """
         assert not self.live[row] and row not in self._pending, \
             f"row {row} is occupied"
@@ -737,34 +814,119 @@ class StreamSession:
                 and len(prompt) > chunk
                 and -(-len(prompt) // chunk) * chunk <= eng.kv.max_seq):
             return self._start_chunked(row, prompt, int(chunk))
-        return self._admit_whole(row, prompt)
+        return self._admit_whole(row, prompt, gen_budget=gen_budget)
 
-    def _admit_whole(self, row: int, prompt: list) -> int:
+    def _bucket(self, n: int) -> int:
+        """Power-of-two prompt bucket rounded up to an sp-world
+        multiple (sp prefill shards S over the sp axis)."""
+        lb = self.engine._bucket_len(n)
+        return -(-lb // self._sp_world) * self._sp_world
+
+    def _admit_whole(self, row: int, prompt: list,
+                     gen_budget: int | None = None) -> int:
         eng = self.engine
-        lb = eng._bucket_len(len(prompt))
-        lb = -(-lb // self._sp_world) * self._sp_world   # round UP to a
-        lb = min(lb, eng.kv.max_seq)                     # world multiple
-        padded = prompt + [0] * (lb - len(prompt))
         eng.key, sub = jax.random.split(eng.key)
-        ids = jnp.asarray([padded], jnp.int32)
         if eng.paged:
-            # Atomic row turnover: the retiree's pages are released
-            # and the newcomer's allocated in one place, so no frozen
-            # row ever writes through a table lane it no longer owns.
-            if row in eng.kv.owned_rows():
-                eng.kv.free_seq(row)
-            eng.kv.alloc_seq(row)
-            self.cur_table = eng.kv.block_table()
-            first, self.caches = eng._admit(
-                self.params, self.caches, ids, jnp.int32(len(prompt)),
-                self.cur_table[:, row:row + 1], sub)
-        else:
-            first, self.caches = eng._admit(
-                self.params, self.caches, ids, jnp.int32(len(prompt)),
-                jnp.int32(row), sub)
+            return self._admit_paged(row, prompt, gen_budget, sub)
+        lb = min(self._bucket(len(prompt)), eng.kv.max_seq)
+        padded = prompt + [0] * (lb - len(prompt))
+        ids = jnp.asarray([padded], jnp.int32)
+        first, self.caches = eng._admit(
+            self.params, self.caches, ids, jnp.int32(len(prompt)),
+            jnp.int32(row), sub)
         self._mark_admitted(row, len(prompt))
         self.token = self.token.at[row].set(first)
         return int(first)
+
+    def _admit_paged(self, row: int, prompt: list,
+                     gen_budget: int | None, sub) -> int:
+        """Block-granular paged admission with cross-request prefix
+        reuse: map cached prefix blocks into the row's lanes, then run
+        only the SUFFIX through the prefill (the whole prompt when the
+        cache misses). Greedy outputs are bit-identical to a cold
+        prefill — the cached blocks hold exactly the K/V a cold prefill
+        of the same tokens would write."""
+        eng, kv = self.engine, self.engine.kv
+        L = len(prompt)
+        # Size the suffix program against the pool geometry BEFORE
+        # claiming hits: the padded suffix bucket scatters at absolute
+        # positions cached+[0, lb) and must not run off max_seq. Fewer
+        # hits → longer suffix but more room; k=0 (the cold path, lb
+        # clamped to max_seq) always fits.
+        hashes = kv.prefix_hashes(prompt)
+        k = kv.prefix_probe(prompt, hashes=hashes)
+        while k > 0:
+            if k * kv.page_size + self._bucket(L - k * kv.page_size) \
+                    <= kv.max_seq:
+                break
+            k -= 1
+        cached = kv.admit_row(row, prompt,
+                              gen_budget=int(gen_budget or 0),
+                              use_hits=k, hashes=hashes)
+        try:
+            # Inside the rollback window: the device upload itself can
+            # raise (device OOM), and a failure after admit_row must
+            # hand the row's blocks back like any program failure.
+            self.cur_table = kv.block_table()
+            if cached:
+                suffix = prompt[cached:]
+                lb = self._bucket(len(suffix))
+                ids = jnp.asarray([suffix + [0] * (lb - len(suffix))],
+                                  jnp.int32)
+                if eng._admit_prefix is None:
+                    eng._admit_prefix = eng._build_admit_paged_prefix()
+                first, self.caches = eng._admit_prefix(
+                    self.params, self.caches, ids, jnp.int32(cached),
+                    jnp.int32(len(suffix)),
+                    self.cur_table[:, row:row + 1], sub)
+            else:
+                lb = min(self._bucket(L), kv.max_seq)
+                ids = jnp.asarray([prompt + [0] * (lb - L)], jnp.int32)
+                first, self.caches = eng._admit(
+                    self.params, self.caches, ids, jnp.int32(L),
+                    self.cur_table[:, row:row + 1], sub)
+            # Materialize HERE: jit returns futures, so an async
+            # runtime failure (device OOM, comm error) would otherwise
+            # surface past the rollback window below and leave a
+            # zombie live row holding its blocks forever.
+            first = int(first)
+        except Exception:
+            # The program never ran to completion: hand the row's
+            # blocks straight back (a stranded allocation is a slow
+            # production OOM — the quick-tier leak audit's target).
+            kv.release_row(row)
+            self.cur_table = kv.block_table()
+            raise
+        kv.register_prefix(row, prompt, hashes=hashes)
+        self._note_prefix(row, L, cached)
+        self._mark_admitted(row, L)
+        self.token = self.token.at[row].set(first)
+        return first
+
+    def _note_prefix(self, row: int, prompt_len: int,
+                     cached: int) -> None:
+        """Prefix-cache telemetry for one admission
+        (docs/observability.md): tokens saved, block-weighted hit
+        rate, and a trace instant on the request's timeline."""
+        kv = self.engine.kv
+        if kv.prefix is None:
+            return
+        obs.counter("serving.prefill_tokens_saved").inc(cached)
+        hits = obs.counter("serving.prefix_hit_blocks")
+        hits.inc(cached // kv.page_size)
+        lookups = obs.counter("serving.prefix_lookup_blocks")
+        lookups.inc(kv.prefix_lookup_blocks(prompt_len))
+        # Gauge derived from the cumulative counters, NOT the
+        # session-local PrefixCache stats: a pump restart recreates the
+        # cache object empty, and the documented contract is the
+        # lifetime hit/lookup ratio of the sibling counters.
+        if lookups.value > 0:
+            obs.gauge("serving.prefix_hit_rate").set(
+                round(hits.value / lookups.value, 4))
+        if cached:
+            _trace.instant("serving.prefix_hit", "serving",
+                           args={"row": row, "prompt_len": prompt_len,
+                                 "cached_tokens": cached})
 
     def _start_chunked(self, row: int, prompt: list, chunk: int):
         eng = self.engine
@@ -815,6 +977,7 @@ class StreamSession:
         _trace.instant("engine.stream_admission", "engine",
                        args={"row": row, "prompt_len": prompt_len})
         self.offsets = self.offsets.at[row].set(prompt_len)
+        self._host_off[row] = prompt_len
         self.live[row] = True
 
     # -- decode / retire ---------------------------------------------------
@@ -823,6 +986,16 @@ class StreamSession:
         cache position, frozen rows re-emit their token. Returns the
         (batch,) token vector as numpy."""
         eng = self.engine
+        if eng.paged:
+            # Incremental block allocation: grow any live row whose
+            # NEXT write position crosses into an unallocated page —
+            # the admission commitment guarantees the block is there.
+            grew = False
+            for r in range(len(self.live)):
+                if self.live[r]:
+                    grew |= eng.kv.ensure_position(r, self._host_off[r])
+            if grew:
+                self.cur_table = eng.kv.block_table()
         done = jnp.asarray([not alive for alive in self.live])
         with obs.span("engine.stream_step"):
             eng.key, sub = jax.random.split(eng.key)
@@ -833,10 +1006,30 @@ class StreamSession:
                 # Real step latency, not the async enqueue (same
                 # observer cost as the serve() decode span).
                 jax.block_until_ready(self.token)
+        for r in range(len(self.live)):
+            if self.live[r]:
+                self._host_off[r] += 1
         return np.asarray(self.token)
 
     def retire_row(self, row: int) -> None:
         """Free a finished row; the next admission may reuse its lane
-        immediately (a paged retiree keeps its pages until the
-        replacement is admitted — atomic turnover)."""
+        immediately. Paged engines release the row's blocks EAGERLY —
+        shared prefix blocks drop a reference (refcount-zero indexed
+        blocks stay cached, LRU-evictable), private blocks return to
+        the free stack, and the row's lanes point back at the sentinel
+        so its frozen writes stay harmless."""
         self.live[row] = False
+        if self.engine.paged:
+            self.engine.kv.release_row(row)
+            self.cur_table = self.engine.kv.block_table()
+
+    def close(self) -> None:
+        """Release whatever the session still holds: every live (or
+        mid-prefill) row retires, returning its blocks to the pool.
+        Rows that already retired released eagerly — a block still
+        active for a retired row after close() is a leak the
+        quick-tier audit flags (tests/test_scheduler.py)."""
+        self._pending.clear()
+        for r in range(self.batch):
+            if self.live[r]:
+                self.retire_row(r)

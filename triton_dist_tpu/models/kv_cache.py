@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from triton_dist_tpu import obs
+
 
 class KVCacheManager:
     def __init__(self, num_layers: int, batch: int, max_seq: int,
@@ -69,7 +71,12 @@ class PagedKVCacheManager:
     megakernel attn task).
 
     Layout contract (matches gqa_fwd_batch_decode_paged):
-      pool_k/pool_v: (w*slots_per_dev, page_size, Hkv, D), dim 0 sharded.
+      pool_k/pool_v: (w*phys_slots_per_dev, page_size, Hkv, D), dim 0
+                     sharded. phys_slots_per_dev = slots_per_dev + 1:
+                     the last physical page per device is the reserved
+                     SENTINEL (stream sessions point unoccupied rows at
+                     it) and lives OUTSIDE the accounted pool, so the
+                     full slots_per_dev capacity stays allocatable.
       block_table:   (w, B, pages_per_seq_dev) int32, dim 0 sharded,
                      entries are device-LOCAL slot ids.
     """
@@ -93,7 +100,16 @@ class PagedKVCacheManager:
         self.dtype = dtype
         self.slots_per_dev = (slots_per_dev if slots_per_dev is not None
                               else batch * pages_per_seq_dev)
-        assert self.slots_per_dev >= pages_per_seq_dev, "pool too small"
+        # Pools SMALLER than one whole row are legal: block-granular
+        # stream sessions admit by blocks (ISSUE 6), and the
+        # seq-granular alloc path fails a too-big request gracefully
+        # ("device pool exhausted") rather than at construction.
+        assert self.slots_per_dev >= 1, "pool too small"
+        # The reserved sentinel page sits past the allocatable slots:
+        # physical pools carry one extra row per device that no free
+        # stack ever hands out, so pointing a frozen row at it costs
+        # zero request capacity.
+        self.phys_slots_per_dev = self.slots_per_dev + 1
         self.offset = 0
         # Host-side allocator state (numpy buffers shared verbatim with
         # the native allocator, csrc/kvpool/kvpool.cc): per-device free
@@ -109,6 +125,25 @@ class PagedKVCacheManager:
         self._owned = np.zeros((batch,), np.uint8)
         from triton_dist_tpu.models import kv_native
         self._lib = kv_native._load()
+        self._init_allocator()
+        # Block-granular serving substrate (stream sessions): populated
+        # by stream_setup(); the seq-granular API above never reads it.
+        self._blockwise = False
+        self.prefix = None           # PrefixCache when enabled
+        self._sentinel = None        # (w,) slot ids unowned rows point at
+        self._ref = np.zeros((w, slots), np.int32)
+        self._row_blocks = np.zeros((batch,), np.int32)
+        self._committed = np.zeros((w,), np.int64)
+        self._row_commit = np.zeros((batch, w), np.int64)
+        self._evicted_total = 0
+
+    def _init_allocator(self) -> None:
+        """(Re)initialize the free stacks + tables + ownership flags —
+        the constructor's allocator state, also the pool reset between
+        serving modes (seq-granular serve() vs block-granular stream
+        sessions must never inherit each other's stack state)."""
+        import numpy as np
+        w, slots = self.world, self.slots_per_dev
         ok = (self._lib is not None
               and self._lib.tdt_kv_init(w, slots, self._stack,
                                         self._top) == 0)
@@ -116,6 +151,8 @@ class PagedKVCacheManager:
             self._lib = None
             self._top[:] = slots
             self._stack[:] = np.arange(slots, dtype=np.int32)
+        self._table[:] = 0
+        self._owned[:] = 0
         self._table_dev = None  # device copy, invalidated on alloc/free
 
     def _args(self):
@@ -201,6 +238,340 @@ class PagedKVCacheManager:
         self._raise(rc, str(list(map(int, rows))))
         self._table_dev = None
 
+    # -- block-granular serving substrate (stream sessions, ISSUE 6) ------
+    # The seq-granular API above reserves whole max_seq rows (plain
+    # serve()'s admission unit). Stream sessions instead run the pool
+    # BLOCK-granular: a request is admitted when enough physical blocks
+    # are free for its prompt + decode budget, its table lanes grow one
+    # block at a time as decode crosses page boundaries, and its blocks
+    # return to the pool the moment it retires. Full prompt blocks are
+    # indexed in a cross-request prefix cache (models/prefix_cache.py):
+    # refcounted sharing for hits, LRU eviction of refcount-zero blocks
+    # when the free stacks run dry. One thread drives all of this (the
+    # stream-session contract), so no locking.
+
+    def reset_pool(self) -> None:
+        """Return the pool to the constructor state: every slot free,
+        tables zeroed, prefix index dropped, both serving modes clear.
+        serve() and stream_setup() both start from here — the two
+        admission disciplines must never inherit each other's stacks."""
+        self._init_allocator()
+        self._blockwise = False
+        self.prefix = None
+        self._sentinel = None
+        self._ref[:] = 0
+        self._row_blocks[:] = 0
+        self._committed[:] = 0
+        self._row_commit[:] = 0
+        self.offset = 0
+        self._emit_gauges()
+
+    def stream_setup(self, prefix_cache: bool = True) -> None:
+        """Reset the pool and enter block-granular mode.
+
+        Points every row's table lanes at the per-device SENTINEL page:
+        the shared decode step runs the per-row KV write for ALL rows
+        (frozen rows included), so an unoccupied row needs somewhere
+        harmless to write — the sentinel is that page (never read below
+        any live row's kv_len, never indexed, never allocatable). This
+        is what lets retired rows release their real blocks EAGERLY
+        instead of holding them until a replacement is admitted. The
+        sentinel is the reserved extra physical slot past the accounted
+        pool (slot id ``slots_per_dev``), so it costs no capacity: a
+        request needing every accounted slot still fits."""
+        import numpy as np
+        self.reset_pool()
+        self._blockwise = True
+        if prefix_cache:
+            from triton_dist_tpu.models.prefix_cache import PrefixCache
+            self.prefix = PrefixCache(self.world, self.page_size)
+        self._sentinel = np.full((self.world,), self.slots_per_dev,
+                                 np.int32)
+        for b in range(self.batch):
+            self._point_at_sentinel(b)
+        self._table_dev = None
+        self._emit_gauges()
+
+    def _point_at_sentinel(self, b: int) -> None:
+        self._table[:, b, :] = self._sentinel[:, None]
+
+    def _pop_block(self, r: int) -> int:
+        """One free block on device ``r``: the free stack first, then
+        LRU eviction of a refcount-zero cached block."""
+        if self._top[r] > 0:
+            self._top[r] -= 1
+            return int(self._stack[r, self._top[r]])
+        victim = (self.prefix.evict_lru(r)
+                  if self.prefix is not None else None)
+        if victim is None:
+            raise RuntimeError(f"device {r} pool exhausted")
+        self._evicted_total += 1
+        obs.counter("kv.blocks_evicted").inc()
+        return victim
+
+    def _push_block(self, r: int, slot: int) -> None:
+        self._stack[r, self._top[r]] = slot
+        self._top[r] += 1
+
+    def _deref(self, r: int, slot: int) -> None:
+        self._ref[r, slot] -= 1
+        assert self._ref[r, slot] >= 0, f"double free: dev {r} slot {slot}"
+        if self._ref[r, slot] == 0:
+            if self.prefix is not None and self.prefix.is_indexed(r, slot):
+                # Data stays resident for future hits; the block is now
+                # the MRU eviction candidate.
+                self.prefix.release(r, slot)
+            else:
+                self._push_block(r, slot)
+
+    # -- admission arithmetic ---------------------------------------------
+    def _block_lane(self, j: int):
+        """Logical block ``j`` of a row → (device r, table lane lp).
+        THE one spelling of the layout invariant — blocks stripe
+        contiguously, ``pages_per_seq_dev`` per device; every demand
+        tally below derives from it."""
+        return j // self.pages_per_seq_dev, j % self.pages_per_seq_dev
+
+    def _blocks_per_dev(self, j0: int, j1: int):
+        """Per-device count of logical blocks [j0, j1) under
+        ``_block_lane``'s striping."""
+        import numpy as np
+        out = np.zeros((self.world,), np.int64)
+        js = np.arange(j0, j1) // self.pages_per_seq_dev
+        if len(js):
+            out += np.bincount(js, minlength=self.world)
+        return out
+
+    def need_per_dev(self, prompt_len: int, gen_len: int):
+        """Worst-case block demand of one request, per device: blocks
+        covering every position it will ever WRITE — prefill writes
+        [0, L), decode steps write [L, L+G-1) (the budget's last token
+        is sampled from the step that writes position L+G-2)."""
+        last = max(prompt_len + max(gen_len, 1) - 1, prompt_len)
+        n = -(-last // self.page_size)
+        assert n <= self.pages_per_seq_dev * self.world, (
+            f"request spans {n} blocks > max_seq capacity "
+            f"(check prompt+gen_len <= max_seq first)")
+        return self._blocks_per_dev(0, n)
+
+    def available_per_dev(self):
+        """Free-stack depth plus evictable (refcount-zero cached)
+        blocks, per device — everything an admission could claim."""
+        import numpy as np
+        avail = self._top.astype(np.int64).copy()
+        if self.prefix is not None:
+            avail += np.asarray([self.prefix.evictable_count(r)
+                                 for r in range(self.world)], np.int64)
+        return avail
+
+    def fits_pool(self, prompt_len: int, gen_len: int) -> bool:
+        """Could this request EVER be admitted (empty pool)? False
+        means the submit must be rejected as unservable, not queued
+        (it would deadlock the admission queue). The sentinel lives
+        outside the accounted pool, so every slot counts."""
+        return bool((self.need_per_dev(prompt_len, gen_len)
+                     <= self.slots_per_dev).all())
+
+    def can_admit(self, prompt_len: int, gen_len: int,
+                  extra=None) -> bool:
+        """Admission control: enough blocks free (or evictable) on
+        every device for this request's worst-case demand, net of what
+        is already committed to live rows' un-allocated decode tails
+        (and of ``extra`` — same-batch admissions not yet executed).
+        Conservative: prefix-cache hits can only reduce the true
+        demand, never raise it."""
+        avail = self.available_per_dev() - self._committed
+        if extra is not None:
+            avail = avail - extra
+        return bool((avail >= self.need_per_dev(prompt_len,
+                                                gen_len)).all())
+
+    # -- request lifecycle -------------------------------------------------
+    def prefix_hashes(self, prompt) -> list | None:
+        """Full block-hash chain for ``prompt`` (``None`` without a
+        prefix cache). Admission walks the chain three times
+        (probe → admit → register); computing it once here and passing
+        it down keeps long-preamble admissions off the sha1 treadmill."""
+        if self.prefix is None:
+            return None
+        return self.prefix.block_hashes(prompt)
+
+    def prefix_lookup_blocks(self, prompt_len: int) -> int:
+        """Blocks eligible for a prefix-cache lookup: every FULL
+        prompt block except the last one of an exactly page-aligned
+        prompt, which is always recomputed (the admission program
+        needs the final position's logits). The single home of that
+        trim rule — probe, admit, and the obs lookup counter all
+        derive from it."""
+        n = prompt_len // self.page_size
+        if n and prompt_len % self.page_size == 0:
+            n -= 1
+        return n
+
+    def prefix_probe(self, prompt, hashes=None) -> int:
+        """Upper bound on cache-hit BLOCKS for ``prompt`` (stateless;
+        the engine sizes the suffix admission program off this before
+        committing to the hits)."""
+        if self.prefix is None:
+            return 0
+        if hashes is None:
+            hashes = self.prefix.block_hashes(prompt)
+        return self.prefix.probe(
+            hashes[:self.prefix_lookup_blocks(len(prompt))])
+
+    def admit_row(self, b: int, prompt, gen_budget: int = 0,
+                  use_hits: int | None = None, hashes=None) -> int:
+        """Block-granular admission of ``prompt`` into row ``b``:
+
+        1. map up to ``use_hits`` cached prefix blocks into the row's
+           lanes (refcounted, shared, read-only);
+        2. allocate private blocks for the rest of the prompt;
+        3. commit (without allocating) the decode-tail blocks the
+           ``gen_budget`` may still demand, so a later admission cannot
+           starve this row mid-decode.
+
+        All-or-nothing: on exhaustion every hit ref is rolled back and
+        the row's lanes return to the sentinel. Returns the number of
+        prefix TOKENS served from cache (a page multiple)."""
+        import numpy as np
+        assert self._blockwise, "admit_row needs stream_setup() first"
+        assert self._row_blocks[b] == 0, f"row {b} already holds blocks"
+        L = len(prompt)
+        page = self.page_size
+        hits, n_lookup = [], 0
+        if self.prefix is not None:
+            if hashes is None:
+                hashes = self.prefix.block_hashes(prompt)
+            hashes = hashes[:self.prefix_lookup_blocks(L)]
+            n_lookup = len(hashes)
+            hits = self.prefix.resolve(hashes, max_hits=use_hits)
+        k = len(hits)
+        n_prompt = -(-L // page)
+        last = max(L + max(gen_budget, 1) - 1, L)
+        n_total = max(n_prompt, -(-last // page))
+        # Map the hits FIRST (claiming them out of the evictable pool)
+        # so the availability check sees the exact post-hit state.
+        for j, (r, slot) in enumerate(hits):
+            rj, lp = self._block_lane(j)
+            assert r == rj, "prefix index device/layout mismatch"
+            if self._ref[r, slot] == 0:
+                self.prefix.claim(r, slot)
+            self._ref[r, slot] += 1
+            self._table[r, b, lp] = slot
+        need = self._blocks_per_dev(k, n_total)
+        avail = self.available_per_dev() - self._committed
+        if np.any(avail < need):
+            for j, (r, slot) in enumerate(hits):    # roll back
+                self._deref(r, slot)
+            self._point_at_sentinel(b)
+            self._table_dev = None
+            raise RuntimeError(
+                f"row {b}: device pool exhausted "
+                f"(short {int(np.max(need - avail))} block(s); "
+                f"{int(self._committed.sum())} committed to live rows)")
+        for j in range(k, n_prompt):
+            r, lp = self._block_lane(j)
+            slot = self._pop_block(r)
+            self._ref[r, slot] = 1
+            self._table[r, b, lp] = slot
+        tail = self._blocks_per_dev(n_prompt, n_total)
+        self._row_commit[b] = tail
+        self._committed += tail
+        self._row_blocks[b] = n_prompt
+        if self.prefix is not None:     # account only admissions that
+            self.prefix.account(n_lookup, k)    # actually succeeded
+        self._table_dev = None
+        self._emit_gauges()
+        return k * page
+
+    def ensure_position(self, b: int, pos: int) -> bool:
+        """Grow row ``b``'s allocation to cover write position ``pos``
+        (called before each decode step). Returns True when a new block
+        was allocated — the caller must refresh its device table."""
+        j = pos // self.page_size
+        n = int(self._row_blocks[b])
+        if j < n:
+            return False
+        assert j == n, (f"row {b}: position {pos} skips past block {n} "
+                        "(decode advances one position at a time)")
+        r, lp = self._block_lane(j)
+        slot = self._pop_block(r)
+        self._ref[r, slot] = 1
+        self._table[r, b, lp] = slot
+        self._row_blocks[b] = n + 1
+        if self._row_commit[b, r] > 0:   # consume this row's commitment
+            self._row_commit[b, r] -= 1
+            self._committed[r] -= 1
+        self._table_dev = None
+        self._emit_gauges()
+        return True
+
+    def release_row(self, b: int) -> None:
+        """Eager retirement: deref every block (shared blocks drop a
+        ref; indexed refcount-zero blocks stay cached and evictable;
+        private blocks return to the free stack), release the row's
+        remaining decode commitment, and point its lanes back at the
+        sentinel so frozen-row writes stay harmless."""
+        for j in range(int(self._row_blocks[b])):
+            r, lp = self._block_lane(j)
+            self._deref(r, int(self._table[r, b, lp]))
+        self._committed -= self._row_commit[b]
+        self._row_commit[b] = 0
+        self._row_blocks[b] = 0
+        self._point_at_sentinel(b)
+        self._table_dev = None
+        self._emit_gauges()
+
+    def register_prefix(self, b: int, tokens, hashes=None) -> int:
+        """Index row ``b``'s full PROMPT blocks in the prefix cache
+        (called once the admission prefill has been dispatched — the
+        pool arrays carrying the data are threaded through the session
+        caches, so a later hit reads exactly what was computed). The
+        partial tail block is mutable (decode writes it) and is never
+        indexed; full blocks are immutable for their pool lifetime —
+        the copy-on-write discipline with the copy statically
+        unreachable. Returns how many blocks were newly indexed."""
+        if self.prefix is None:
+            return 0
+        n_full = min(len(tokens) // self.page_size,
+                     int(self._row_blocks[b]))
+        if hashes is None:
+            hashes = self.prefix.block_hashes(tokens)
+        new = 0
+        for j in range(n_full):
+            r, lp = self._block_lane(j)
+            new += bool(self.prefix.register(
+                hashes[j], r, int(self._table[r, b, lp])))
+        return new
+
+    # -- introspection -----------------------------------------------------
+    def block_audit(self) -> dict:
+        """Pool accounting snapshot (the quick-tier leak audit: after
+        every request retires, free + evictable must equal the whole
+        pool — a stranded block is a slow OOM). The sentinel pages are
+        outside the accounted pool and never appear here."""
+        free = int(self._top.sum())
+        evictable = (sum(self.prefix.evictable_count(r)
+                         for r in range(self.world))
+                     if self.prefix is not None else 0)
+        total = self.world * self.slots_per_dev
+        return {"free": free, "evictable": evictable,
+                "active": total - free - evictable,
+                "committed": int(self._committed.sum()),
+                "evicted_total": self._evicted_total,
+                "total": total}
+
+    def _emit_gauges(self) -> None:
+        if not obs.enabled():
+            return
+        a = self.block_audit()
+        obs.gauge("kv.blocks_free").set(a["free"])
+        obs.gauge("kv.blocks_cached").set(a["evictable"])
+        obs.gauge("kv.blocks_active").set(a["active"])
+        if a["total"]:
+            obs.gauge("kv.block_utilization").set(
+                round(1.0 - (a["free"] + a["evictable"]) / a["total"], 4))
+
     def block_table(self) -> jax.Array:
         """Device copy of the (w, B, n_pages) table — pass this into
         jitted reads AND writes so table changes retrace instead of being
@@ -213,8 +584,11 @@ class PagedKVCacheManager:
 
     # -- device state -------------------------------------------------------
     def init(self):
-        """[(pool_k, pool_v)] * L, all slots zeroed."""
-        shape = (self.world * self.slots_per_dev, self.page_size,
+        """[(pool_k, pool_v)] * L, all slots zeroed. The +1 physical
+        slot per device is the reserved sentinel page; consumers derive
+        the slot stride from the array shape, never from
+        ``slots_per_dev``."""
+        shape = (self.world * self.phys_slots_per_dev, self.page_size,
                  self.num_kv_heads, self.head_dim)
         sh = NamedSharding(self.mesh, P(self.axis))
         z = jax.device_put(jnp.zeros(shape, self.dtype), sh)
@@ -248,6 +622,24 @@ class PagedKVCacheManager:
         return gslots, inpage
 
     @staticmethod
+    def gathered_view(pool: jax.Array, table: jax.Array, world: int):
+        """Contiguous (B, T, Hkv, D) view of one pooled layer via table
+        gathers — THE shared reconstruction consumed by both the
+        "gathered"/xla paged decode (ops/flash_decode.py) and the paged
+        chunked-prefill attention (dense.forward_sp), so the pool-gather
+        geometry cannot diverge between the read paths. Positions past
+        a row's live length resolve to sentinel/stale pages the callers'
+        kv_len masks never expose. Known cost: O(max_seq) gather, like
+        _paged_scatter's staging (optimization candidate). Callers apply
+        their own sharding constraint to the result."""
+        page_size = pool.shape[1]
+        t_total = page_size * table.shape[2] * world
+        posn = jnp.arange(t_total, dtype=jnp.int32)
+        g, ip = PagedKVCacheManager.position_to_slot(
+            table, posn, page_size, pool.shape[0] // world)
+        return pool[g, ip[:, None]].transpose(1, 0, 2, 3)
+
+    @staticmethod
     def position_to_slot_rows(table: jax.Array, offsets, page_size: int,
                               slots_per_dev: int):
         """PER-ROW positions → (global pool rows (B,), in-page rows (B,)).
@@ -276,7 +668,7 @@ class PagedKVCacheManager:
         """
         pool_k, pool_v = pools[layer]
         gslots, inpage = self.position_to_slot(
-            table, offset, self.page_size, self.slots_per_dev)
+            table, offset, self.page_size, self.phys_slots_per_dev)
         pool_k = pool_k.at[gslots, inpage].set(new_k.astype(pool_k.dtype))
         pool_v = pool_v.at[gslots, inpage].set(new_v.astype(pool_v.dtype))
         out = list(pools)

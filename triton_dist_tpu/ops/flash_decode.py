@@ -94,7 +94,8 @@ class FlashDecodeContext:
     # production paged server must not wedge by default. "direct" is
     # the opt-in — via this field or the TDT_PAGED_VARIANT env var,
     # which overrides the field so a deployment can flip paths without
-    # code changes — until the hang is fixed.
+    # code changes — until the hang is fixed. (Its smoke-queue canary
+    # is retired: docs/resilience.md "Retired canary".)
     paged_variant: str = "gathered"
 
     @property
@@ -564,12 +565,10 @@ def gqa_fwd_batch_decode_paged(q: jax.Array, pool_k: jax.Array,
         # sidesteps the direct kernel's block-table indirection (see
         # FlashDecodeContext.paged_variant).
         from triton_dist_tpu.models.kv_cache import PagedKVCacheManager
-        spd = pool_k.shape[0] // world
-        posn = jnp.arange(world * t_loc)
-        g, ip = PagedKVCacheManager.position_to_slot(
-            block_table, posn, page_size, spd)             # (T, B), (T,)
-        ck = pool_k[g, ip[:, None]].transpose(1, 0, 2, 3)  # (B, T, ...)
-        cv = pool_v[g, ip[:, None]].transpose(1, 0, 2, 3)
+        ck = PagedKVCacheManager.gathered_view(pool_k, block_table,
+                                               world)  # (B, T, ...)
+        cv = PagedKVCacheManager.gathered_view(pool_v, block_table,
+                                               world)
         sh = jax.sharding.NamedSharding(mesh, P(None, axis))
         return gqa_fwd_batch_decode(
             q, jax.lax.with_sharding_constraint(ck, sh),

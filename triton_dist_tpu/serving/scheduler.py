@@ -32,6 +32,14 @@ Design:
   pump iteration, interleaved with the shared decode step — so
   admitting a long prompt cannot stall the token cadence of the rows
   already decoding (``StreamSession.prefill_step``).
+- **Block-granular paged admission** (ISSUE 6). On paged engines the
+  head of the queue additionally waits for enough free KV BLOCKS for
+  its worst case (``StreamSession.can_admit``) — still strictly FIFO —
+  and passes its ``gen_len`` budget through so the pool commits the
+  decode tail. Oversubscribed pools therefore stream through the
+  shared batch instead of falling back to the serialized path; a
+  request that could never fit fails at ``submit()`` as ``ValueError``
+  (docs/serving.md "Block-granular admission").
 - **Observability** (docs/observability.md): ``serving.queue_depth``
   and ``serving.batch_occupancy`` gauges, per-request
   ``serving.ttft_ms`` and ``serving.queue_wait_ms`` histograms,
@@ -154,6 +162,18 @@ class Scheduler:
             raise ValueError(
                 f"prompt ({len(prompt)}) + gen_len ({gen_len}) must fit "
                 f"max_seq ({self.engine.kv.max_seq})")
+        if gen_len > 0 and getattr(self.engine, "paged", False):
+            # Never-fitting requests must fail HERE, not queue: the
+            # pump admits strictly FIFO, so an unadmittable head would
+            # deadlock everything behind it.
+            kv = self.engine.kv
+            if not kv.fits_pool(len(prompt), gen_len):
+                raise ValueError(
+                    f"prompt ({len(prompt)}) + gen_len ({gen_len}) can "
+                    f"never fit the block pool "
+                    f"({kv.slots_per_dev} slots/device, page "
+                    f"{kv.page_size}) — shrink the request or size the "
+                    f"pool up")
         if stop_tokens is None:
             eos = getattr(self.engine.model.config, "eos_token_id", -1)
             stop_set = {eos} if eos >= 0 else set()
@@ -275,6 +295,15 @@ class Scheduler:
             for req in leftovers + list(rows.values()):
                 self._fail(req, err)
             obs.gauge("serving.batch_occupancy").set(0)
+            sess, self._session = self._session, None
+            if sess is not None:
+                try:
+                    # Release what in-flight rows still hold (paged
+                    # block pools): a stop mid-generation must not
+                    # strand their blocks.
+                    sess.close()
+                except Exception:  # noqa: BLE001 — shutdown best-effort
+                    pass
         if exc is not None:
             # The waiters already carry the exception; re-raising from
             # a daemon thread would only add unhandled-thread noise.
@@ -320,7 +349,8 @@ class Scheduler:
             try:
                 with self._bind(req):
                     first = sess.prefill_into_row(
-                        row, req.prompt, chunk=self.prefill_chunk)
+                        row, req.prompt, chunk=self.prefill_chunk,
+                        gen_budget=req.gen_len)
             except Exception as e:  # noqa: BLE001 — degrade THIS request
                 sess.cancel_prefill(row)
                 obs.counter("serving.admit_errors").inc()
@@ -341,7 +371,23 @@ class Scheduler:
                 if not self._running:
                     break
                 free = sess.free_rows()
+                # Block-granular admission (paged engines): the head
+                # of the queue waits until enough blocks are free for
+                # its worst case — strictly FIFO, no skip-ahead.
+                # ``pending`` accumulates the demand of this batch's
+                # earlier admits (they run outside the lock, so the
+                # pool hasn't seen them yet).
+                pending = None
                 while self._queue and free:
+                    head = self._queue[0]
+                    if not sess.can_admit(len(head.prompt),
+                                          head.gen_len, extra=pending):
+                        break
+                    need = sess.admission_need(len(head.prompt),
+                                               head.gen_len)
+                    if need is not None:
+                        pending = need if pending is None \
+                            else pending + need
                     admits.append((free.pop(0), self._queue.popleft()))
                 obs.gauge("serving.queue_depth").set(len(self._queue))
             # Engine work happens OUTSIDE the lock: submitters only ever

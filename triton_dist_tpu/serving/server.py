@@ -155,17 +155,13 @@ class ModelServer:
         if scheduler is None:
             # Auto: on for engines a stream session can actually
             # serve. Test doubles without a kv and mega engines keep
-            # the serialized path — and so does a paged engine whose
-            # pool is oversubscribed (legal for plain serve(), but a
-            # stream session pre-allocates every lane and would die at
-            # pump startup, bricking generation entirely). Explicit
-            # scheduler=True still fails loudly for those.
-            kv = getattr(engine, "kv", None)
-            scheduler = (kv is not None
-                         and not getattr(engine, "use_mega", False)
-                         and not (getattr(engine, "paged", False)
-                                  and kv.slots_per_dev
-                                  < kv.batch * kv.pages_per_seq_dev))
+            # the serialized path. Oversubscribed paged pools are NOT
+            # an exception anymore: block-granular admission (ISSUE 6)
+            # streams them fine — the scheduler just admits fewer rows
+            # at a time. ``scheduler=False`` stays as the explicit
+            # serialized-path override.
+            scheduler = (getattr(engine, "kv", None) is not None
+                         and not getattr(engine, "use_mega", False))
         self.scheduler = None
         if scheduler:
             from triton_dist_tpu.serving.scheduler import Scheduler

@@ -114,6 +114,30 @@ def render_serving(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_kv(snap: dict) -> str:
+    """Summarize the paged block pool + prefix cache (``kv.*`` metrics,
+    docs/observability.md "KV block pool"): occupancy gauges
+    (free / cached / active / utilization) and the eviction counter.
+    The prefix-cache hit metrics live under ``serving.*`` and render in
+    that section. Empty string for processes without a paged pool."""
+    counters = {k: v for k, v in snap.get("counters", {}).items()
+                if k.startswith("kv.")}
+    gauges = {k: v for k, v in snap.get("gauges", {}).items()
+              if k.startswith("kv.")}
+    if not counters and not gauges:
+        return ""
+    lines = ["#### kv block pool", "| metric | value |", "|---|---|"]
+    for k in sorted(gauges):
+        v = gauges[k]
+        lines.append(f"| {k} | "
+                     f"{int(v) if float(v) == int(v) else round(v, 4)} |")
+    for k in sorted(counters):
+        v = counters[k]
+        lines.append(f"| {k} | "
+                     f"{int(v) if float(v) == int(v) else v} |")
+    return "\n".join(lines)
+
+
 def render_tracing(stats: dict | None) -> str:
     """Summarize the event-tracing / flight-recorder state
     (``obs.trace.stats()``, carried under the snapshot's ``trace`` key
@@ -143,13 +167,15 @@ def render_telemetry(snap: dict) -> str:
     lines = ["### telemetry"]
     resil = render_resilience(snap)
     serving = render_serving(snap)
+    kv = render_kv(snap)
     tracing = render_tracing(snap.get("trace"))
     # trace.* gauges mirror what the tracing section already shows
     # (they exist for the Prometheus exposition path) — don't render
     # the same numbers twice when that section is present; ditto the
-    # serving.* metrics and their dedicated section.
+    # serving.* / kv.* metrics and their dedicated sections.
     skip = lambda k: (k.startswith("resilience.")  # noqa: E731
                       or (bool(serving) and k.startswith("serving."))
+                      or (bool(kv) and k.startswith("kv."))
                       or (bool(tracing) and k.startswith("trace.")))
     scalars = [("counter", k, v)
                for k, v in sorted(snap.get("counters", {}).items())
@@ -161,6 +187,8 @@ def render_telemetry(snap: dict) -> str:
         lines += [resil, ""]
     if serving:
         lines += [serving, ""]
+    if kv:
+        lines += [kv, ""]
     if tracing:
         lines += [tracing, ""]
     if scalars:
