@@ -258,11 +258,17 @@ def _run_parts_in_children(extras: dict) -> None:
                 # Each child carries its own process-local telemetry
                 # snapshot; the parent runs the same merge rank-0 would
                 # across hosts (counters/histograms add, gauges max)
-                # instead of letting the last child win.
+                # instead of letting the last child win. Sampled
+                # request waterfalls are metadata merge_snapshots
+                # drops — union them back by hand.
+                prev = extras.get("telemetry")
+                wf = {**((prev or {}).get("waterfalls") or {}),
+                      **(tel.get("waterfalls") or {})}
                 try:
                     from triton_dist_tpu.obs import merge_snapshots
-                    extras["telemetry"] = merge_snapshots(
-                        [extras.get("telemetry"), tel])
+                    extras["telemetry"] = merge_snapshots([prev, tel])
+                    if wf:
+                        extras["telemetry"]["waterfalls"] = wf
                 except Exception:  # noqa: BLE001 — telemetry is extra
                     # Keep what already accumulated over prior parts;
                     # only seed from this child when there is nothing.
@@ -901,6 +907,22 @@ def _scrape_metrics(host, port):
         c.close()
 
 
+def _sample_waterfall(host, port):
+    """Newest request's attribution waterfall (obs.attrib via
+    {"cmd": "request_stats"}), or None — best-effort bench color."""
+    from triton_dist_tpu.serving.client import ChatClient
+    try:
+        c = ChatClient(host, port)
+        try:
+            reqs = c.request({"cmd": "request_stats",
+                              "last": 1}).get("requests") or []
+            return reqs[0] if reqs else None
+        finally:
+            c.close()
+    except Exception:  # noqa: BLE001 — telemetry color, never the bench
+        return None
+
+
 def _hist_delta(before, after, name):
     """The timed window's own histogram: warmup requests share the
     process-global registry, and their cold-compile TTFTs would
@@ -917,9 +939,10 @@ def _hist_delta(before, after, name):
             "count": b["count"] - a["count"],
             "sum": b["sum"] - a["sum"],
             # The window's extrema are unknowable from cumulative
-            # snapshots; the lifetime max is the warmup's compile
-            # time — exactly what this delta excludes. None makes
-            # a +Inf-tail quantile report None (honest) instead.
+            # snapshots (the lifetime max is the warmup's compile
+            # time — exactly what this delta excludes); with max=None
+            # a +Inf-tail quantile clips to the top finite bucket
+            # edge (obs.histogram_quantile overflow handling).
             "min": None, "max": None}
 
 
@@ -993,6 +1016,11 @@ def _bench_serving(mesh, n, on_tpu, extras):
             # scheduling win this probe is pricing).
             fanout(srv.host, srv.port,
                    [dict(r, gen_len=2) for r in reqs])
+            if use_scheduler and srv.scheduler.slo is not None:
+                # Fresh rolling-window epoch: the windowed percentiles
+                # below must price the timed run, not the warmup's
+                # cold-compile TTFTs sharing the same 60s window.
+                srv.scheduler.slo.reset_windows()
             warm = scrape(srv.host, srv.port) if use_scheduler else None
             t0 = time.perf_counter()
             outs = fanout(srv.host, srv.port, reqs)
@@ -1000,13 +1028,25 @@ def _bench_serving(mesh, n, on_tpu, extras):
             toks = sum(len(o["tokens"][0]) for o in outs
                        if "tokens" in o)
             errors = [o for o in outs if "tokens" not in o]
+            # The metrics scrape forces a fresh SLO evaluation, so the
+            # serving.rolling.* gauges below are current as of the end
+            # of the timed window.
             snap = scrape(srv.host, srv.port) if use_scheduler else None
-            return toks / dt if dt > 0 else 0.0, errors, warm, snap
+            wf = None
+            if use_scheduler:
+                wf = _sample_waterfall(srv.host, srv.port)
+            return (toks / dt if dt > 0 else 0.0, errors, warm, snap,
+                    wf)
         finally:
             srv.stop()
 
-    tps_serial, err_s, _, _ = run(False)
-    tps_sched, err_c, warm, snap = run(True)
+    tps_serial, err_s, _, _, _ = run(False)
+    tps_sched, err_c, warm, snap, waterfall = run(True)
+    if waterfall:
+        # One sampled request's attribution waterfall rides inside
+        # extras.telemetry (where TTFT went: queue vs prefill vs
+        # decode) — tools/report.py renders it.
+        extras["serving_waterfall"] = waterfall
     extras["serving_clients"] = clients
     extras["serving_batch_rows"] = batch
     extras["serving_tokens_per_s"] = round(tps_sched, 2)
@@ -1028,6 +1068,23 @@ def _bench_serving(mesh, n, on_tpu, extras):
         p50 = histogram_quantile(qw, 0.50)
         extras["serving_queue_wait_p50_ms"] = (round(p50, 3) if p50
                                                else None)
+    # Rolling-WINDOW percentiles (obs.slo): the windows were reset
+    # after warmup and the timed run fits inside one TDT_SLO_WINDOW_S,
+    # so these are the timed run's own numbers — no warmup compiles,
+    # no process-lifetime dilution. The regress gate pins these keys
+    # (tools/bench_ops.py SERVING_ROLLING_KEYS) — unless the operator
+    # disabled the SLO engine, which the gate must see as an explicit
+    # opt-out, not a missing-metric failure.
+    from triton_dist_tpu.obs import slo as _slo
+    if not _slo.enabled():
+        extras["serving_rolling_disabled"] = True
+    else:
+        for m in ("ttft", "tpot"):
+            for tag in ("p50", "p99"):
+                v = (snap or {}).get("gauges", {}).get(
+                    f"serving.rolling.{m}_{tag}_ms")
+                extras[f"serving_rolling_{m}_{tag}_ms"] = (
+                    round(float(v), 3) if v is not None else None)
     return tps_sched, extras.get("serving_sched_vs_serial")
 
 
@@ -1110,7 +1167,8 @@ def _bench_prefix(mesh, n, on_tpu, extras):
             c.close()
             errors = [] if "tokens" in out else [out]
             snap = _scrape_metrics(srv.host, srv.port)
-            return dt, errors, warm, snap
+            wf = _sample_waterfall(srv.host, srv.port)
+            return dt, errors, warm, snap, wf
         finally:
             srv.stop()
 
@@ -1119,8 +1177,13 @@ def _bench_prefix(mesh, n, on_tpu, extras):
         return (snap.get("counters", {}).get(key, 0)
                 - (warm or {}).get("counters", {}).get(key, 0))
 
-    dt_cold, err_cold, warm_c, snap_c = run(False)
-    dt_warm, err_warm, warm_w, snap_w = run(True)
+    dt_cold, err_cold, warm_c, snap_c, _ = run(False)
+    dt_warm, err_warm, warm_w, snap_w, wf_warm = run(True)
+    if wf_warm:
+        # A warm-cache admission's waterfall: prefill_ms prices only
+        # the suffix, cached_tokens shows the skipped preamble
+        # (rides inside extras.telemetry — tools/report.py).
+        extras["prefix_waterfall"] = wf_warm
     extras["serving_prefix_clients"] = clients
     extras["serving_prefix_preamble_tokens"] = preamble_len
     extras["serving_prefix_tokens_saved"] = int(saved_delta(warm_w,
@@ -1695,6 +1758,7 @@ def main():
             raise SystemExit(
                 f"unknown TDT_BENCH_ONLY entries {bad}; "
                 f"known: {[b[0] for b in benches]}")
+        wf_acc: dict = {}
         for name, fn in benches:
             if only and name not in only:
                 continue
@@ -1705,6 +1769,15 @@ def main():
             tel = obs.snapshot()
             if _trace.enabled():
                 tel["trace"] = _trace.stats()
+            for k in ("serving_waterfall", "prefix_waterfall"):
+                # Sampled request-attribution waterfalls live ONLY
+                # under extras.telemetry (report.py "request
+                # waterfalls") — extras itself stays a flat scalar
+                # map for the regress gate.
+                if k in extras:
+                    wf_acc[k] = extras.pop(k)
+            if wf_acc:
+                tel["waterfalls"] = dict(wf_acc)
             if any(tel.values()):
                 extras["telemetry"] = tel
             _checkpoint_extras(extras, name)

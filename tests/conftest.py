@@ -81,6 +81,19 @@ def _isolate_trace(monkeypatch, tmp_path):
 
 
 @pytest.fixture(autouse=True)
+def _isolate_observatory():
+    """The SLO observatory's process-local rings (request-attribution
+    waterfalls; the perfwatch sample windows reset through
+    resilience.reset_for_tests below) start empty for every test, so
+    one test's requests cannot leak into another's
+    ``request_stats``."""
+    from triton_dist_tpu.obs import attrib
+    attrib.reset()
+    yield
+    attrib.reset()
+
+
+@pytest.fixture(autouse=True)
 def _isolate_resilience(monkeypatch, tmp_path):
     """Point the resilience known-bad cache at a per-test temp file
     (never the developer's ~/.cache) and reset all process-local

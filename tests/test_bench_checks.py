@@ -334,3 +334,50 @@ def test_bench_parts_typo_fails_before_checkpoint(tmp_path, monkeypatch):
         mod.main()
     assert json.loads(progress.read_text())["extras"] == {
         "ag_gemm_tflops": 9.0}
+
+
+def test_check_serving_wellformed_requires_rolling_keys():
+    """ISSUE 8 satellite: --regress fails a serving bench run whose
+    extras lack rolling-window TTFT/TPOT percentiles."""
+    from triton_dist_tpu.tools import bench_ops
+    # Kernel-only runs pass untouched.
+    assert bench_ops.check_serving_wellformed({"ag_gemm_vs_xla": 1.0}) == []
+    ex = {"serving_tokens_per_s": 100.0,
+          "serving_rolling_ttft_p50_ms": 1.2,
+          "serving_rolling_ttft_p99_ms": 3.4,
+          "serving_rolling_tpot_p50_ms": 0.5,
+          "serving_rolling_tpot_p99_ms": 0.9}
+    assert bench_ops.check_serving_wellformed(ex) == []
+    bad = dict(ex)
+    bad["serving_rolling_tpot_p99_ms"] = None
+    del bad["serving_rolling_ttft_p50_ms"]
+    fails = bench_ops.check_serving_wellformed(bad)
+    assert len(fails) == 2
+    assert any("serving_rolling_ttft_p50_ms" in f for f in fails)
+    assert any("serving_rolling_tpot_p99_ms" in f for f in fails)
+    # The recorded TDT_SLO=0 opt-out is not a missing-metric failure.
+    assert bench_ops.check_serving_wellformed(
+        {"serving_tokens_per_s": 50.0,
+         "serving_rolling_disabled": True}) == []
+
+
+def test_regress_from_file_gates_serving_rolling(tmp_path):
+    """run_regress picks the wellformedness check up end to end."""
+    import json as _json
+    from triton_dist_tpu.tools import bench_ops
+    baseline = tmp_path / "BASELINE.json"
+    baseline.write_text(_json.dumps(
+        {"regression_floors": {"cpu": {}}}))
+    art = tmp_path / "bench.json"
+    art.write_text(_json.dumps(
+        {"extras": {"serving_tokens_per_s": 50.0}}))
+    rc = bench_ops.run_regress(str(baseline), str(art), "cpu")
+    assert rc == 1
+    ok = tmp_path / "bench_ok.json"
+    ok.write_text(_json.dumps({"extras": {
+        "serving_tokens_per_s": 50.0,
+        "serving_rolling_ttft_p50_ms": 1.0,
+        "serving_rolling_ttft_p99_ms": 2.0,
+        "serving_rolling_tpot_p50_ms": 0.3,
+        "serving_rolling_tpot_p99_ms": 0.6}}))
+    assert bench_ops.run_regress(str(baseline), str(ok), "cpu") == 0

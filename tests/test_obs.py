@@ -260,3 +260,53 @@ def test_vmem_limit_bytes_deprecation():
     assert common.VMEM_LIMIT_BYTES != vmem.DECLARED_FOOTPRINT_CAP
     with pytest.raises(AttributeError):
         vmem.NOPE
+
+
+def test_histogram_quantile_overflow_clips_to_top_edge():
+    """ISSUE 8 satellite: a quantile landing in the +Inf bucket of a
+    histogram with no recorded max (windowed deltas, rolling windows)
+    reports the top finite edge flagged clipped=True — not None."""
+    from triton_dist_tpu.obs import histogram_quantile
+    h = {"buckets": [1.0, 2.0, 4.0], "counts": [1, 0, 0, 9],
+         "count": 10, "sum": 100.0, "min": None, "max": None}
+    v, clipped = histogram_quantile(h, 0.99, detail=True)
+    assert v == 4.0 and clipped
+    assert histogram_quantile(h, 0.99) == 4.0     # default: value only
+    # A recorded max stays the honest (unclipped) overflow estimate.
+    h2 = dict(h, max=37.5)
+    v2, clipped2 = histogram_quantile(h2, 0.99, detail=True)
+    assert v2 == 37.5 and not clipped2
+    # Finite-bucket quantiles never flag.
+    v3, clipped3 = histogram_quantile(h, 0.05, detail=True)
+    assert v3 == pytest.approx(0.5) and not clipped3
+    # Empty histograms still report None.
+    assert histogram_quantile({"buckets": [1.0], "counts": [0, 0],
+                               "count": 0}, 0.5) is None
+
+
+def test_trace_stats_exports_drop_gauges():
+    """ISSUE 8 satellite: ring drops + per-ring high water surface as
+    obs gauges (not only inside trace.stats()), and report.py warns on
+    nonzero drops."""
+    from triton_dist_tpu.obs import trace
+    reg = obs.Registry()
+    obs.enable(reg)
+    try:
+        trace.enable(capacity=4)
+        for i in range(10):                 # 6 overwrites
+            trace.instant(f"e{i}", "op")
+        st = trace.stats()
+        assert st["dropped_total"] == 6
+        assert st["ring_high_water"] == 4
+        g = reg.snapshot()["gauges"]
+        assert g["trace.dropped_total"] == 6
+        assert g["trace.ring_high_water"] == 4
+        from triton_dist_tpu.tools.report import render_tracing
+        md = render_tracing(st)
+        assert "ring_high_water" in md
+        assert "TDT_TRACE_RING" in md and "⚠" in md
+        # No warning when nothing dropped.
+        assert "⚠" not in render_tracing(
+            {"events_total": 3, "dropped_total": 0})
+    finally:
+        obs.disable()

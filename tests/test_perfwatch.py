@@ -1,0 +1,266 @@
+"""Live perf-ratio watch (obs/perfwatch.py) + router integration
+(ISSUE 8).
+
+Quick tier, CPU-only: rolling-median arithmetic and the sample-count
+gate are pure Python; the routing tests force the BASELINE policy
+onto a temp floor table (``TDT_BASELINE_ROUTING=cpu`` +
+``TDT_BASELINE_PATH``, the PR-3 test hook) and assert the
+floor→live-median switchover through the
+``resilience.policy_source.{live,floor}`` counters — the ISSUE 8
+acceptance bar. The end-to-end test records real wall times through a
+``@resilient``-decorated op, fused branch deliberately slowed, and
+watches the router route it out once both branches cross
+``TDT_PERFWATCH_MIN_SAMPLES``.
+"""
+
+import json
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from triton_dist_tpu import obs
+from triton_dist_tpu.obs import perfwatch
+from triton_dist_tpu.resilience import router
+
+
+def _feed(op, bucket, fused_ms, xla_ms, n):
+    for _ in range(n):
+        perfwatch.record(op, "fused", bucket, fused_ms)
+        perfwatch.record(op, "xla", bucket, xla_ms)
+
+
+# ---------------------------------------------------------------------------
+# Rolling medians and the sample gate.
+# ---------------------------------------------------------------------------
+
+def test_ratio_needs_min_samples_on_both_branches(monkeypatch):
+    monkeypatch.setenv("TDT_PERFWATCH_MIN_SAMPLES", "4")
+    for _ in range(10):
+        perfwatch.record("t_op", "fused", "b0", 2.0)
+    assert perfwatch.ratio("t_op") is None        # no xla data at all
+    for _ in range(3):
+        perfwatch.record("t_op", "xla", "b0", 4.0)
+    assert perfwatch.ratio("t_op") is None        # 3 < 4
+    perfwatch.record("t_op", "xla", "b0", 4.0)
+    assert perfwatch.ratio("t_op") == pytest.approx(2.0)
+
+
+def test_ratio_is_median_of_per_bucket_ratios(monkeypatch):
+    monkeypatch.setenv("TDT_PERFWATCH_MIN_SAMPLES", "2")
+    _feed("t_op", "small", 1.0, 4.0, 3)           # ratio 4.0
+    _feed("t_op", "large", 10.0, 5.0, 3)          # ratio 0.5
+    _feed("t_op", "mid", 2.0, 4.0, 3)             # ratio 2.0
+    assert perfwatch.ratio("t_op") == pytest.approx(2.0)
+    assert perfwatch.ratio("t_op", bucket="large") == pytest.approx(0.5)
+    # An unqualified bucket (one thin branch) never skews the median.
+    perfwatch.record("t_op", "fused", "thin", 0.001)
+    assert perfwatch.ratio("t_op") == pytest.approx(2.0)
+
+
+def test_rolling_window_forgets_old_samples(monkeypatch):
+    monkeypatch.setenv("TDT_PERFWATCH_MIN_SAMPLES", "4")
+    _feed("t_op", "b0", 100.0, 1.0, 8)            # old regime: 0.01
+    assert perfwatch.ratio("t_op") < 0.9
+    # The deque holds DEFAULT_MAX_SAMPLES; a full window of new
+    # samples displaces the old regime entirely.
+    _feed("t_op", "b0", 1.0, 2.0, perfwatch.DEFAULT_MAX_SAMPLES)
+    assert perfwatch.ratio("t_op") == pytest.approx(2.0)
+
+
+def test_stats_and_gauge(monkeypatch):
+    monkeypatch.setenv("TDT_PERFWATCH_MIN_SAMPLES", "2")
+    reg = obs.Registry()
+    obs.enable(reg)
+    try:
+        _feed("t_op", "b0", 2.0, 4.0, 3)
+        st = perfwatch.stats()["t_op"]
+        assert st["live_ratio"] == pytest.approx(2.0)
+        assert st["fused_samples"] == 3 and st["xla_samples"] == 3
+        snap = reg.snapshot()
+        assert snap["gauges"][
+            "resilience.perfwatch.t_op.live_ratio"] == pytest.approx(2.0)
+        assert snap["counters"][
+            "resilience.perfwatch.samples.fused"] == 3
+    finally:
+        obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# Router policy: live median first, static floor fallback.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def cpu_policy(tmp_path, monkeypatch):
+    """Force BASELINE routing onto a controlled cpu floor table."""
+    floors = {"regression_floors": {"cpu": {
+        "parityop_vs_xla": 0.95,      # parity floor: stays fused
+        "slowop_vs_xla": 0.5,         # regression floor: routes to XLA
+        "t_probed_vs_xla": 0.5,       # routes to XLA (probe test)
+    }}}
+    path = tmp_path / "BASELINE.json"
+    path.write_text(json.dumps(floors))
+    monkeypatch.setenv("TDT_BASELINE_PATH", str(path))
+    monkeypatch.setenv("TDT_BASELINE_ROUTING", "cpu")
+    monkeypatch.setenv("TDT_PERFWATCH_MIN_SAMPLES", "4")
+    reg = obs.Registry()
+    obs.enable(reg)
+    yield reg
+    obs.disable()
+
+
+def _counters(reg):
+    return reg.snapshot()["counters"]
+
+
+def test_policy_switches_floor_to_live(cpu_policy):
+    reg = cpu_policy
+    # No live data: the static floor decides (parity → fused).
+    assert router.policy_reason("parityop") is None
+    c = _counters(reg)
+    assert c["resilience.policy_source.floor"] == 1
+    assert "resilience.policy_source.live" not in c
+    # Cross the sample gate with live data saying "clearly slower":
+    # the SAME op now routes out — the stale parity floor is overruled.
+    _feed("parityop", "b0", 10.0, 1.0, 4)         # live ratio 0.1
+    reason = router.policy_reason("parityop")
+    assert reason is not None and "live" in reason
+    c = _counters(reg)
+    assert c["resilience.policy_source.live"] == 1
+    assert c["resilience.parityop.policy_source.live"] == 1
+    assert c["resilience.policy_source.floor"] == 1   # unchanged
+    # decide() carries it through to the routing reason.
+    assert router.decide("parityop", "nokey") == "policy"
+
+
+def test_policy_live_rescues_floor_routed_op(cpu_policy):
+    # The floor says route out (0.5 < 0.9)...
+    assert router.policy_reason("slowop") is not None
+    # ...but fresh measurements prove the kernel is fine now: the op
+    # goes BACK to fused without a BASELINE redeploy.
+    _feed("slowop", "b0", 1.0, 2.0, 4)            # live ratio 2.0
+    assert router.policy_reason("slowop") is None
+    c = _counters(cpu_policy)
+    assert c["resilience.policy_source.live"] >= 1
+
+
+def test_policy_routing_opt_out(cpu_policy, monkeypatch):
+    monkeypatch.setenv("TDT_PERFWATCH_ROUTING", "0")
+    _feed("parityop", "b0", 10.0, 1.0, 4)         # live says slow...
+    # ...but routing is pinned to the floors: parity floor → fused.
+    assert router.policy_reason("parityop") is None
+    c = _counters(cpu_policy)
+    assert "resilience.policy_source.live" not in c
+    assert c["resilience.policy_source.floor"] == 1
+
+
+def test_reset_router_clears_perfwatch(monkeypatch):
+    monkeypatch.setenv("TDT_PERFWATCH_MIN_SAMPLES", "2")
+    _feed("t_op", "b0", 1.0, 2.0, 3)
+    assert perfwatch.ratio("t_op") is not None
+    router.reset_router()
+    assert perfwatch.ratio("t_op") is None
+
+
+@router.resilient("t_probed")
+def _probed_op(x, impl="pallas"):
+    return x * 2
+
+
+def test_policy_probe_keeps_fused_samples_fresh(cpu_policy, monkeypatch):
+    """Review hardening: live routing must not be one-way sticky.
+    Every Nth policy-routed call probes the fused branch (recording a
+    fresh fused sample), so a routed-out op keeps gathering the data
+    it needs to route back in."""
+    monkeypatch.setenv("TDT_PERFWATCH_PROBE_EVERY", "2")
+    monkeypatch.setenv("TDT_PERFWATCH_MIN_SAMPLES", "8")
+    x = jnp.ones((2, 2), jnp.float32)
+    for _ in range(4):                    # floor 0.5 → policy-routed
+        _probed_op(x, impl="pallas")
+    c = _counters(cpu_policy)
+    assert c["resilience.t_probed.policy_probes"] == 2
+    assert c["resilience.t_probed.fused_total"] == 2       # the probes
+    assert c["resilience.t_probed.fallback.policy"] == 2   # the rest
+    assert perfwatch.sample_count("t_probed", "fused") == 2
+    assert perfwatch.sample_count("t_probed", "xla") == 2
+    # With enough (here: hand-fed, deterministic) samples proving the
+    # fused branch healthy, the live median overrules the floor and
+    # the op is back on the fused path — the organic version of this
+    # is exactly what the probes feed.
+    _feed("t_probed", "m", 1.0, 2.0, 8)
+    assert router.policy_reason("t_probed") is None
+    # Probing honors the routing opt-out.
+    monkeypatch.setenv("TDT_PERFWATCH_ROUTING", "0")
+    perfwatch.reset()
+    for _ in range(4):
+        _probed_op(x, impl="pallas")
+    assert perfwatch.sample_count("t_probed", "fused") == 0
+
+
+def test_probe_never_runs_while_breaker_not_closed(cpu_policy,
+                                                   monkeypatch):
+    """decide() checks policy before the breaker, so a "policy" route
+    can mask a breaker opened over real infra failures — probes must
+    not re-enter the failing fused branch (nor steal the half-open
+    slot)."""
+    monkeypatch.setenv("TDT_PERFWATCH_PROBE_EVERY", "2")
+    from triton_dist_tpu.resilience.breaker import OPEN, get_breaker
+    br = get_breaker("t_probed")
+    for _ in range(10):                   # past any threshold
+        br.record_failure()
+    assert br.state == OPEN
+    x = jnp.ones((2, 2), jnp.float32)
+    for _ in range(6):                    # floor 0.5 → policy-routed
+        _probed_op(x, impl="pallas")
+    c = _counters(cpu_policy)
+    assert "resilience.t_probed.policy_probes" not in c
+    assert "resilience.t_probed.fused_total" not in c
+    assert perfwatch.sample_count("t_probed", "fused") == 0
+    assert c["resilience.t_probed.fallback.policy"] == 6
+
+
+# ---------------------------------------------------------------------------
+# End to end through @resilient: measured wall times switch the route.
+# ---------------------------------------------------------------------------
+
+@router.resilient("t_slowfused")
+def _slow_fused_op(x, impl="pallas"):
+    if impl == "pallas":
+        time.sleep(0.005)                 # the fused branch is slower
+    return x + 1
+
+
+def test_resilient_entries_record_and_reroute(cpu_policy, tmp_path,
+                                              monkeypatch):
+    """The acceptance scenario: @resilient entries record their own
+    wall times; once both branches cross TDT_PERFWATCH_MIN_SAMPLES the
+    router's next decision comes from the live median (policy_source
+    counters prove the switch) and the slow fused branch routes to
+    XLA."""
+    floors = {"regression_floors": {"cpu": {"t_slowfused_vs_xla": 0.95}}}
+    path = tmp_path / "B2.json"
+    path.write_text(json.dumps(floors))
+    monkeypatch.setenv("TDT_BASELINE_PATH", str(path))
+    router._BASELINE_CACHE.clear()
+    reg = cpu_policy
+    x = jnp.ones((4, 4), jnp.float32)
+    # Reference-branch calls (tests/bench are the xla sample source).
+    for _ in range(4):
+        _slow_fused_op(x, impl="xla")
+    assert perfwatch.sample_count("t_slowfused", "xla") == 4
+    # Fused calls: the parity floor keeps them fused while the live
+    # data is thin...
+    for _ in range(4):
+        _slow_fused_op(x, impl="pallas")
+    assert perfwatch.sample_count("t_slowfused", "fused") == 4
+    c = _counters(reg)
+    assert c["resilience.t_slowfused.policy_source.floor"] >= 1
+    assert c.get("resilience.t_slowfused.fallbacks_total", 0) == 0
+    # ...and the very next call consults the live median (~5 ms fused
+    # vs ~µs xla → clearly slower) and routes to the reference path.
+    _slow_fused_op(x, impl="pallas")
+    c = _counters(reg)
+    assert c["resilience.t_slowfused.policy_source.live"] >= 1
+    assert c["resilience.t_slowfused.fallback.policy"] == 1
+    # The routed call itself recorded another xla sample.
+    assert perfwatch.sample_count("t_slowfused", "xla") == 5

@@ -666,3 +666,193 @@ def test_server_serialized_path_still_works(tiny):
         c.close()
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: the serving SLO observatory through a live scheduler.
+# ---------------------------------------------------------------------------
+
+def test_response_timing_waterfall_sums_to_wall_time(tiny):
+    """Acceptance: the attribution waterfall's segments partition the
+    request's measured wall time — segment sum == total exactly (one
+    clock, by construction), total within 5 ms of the server-measured
+    latency (handler↔pump handoff is the only slack)."""
+    model, params = tiny
+    srv = ModelServer(_engine(model), params, port=0).start()
+    try:
+        c = ChatClient(srv.host, srv.port, timeout=180)
+        c.generate_ids([[1, 2, 3]], gen_len=3)       # warm compiles
+        r = c.generate_ids([[4, 5, 6]], gen_len=5)
+        c.close()
+        (t,) = r["timing"]
+        seg = t["segments"]
+        assert set(seg) == {"queue_wait_ms", "prefill_ms", "decode_ms"}
+        assert sum(seg.values()) == pytest.approx(t["total_ms"],
+                                                  abs=0.01)
+        assert abs(t["total_ms"] - r["latency_ms"]) < 5.0, (t, r)
+        assert t["tokens"] == len(r["tokens"][0]) == 5
+        assert t["prompt_tokens"] == 3
+        assert t["tpot_ms"] == pytest.approx(
+            seg["decode_ms"] / 4, abs=0.01)
+        assert t["trace_id"] == r["trace_id"]
+    finally:
+        srv.stop()
+
+
+def test_request_stats_ring_newest_first(tiny):
+    model, params = tiny
+    srv = ModelServer(_engine(model), params, port=0).start()
+    try:
+        c = ChatClient(srv.host, srv.port, timeout=180)
+        for i in range(3):
+            c.generate_ids([[1 + i, 2, 3]], gen_len=2)
+        stats = c.request({"cmd": "request_stats", "last": 2})
+        all_stats = c.request({"cmd": "request_stats"})
+        c.close()
+        assert len(stats["requests"]) == 2
+        assert len(all_stats["requests"]) == 3
+        rids = [r["rid"] for r in all_stats["requests"]]
+        assert rids == sorted(rids, reverse=True)    # newest first
+        for r in all_stats["requests"]:
+            assert sum(r["segments"].values()) == pytest.approx(
+                r["total_ms"], abs=0.01)
+    finally:
+        srv.stop()
+
+
+def test_waterfall_reports_prefix_savings(paged_tiny):
+    """A warm shared-prefix admission's waterfall shows the skipped
+    tokens (cached_tokens > 0) — the prefix-cache savings leg of the
+    attribution story."""
+    model, params = paged_tiny
+    eng = _paged_engine(model, batch=2)
+    srv = ModelServer(eng, params, port=0).start()
+    try:
+        pre = list(range(1, 9))                      # two full pages
+        c = ChatClient(srv.host, srv.port, timeout=180)
+        c.generate_ids([pre + [30]], gen_len=2)      # indexes preamble
+        r = c.generate_ids([pre + [31]], gen_len=2)  # warm hit
+        c.close()
+        (t,) = r["timing"]
+        assert t["cached_tokens"] >= 8, t
+        assert t["prompt_tokens"] == 9
+    finally:
+        srv.stop()
+
+
+def test_latency_regression_breaches_and_arms_recorder(tiny,
+                                                       monkeypatch):
+    """Acceptance: a latency regression (every TTFT 'violates' a
+    deliberately impossible threshold — the CPU-tier stand-in for a
+    fault-injected spike) drives a fast+slow burn breach through the
+    LIVE scheduler, arms the flight recorder exactly once, and the
+    dump validates as a Perfetto artifact."""
+    import json as _json
+    monkeypatch.setenv("TDT_SLO_TTFT_P99_MS", "0.001")
+    from triton_dist_tpu.obs import flight, trace
+    model, params = tiny
+    srv = ModelServer(_engine(model), params, port=0).start()
+    try:
+        assert trace.enabled()                       # server default
+        c = ChatClient(srv.host, srv.port, timeout=180)
+        before = c.request({"cmd": "metrics"})["metrics"]
+        b0 = before["counters"].get("serving.slo_breaches", 0)
+        # Enough violating requests to clear the slow-window sample
+        # floor (TDT_SLO_MIN_SAMPLES): a sustained regression, not a
+        # single-request blip (which must NOT page — see below).
+        outs = fanout(srv.host, srv.port,
+                      [{"prompt_ids": [[1 + i, 2, 3]], "gen_len": 3}
+                       for i in range(12)], timeout=180)
+        assert all("tokens" in o for o in outs), outs
+        # The metrics scrape forces a fresh evaluation.
+        m = c.request({"cmd": "metrics"})["metrics"]
+        assert m["counters"]["serving.slo_breaches"] == b0 + 1
+        assert m["gauges"]["serving.slo_breached.ttft_p99"] == 1
+        assert m["gauges"]["serving.slo_burn.ttft_p99"] > 1
+        rec = flight.last_record()
+        assert rec is not None and rec["reason"] == "slo_ttft_p99"
+        dumps0 = rec["count"]
+        # Sustained breach: another request + scrape, no second dump
+        # (transition-gated), no second breach count.
+        c.generate_ids([[4, 5, 6]], gen_len=3)
+        m2 = c.request({"cmd": "metrics"})["metrics"]
+        c.close()
+        assert m2["counters"]["serving.slo_breaches"] == b0 + 1
+        assert flight.last_record()["count"] == dumps0
+        with open(rec["path"]) as f:
+            chrome = _json.load(f)
+        from triton_dist_tpu.tools import trace_export
+        errors, _ = trace_export.validate(chrome)
+        assert errors == [], errors
+    finally:
+        srv.stop()
+
+
+def test_slo_no_false_positive_under_default_targets(tiny):
+    """Default (generous) targets must never breach on healthy
+    quick-tier traffic — the false-positive half of the acceptance
+    bar."""
+    model, params = tiny
+    srv = ModelServer(_engine(model), params, port=0).start()
+    try:
+        c = ChatClient(srv.host, srv.port, timeout=180)
+        b0 = c.request({"cmd": "metrics"})["metrics"]["counters"].get(
+            "serving.slo_breaches", 0)    # registry is process-global
+        outs = fanout(srv.host, srv.port,
+                      [{"prompt_ids": [[1 + i, 2]], "gen_len": 4}
+                       for i in range(4)], timeout=180)
+        assert all("tokens" in o for o in outs), outs
+        m = c.request({"cmd": "metrics"})["metrics"]
+        c.close()
+        assert m["counters"].get("serving.slo_breaches", 0) == b0
+        for k, v in m["gauges"].items():
+            if k.startswith("serving.slo_breached."):
+                assert v == 0, k
+    finally:
+        srv.stop()
+
+
+def test_metrics_catalog_wellformed(tiny, monkeypatch):
+    """CI satellite: every SLO/perfwatch metric in the documented
+    catalog appears in a live {"cmd": "metrics"} snapshot after real
+    traffic (+ a perfwatch sample/consult in the same process)."""
+    import json as _json
+    model, params = tiny
+    srv = ModelServer(_engine(model), params, port=0).start()
+    try:
+        # Real traffic populates every rolling window (tpot needs a
+        # multi-token request; pump/queue_wait/ttft come for free).
+        outs = fanout(srv.host, srv.port,
+                      [{"prompt_ids": [[1 + i, 2, 3]], "gen_len": 4}
+                       for i in range(3)], timeout=180)
+        assert all("tokens" in o for o in outs), outs
+        # Perfwatch metrics need samples + a policy consult: feed the
+        # process-shared watch and run one policy decision off a temp
+        # floor table (the PR-3 cpu-forcing test hook).
+        from triton_dist_tpu.obs import perfwatch, slo
+        from triton_dist_tpu.resilience import router
+        monkeypatch.setenv("TDT_PERFWATCH_MIN_SAMPLES", "2")
+        for _ in range(3):
+            perfwatch.record("catop", "fused", "b", 1.0)
+            perfwatch.record("catop", "xla", "b", 2.0)
+        floors = {"regression_floors": {"cpu": {"catop_vs_xla": 0.95}}}
+        import tempfile
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            _json.dump(floors, f)
+        monkeypatch.setenv("TDT_BASELINE_PATH", f.name)
+        monkeypatch.setenv("TDT_BASELINE_ROUTING", "cpu")
+        assert router.policy_reason("catop") is None   # live 2.0: fused
+        c = ChatClient(srv.host, srv.port, timeout=180)
+        m = c.request({"cmd": "metrics"})["metrics"]
+        c.close()
+        for name in slo.gauge_catalog():
+            assert name in m["gauges"], name
+        assert "serving.pump_iteration_ms" in m["histograms"]
+        assert ("resilience.perfwatch.catop.live_ratio"
+                in m["gauges"])
+        assert ("resilience.perfwatch.samples.fused"
+                in m["counters"])
+        assert ("resilience.policy_source.live" in m["counters"])
+    finally:
+        srv.stop()

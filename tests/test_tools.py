@@ -452,3 +452,43 @@ def test_trace_fallback_miss_warns_once(tmp_path, monkeypatch):
     msgs = [x for x in w if "baked" in str(x.message).lower()
             or "bakes" in str(x.message)]
     assert len(msgs) == 1
+
+
+def test_top_render_dashboard_sections():
+    """tools/top.py: the dashboard renders rolling SLOs, burn rates,
+    occupancy/pool, live op ratios, and request waterfalls from a
+    plain metrics snapshot (no server needed)."""
+    from triton_dist_tpu.tools import top
+    snap = {
+        "gauges": {
+            "serving.rolling.ttft_p50_ms": 12.5,
+            "serving.rolling.ttft_p99_ms": 80.0,
+            "serving.rolling.ttft_n": 42,
+            "serving.slo_burn.ttft_p99": 0.2,
+            "serving.slo_burn.ttft_p99_slow": 0.1,
+            "serving.slo_breached.ttft_p99": 0,
+            "serving.batch_occupancy": 3,
+            "serving.queue_depth": 1,
+            "kv.block_utilization": 0.75,
+            "resilience.perfwatch.ag_gemm.live_ratio": 1.2,
+            "trace.dropped_total": 7,
+        },
+        "counters": {"serving.admitted": 10, "serving.retired": 9},
+        "requests": [{"rid": 4, "total_ms": 20.0,
+                      "segments": {"queue_wait_ms": 1.0,
+                                   "prefill_ms": 9.0,
+                                   "decode_ms": 10.0},
+                      "tokens": 5, "cached_tokens": 2}],
+    }
+    out = top.render(snap)
+    assert "rolling latency" in out and "p50 12.500" in out
+    assert "slo burn rates" in out and "ttft_p99" in out
+    assert "BREACH" not in out
+    assert "block utilization" in out and "0.750" in out
+    assert "ag_gemm" in out and "1.200x" in out
+    assert "rid 4" in out and "prefill 9" in out
+    assert "TDT_TRACE_RING" in out
+    snap["gauges"]["serving.slo_breached.ttft_p99"] = 1
+    assert "BREACH" in top.render(snap)
+    assert "(no serving metrics yet)" in top.render(
+        {"gauges": {}, "counters": {}})

@@ -71,10 +71,16 @@ class Engine:
                  prefill_chunk: int | None = None,
                  use_mega: bool = False,
                  prefix_cache: bool | None = None,
-                 kv_slots_per_dev: int | None = None):
+                 kv_slots_per_dev: int | None = None,
+                 slo=None):
         self.model = model
         c = model.config
         self.paged = paged
+        # Declarative serving SLO targets (obs.slo.SLOTarget list) the
+        # scheduler's SLO tracker evaluates for this engine; None keeps
+        # the env-overridable defaults (docs/observability.md "SLOs
+        # and burn rates").
+        self.slo = slo
         # Cross-request prefix caching (ISSUE 6; paged stream sessions
         # only): full prompt blocks are indexed by token-hash chain and
         # shared across requests, so a warm shared-prefix admission
@@ -756,6 +762,12 @@ class StreamSession:
         self.live = [False] * b
         self._host_off = [0] * b     # host shadow of per-row offsets
         self._pending: dict[int, dict] = {}   # row → chunked-prefill state
+        #: Facts about the most recent completed admission (currently
+        #: the prefix-cached token count) — the scheduler reads this
+        #: right after prefill_into_row/prefill_step returns a first
+        #: token, for the request's latency-attribution waterfall
+        #: (obs.attrib).
+        self.admit_info: dict | None = None
 
     @property
     def batch(self) -> int:
@@ -834,6 +846,7 @@ class StreamSession:
         first, self.caches = eng._admit(
             self.params, self.caches, ids, jnp.int32(len(prompt)),
             jnp.int32(row), sub)
+        self.admit_info = {"cached": 0}
         self._mark_admitted(row, len(prompt))
         self.token = self.token.at[row].set(first)
         return int(first)
@@ -899,6 +912,7 @@ class StreamSession:
             raise
         kv.register_prefix(row, prompt, hashes=hashes)
         self._note_prefix(row, L, cached)
+        self.admit_info = {"cached": cached}
         self._mark_admitted(row, L)
         self.token = self.token.at[row].set(first)
         return first
@@ -963,6 +977,7 @@ class StreamSession:
         first, self.caches = eng._admit_finish(  # in the final chunk
             self.caches, st["small"], logits, jnp.int32(idx),
             jnp.int32(row), st["key"])
+        self.admit_info = {"cached": 0}
         self._mark_admitted(row, st["len"])
         self.token = self.token.at[row].set(first)
         return int(first)

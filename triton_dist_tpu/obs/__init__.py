@@ -14,6 +14,14 @@ recorder (``obs.flight``): the most recent event window dumps to disk
 on watchdog trips, breaker opens, serve-loop failures, SIGTERM, or an
 explicit ``{"cmd": "dump_trace"}``.
 
+The serving SLO observatory (ISSUE 8) sits on top: ``obs.slo`` keeps
+rolling-window percentiles + multi-window burn rates that arm the
+flight recorder on a latency-SLO breach, ``obs.perfwatch`` keeps live
+fused-vs-XLA wall-time medians the resilience router consults before
+its static BASELINE floors, and ``obs.attrib`` keeps per-request
+latency waterfalls (queue → prefill → decode) the server returns
+inline and ``tools/top.py`` renders live.
+
 Disabled by default at zero hot-path cost; flip metrics on with
 ``obs.enable()`` (the ModelServer does this at construction;
 ``TDT_TRACE=1`` makes that enable tracing too).
@@ -48,7 +56,12 @@ from triton_dist_tpu.obs.exposition import (  # noqa: F401
     merge_snapshots,
     render_prometheus,
 )
-from triton_dist_tpu.obs import flight, trace  # noqa: F401
+from triton_dist_tpu.obs import attrib, flight, perfwatch, slo, trace  # noqa: F401,E501
+from triton_dist_tpu.obs.slo import (  # noqa: F401
+    SLOTarget,
+    SLOTracker,
+    WindowedHistogram,
+)
 from triton_dist_tpu.obs.trace import (  # noqa: F401
     enabled as trace_enabled,
 )

@@ -24,34 +24,47 @@ __all__ = ["allgather_json", "histogram_quantile", "merge_snapshots",
            "render_prometheus", "aggregate_across_hosts"]
 
 
-def histogram_quantile(h: dict, q: float) -> float | None:
+def histogram_quantile(h: dict, q: float, detail: bool = False):
     """Estimate the ``q``-quantile of a snapshot histogram dict
     (fixed upper-bound ``buckets`` + per-bucket ``counts`` — the shape
     :meth:`Histogram.to_dict` emits) by linear interpolation inside
-    the containing bucket; the +Inf tail reports the recorded ``max``
-    (the only honest point estimate there). ``None`` on an empty or
+    the containing bucket. A quantile landing in the +Inf overflow
+    bucket reports the recorded ``max`` when the snapshot carries one,
+    and otherwise CLIPS to the top finite bucket edge — windowed
+    histogram deltas (bench.py) and rolling windows (``obs.slo``)
+    cannot know their extrema, and "at least the top edge" is a usable
+    lower bound where ``None`` used to hide the whole percentile.
+    ``detail=True`` returns ``(value, clipped)`` so callers can flag
+    the clip. ``None`` (or ``(None, False)``) only on an empty or
     malformed histogram. This is how bench.py turns the server's
     ``serving.ttft_ms`` histogram into p50/p99 without shipping raw
     samples."""
+    value, clipped = None, False
     counts = h.get("counts") or []
     buckets = h.get("buckets") or []
     total = h.get("count", 0)
-    if not total or not counts:
-        return None
-    target = q * total
-    cum = 0
-    lo = 0.0
-    for i, c in enumerate(counts):
-        cum += c
-        if cum >= target and c:
-            if i >= len(buckets):
-                return float(h["max"]) if h.get("max") is not None else None
-            hi = buckets[i]
-            frac = (target - (cum - c)) / c
-            return lo + (hi - lo) * frac
-        if i < len(buckets):
-            lo = buckets[i]
-    return float(h["max"]) if h.get("max") is not None else None
+    if total and counts:
+        target = q * total
+        cum = 0
+        lo = 0.0
+        in_overflow = True
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= target and c:
+                if i < len(buckets):
+                    hi = buckets[i]
+                    frac = (target - (cum - c)) / c
+                    value = lo + (hi - lo) * frac
+                    in_overflow = False
+                break
+            if i < len(buckets):
+                lo = buckets[i]
+        if in_overflow and buckets:
+            if h.get("max") is not None:
+                value = float(h["max"])
+            else:
+                value, clipped = float(buckets[-1]), True
+    return (value, clipped) if detail else value
 
 
 def allgather_json(obj) -> list:

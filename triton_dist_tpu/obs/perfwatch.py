@@ -1,0 +1,223 @@
+"""Live fused-vs-XLA perf-ratio watch: rolling medians per op.
+
+The resilience router's BASELINE policy (PR 3) routes "clearly slower"
+fused regimes to XLA using ``BASELINE.json`` floors — numbers measured
+in round 5 and frozen at deploy time. ROADMAP item 5 asks for the
+loop to close: *measured* ratios feeding routing so a chip run
+self-corrects a stale floor without a redeploy. This module is that
+feedback path: every ``@resilient`` op entry records the wall time of
+the branch it actually ran (``fused`` or ``xla``) per (op, branch,
+shape-bucket) into bounded rolling windows, and :func:`ratio` answers
+"what does the live data say fused-vs-XLA is *right now*" — the
+median of per-bucket ``median(xla) / median(fused)`` ratios across
+buckets where BOTH branches have at least ``TDT_PERFWATCH_MIN_SAMPLES``
+(default 32) samples. The router consults that live ratio FIRST and
+falls back to the static floor when the data is too thin
+(docs/resilience.md "Live ratios vs BASELINE floors");
+``TDT_PERFWATCH_ROUTING=0`` opts routing out while samples keep
+accumulating.
+
+Shape buckets are power-of-two-rounded shape signatures
+(``ops.common.shape_bucket``): close-enough shapes pool their samples
+(a serving process sees few distinct shapes but many calls), while a
+64× size difference can never launder one regime's ratio into
+another's.
+
+Recording is eager-only (trace-time "samples" under ``jax.jit`` are
+compile costs, not runtimes — the router already skips its guards for
+traced calls) and gated on telemetry being enabled; recorded calls are
+``block_until_ready``-materialized first so the sample is device time,
+not async-dispatch time (the same documented observer cost as the
+engine's decode spans).
+
+Metric surface: ``resilience.perfwatch.<op>.live_ratio`` gauge (once
+computable), ``resilience.perfwatch.samples.{fused,xla}`` counters,
+and the router-side ``resilience.policy_source.{live,floor}`` decision
+counters (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import statistics
+import threading
+
+from triton_dist_tpu.obs import registry as _registry
+
+__all__ = [
+    "BRANCHES", "DEFAULT_MAX_SAMPLES", "DEFAULT_MIN_SAMPLES",
+    "min_samples", "ratio", "record", "reset", "routing_enabled",
+    "sample_count", "stats",
+]
+
+BRANCHES = ("fused", "xla")
+
+#: Per-(op, branch, bucket) rolling-window length. Medians over 128
+#: samples shrug off one-off outliers (first-call compiles, GC pauses).
+DEFAULT_MAX_SAMPLES = 128
+
+#: Both branches of a bucket need this many samples before its ratio
+#: counts (``TDT_PERFWATCH_MIN_SAMPLES``): routing on thin live data
+#: would be worse than routing on the stale-but-measured floor.
+DEFAULT_MIN_SAMPLES = 32
+
+#: Every Nth policy-routed call runs the fused branch anyway (the
+#: policy-route analog of the breaker's half-open probe): without it a
+#: routed-out op never gathers fresh fused samples, its medians freeze,
+#: and live routing is one-way sticky — a transient slowdown would pin
+#: the op to XLA for the process lifetime. ``TDT_PERFWATCH_PROBE_EVERY``
+#: overrides; 0 disables probing.
+DEFAULT_PROBE_EVERY = 32
+
+_LOCK = threading.Lock()
+_SAMPLES: dict[tuple[str, str, str], collections.deque] = {}
+_PROBE_COUNT: dict[str, int] = {}
+#: Op-level ratio cache: recomputed lazily only when new samples
+#: arrived since the last consult, so the router's per-call policy
+#: check is a dict lookup, not a median pass. Keyed by min_samples
+#: (an env change selects a different gate) and invalidated by
+#: dropping ALL of an op's keys on record — a per-op dirty bit would
+#: let one gate's recompute mark another gate's stale entry clean.
+_RATIO_CACHE: dict[tuple[str, int], float | None] = {}
+
+
+def min_samples() -> int:
+    return _registry.env_int("TDT_PERFWATCH_MIN_SAMPLES",
+                             DEFAULT_MIN_SAMPLES, minimum=1)
+
+
+def routing_enabled() -> bool:
+    """``TDT_PERFWATCH_ROUTING=0`` stops the router consulting live
+    ratios (samples still accumulate for dashboards/reports)."""
+    return os.environ.get("TDT_PERFWATCH_ROUTING", "").strip() != "0"
+
+
+def probe_every() -> int:
+    return _registry.env_int("TDT_PERFWATCH_PROBE_EVERY",
+                             DEFAULT_PROBE_EVERY, minimum=0)
+
+
+def take_probe(op: str) -> bool:
+    """True on every :func:`probe_every`-th policy-routed call of
+    ``op``: the router then runs the fused branch anyway (recording
+    its wall time) so the fused medians stay fresh and a recovered
+    kernel can route back in — live routing self-corrects in BOTH
+    directions (docs/resilience.md "Live ratios vs BASELINE
+    floors")."""
+    n = probe_every()
+    if n <= 0:
+        return False
+    with _LOCK:
+        c = _PROBE_COUNT.get(op, 0) + 1
+        _PROBE_COUNT[op] = c
+        return c % n == 0
+
+
+def record(op: str, branch: str, bucket: str, ms: float) -> None:
+    """One measured wall-time sample for ``op``'s ``branch``
+    ("fused" | "xla") at ``bucket`` (``ops.common.shape_bucket``
+    signature). O(1): the append marks the op dirty and the median
+    pass happens lazily at the next :func:`ratio` consult (router
+    policy check / :func:`stats`), which also refreshes the
+    ``live_ratio`` gauge — recording must stay cheap enough for every
+    eager op call under telemetry."""
+    if branch not in BRANCHES:
+        raise ValueError(f"branch must be one of {BRANCHES}: {branch!r}")
+    with _LOCK:
+        dq = _SAMPLES.get((op, branch, bucket))
+        if dq is None:
+            dq = _SAMPLES[(op, branch, bucket)] = collections.deque(
+                maxlen=DEFAULT_MAX_SAMPLES)
+        dq.append(float(ms))
+        for k in [k for k in _RATIO_CACHE if k[0] == op]:
+            del _RATIO_CACHE[k]
+    _registry.counter(f"resilience.perfwatch.samples.{branch}").inc()
+
+
+def sample_count(op: str, branch: str, bucket: str | None = None) -> int:
+    with _LOCK:
+        return sum(len(dq) for (o, br, b), dq in _SAMPLES.items()
+                   if o == op and br == branch
+                   and (bucket is None or b == bucket))
+
+
+def _bucket_ratios(op: str, bucket: str | None, min_n: int) -> list:
+    # Caller holds _LOCK.
+    buckets = sorted({b for (o, _, b) in _SAMPLES
+                      if o == op and (bucket is None or b == bucket)})
+    out = []
+    for b in buckets:
+        fused = _SAMPLES.get((op, "fused", b))
+        xla = _SAMPLES.get((op, "xla", b))
+        if (fused and xla and len(fused) >= min_n
+                and len(xla) >= min_n):
+            mf = statistics.median(fused)
+            if mf > 0:
+                out.append(statistics.median(xla) / mf)
+    return out
+
+
+def ratio(op: str, bucket: str | None = None,
+          min_n: int | None = None) -> float | None:
+    """Live ``<op>_vs_xla`` ratio (>1 = fused faster, matching the
+    BASELINE floor convention): median over per-bucket
+    ``median(xla) / median(fused)`` ratios, each bucket qualifying
+    only when both branches carry ≥ ``min_n`` samples
+    (default ``TDT_PERFWATCH_MIN_SAMPLES``). None when no bucket
+    qualifies — the router then falls back to the static floor.
+
+    The op-level default path is cached: a consult with no new
+    samples since the last one is a dict lookup, so the router's
+    per-call policy check never pays a median pass on a quiet op."""
+    if bucket is not None or min_n is not None:
+        with _LOCK:
+            ratios = _bucket_ratios(
+                op, bucket, min_n if min_n is not None else min_samples())
+        return statistics.median(ratios) if ratios else None
+    mn = min_samples()
+    key = (op, mn)
+    with _LOCK:
+        if key in _RATIO_CACHE:
+            return _RATIO_CACHE[key]
+        ratios = _bucket_ratios(op, None, mn)
+        r = statistics.median(ratios) if ratios else None
+        _RATIO_CACHE[key] = r
+    if r is not None:
+        _registry.gauge(f"resilience.perfwatch.{op}.live_ratio").set(
+            round(r, 4))
+    return r
+
+
+def stats() -> dict:
+    """Per-op summary for reports/dashboards: qualified live ratio
+    (or None), per-branch sample counts, bucket count. Goes through
+    :func:`ratio`'s cache, so scraping also refreshes the
+    ``live_ratio`` gauges."""
+    with _LOCK:
+        ops = sorted({o for (o, _, _) in _SAMPLES})
+    out = {}
+    for op in ops:
+        r = ratio(op)
+        with _LOCK:
+            out[op] = {
+                "live_ratio": round(r, 4) if r is not None else None,
+                "buckets": len({b for (o, _, b) in _SAMPLES
+                                if o == op}),
+                "fused_samples": sum(
+                    len(dq) for (o, br, _), dq in _SAMPLES.items()
+                    if o == op and br == "fused"),
+                "xla_samples": sum(
+                    len(dq) for (o, br, _), dq in _SAMPLES.items()
+                    if o == op and br == "xla"),
+            }
+    return out
+
+
+def reset() -> None:
+    """Drop every rolling window, probe counter, and cached ratio
+    (tests)."""
+    with _LOCK:
+        _SAMPLES.clear()
+        _PROBE_COUNT.clear()
+        _RATIO_CACHE.clear()

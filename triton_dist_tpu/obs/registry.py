@@ -28,6 +28,7 @@ because its loop drives the jitted step from Python.
 from __future__ import annotations
 
 import bisect
+import os
 import threading
 import time
 import warnings
@@ -36,10 +37,25 @@ from triton_dist_tpu.obs import trace as _trace
 
 __all__ = [
     "DEFAULT_MS_BUCKETS", "Counter", "Gauge", "Histogram", "Registry",
-    "NullRegistry", "enable", "disable", "enabled", "get_registry",
-    "set_registry", "counter", "gauge", "histogram", "snapshot",
-    "reset", "span", "record_comm",
+    "NullRegistry", "enable", "disable", "enabled", "env_int",
+    "get_registry", "set_registry", "counter", "gauge", "histogram",
+    "snapshot", "reset", "span", "record_comm",
 ]
+
+def env_int(name: str, default: int, minimum: int | None = None) -> int:
+    """Validated integer env knob — the one parser the obs modules
+    share (perfwatch / attrib; the ring/breaker knobs predate it)."""
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return default
+    try:
+        n = int(v)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer: {v!r}") from None
+    if minimum is not None and n < minimum:
+        raise ValueError(f"{name} must be >= {minimum}: {n}")
+    return n
+
 
 #: Default latency buckets (milliseconds): sub-ms jit dispatch up to
 #: multi-second prefills. Upper bounds; an implicit +Inf bucket catches

@@ -418,9 +418,18 @@ def stats() -> dict:
         out["dropped_total"] = sum(r.dropped for r in rings)
         out["tracks"] = len(rings)
         out["ring_capacity"] = t.capacity
+        # Per-ring high-water mark: the fullest any single ring ever
+        # got (capped at capacity — a wrapped ring IS full). Together
+        # with dropped_total this is the TDT_TRACE_RING sizing signal:
+        # high water at capacity + nonzero drops = undersized ring
+        # (tools/report.py warns on it).
+        out["ring_high_water"] = max(
+            (min(r.total, r.cap) for r in rings), default=0)
         from triton_dist_tpu.obs import registry as _registry
         _registry.gauge("trace.events_total").set(out["events_total"])
         _registry.gauge("trace.dropped_total").set(out["dropped_total"])
+        _registry.gauge("trace.ring_high_water").set(
+            out["ring_high_water"])
     from triton_dist_tpu.obs import flight as _flight
     last = _flight.last_record()
     if last is not None:

@@ -365,6 +365,28 @@ def cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
 
+def pow2_round(n: int) -> int:
+    """Smallest power of two >= ``n`` (0 stays 0)."""
+    n = int(n)
+    if n <= 0:
+        return 0
+    return 1 << (n - 1).bit_length()
+
+
+def shape_bucket(*arrays) -> str:
+    """Power-of-two-rounded shape signature of an op call's array
+    operands, e.g. ``"2048x4096:bfloat16,4096x4096:bfloat16"`` — the
+    pooling key for the live perf-ratio watch (``obs.perfwatch``).
+    Coarser than the resilience config key on purpose: a serving
+    process sees few distinct shapes but many calls, and nearby shapes
+    share a performance regime, while a 64x size difference never
+    pools."""
+    return ",".join(
+        "x".join(str(pow2_round(d)) for d in a.shape) + f":{a.dtype}"
+        for a in arrays
+        if hasattr(a, "shape") and hasattr(a, "dtype"))
+
+
 def round_up(a: int, b: int) -> int:
     return cdiv(a, b) * b
 

@@ -49,6 +49,7 @@ import inspect
 import json
 import os
 import threading
+import time
 
 from triton_dist_tpu import obs
 from triton_dist_tpu.resilience import knownbad
@@ -190,24 +191,50 @@ def _routing_tier() -> str | None:
 
 
 def policy_reason(op: str) -> str | None:
-    """Non-None iff BASELINE data says this op's fused kernel is
-    clearly slower than XLA in the active tier.
+    """Non-None iff the active perf data says this op's fused kernel
+    is clearly slower than XLA in the active tier.
 
-    The ``regression_floors`` table is a CI gate that deliberately
-    sits just UNDER the measured ratios (BASELINE.json ``_comment``),
-    so a floor slightly below 1.0 can belong to an op that actually
-    measures faster than XLA (r5 gemm_ar: floor 0.95, measured
-    1.065×). The default threshold therefore leaves a parity margin:
-    route to XLA only when the floor is below ``TDT_POLICY_THRESHOLD``
-    (default 0.9 — clearly-slower regimes like ag_gemm 0.7 and
-    gemm_rs 0.78), and treat [threshold, ∞) as parity-or-better."""
+    Two data sources, freshest first (docs/resilience.md "Live ratios
+    vs BASELINE floors"):
+
+    1. **Live measured ratio** (``obs.perfwatch``): rolling medians of
+       the wall times the ``@resilient`` entries themselves recorded,
+       consulted once BOTH branches carry
+       ``TDT_PERFWATCH_MIN_SAMPLES`` samples — a chip run
+       self-corrects a stale floor without a redeploy.
+       ``TDT_PERFWATCH_ROUTING=0`` opts out.
+    2. **Static BASELINE floor**: the ``regression_floors`` table is a
+       CI gate that deliberately sits just UNDER the measured ratios
+       (BASELINE.json ``_comment``), so a floor slightly below 1.0 can
+       belong to an op that actually measures faster than XLA (r5
+       gemm_ar: floor 0.95, measured 1.065×).
+
+    Both compare against ``TDT_POLICY_THRESHOLD`` (default 0.9): route
+    to XLA only below it, treat [threshold, ∞) as parity-or-better —
+    the parity margin floors need because they understate measured
+    ratios (live medians don't, but one threshold keeps the policy
+    legible). Every decision's provenance counts into
+    ``resilience.policy_source.{live,floor}`` (plus per-op twins), so
+    the floor→live switchover is observable."""
     tier = _routing_tier()
     if tier is None:
         return None
+    thr = float(os.environ.get("TDT_POLICY_THRESHOLD", "0.9"))
+    from triton_dist_tpu.obs import perfwatch
+    if perfwatch.routing_enabled():
+        live = perfwatch.ratio(op)
+        if live is not None:
+            obs.counter("resilience.policy_source.live").inc()
+            obs.counter(f"resilience.{op}.policy_source.live").inc()
+            if live < thr:
+                return (f"live {op}_vs_xla={round(live, 4)} < {thr} "
+                        f"(perfwatch median)")
+            return None
     ratio = _baseline_ratios(tier).get(f"{op}_vs_xla")
     if ratio is None:
         return None
-    thr = float(os.environ.get("TDT_POLICY_THRESHOLD", "0.9"))
+    obs.counter("resilience.policy_source.floor").inc()
+    obs.counter(f"resilience.{op}.policy_source.floor").inc()
     if ratio < thr:
         return f"{op}_vs_xla={ratio} < {thr} ({tier})"
     return None
@@ -378,6 +405,49 @@ def _has_tracer(bound: inspect.BoundArguments) -> bool:
     return False
 
 
+def _shape_bucket(bound: inspect.BoundArguments) -> str:
+    """Perfwatch pooling key for this call: the pow2-rounded shape
+    signature of its array operands (``ops.common.shape_bucket``) —
+    coarser than the resilience config key on purpose."""
+    from triton_dist_tpu.ops.common import shape_bucket
+    arrays = []
+    for v in bound.arguments.values():
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            arrays.append(v)
+        elif (isinstance(v, (list, tuple)) and v
+              and all(hasattr(e, "shape") and hasattr(e, "dtype")
+                      for e in v)):
+            arrays.extend(v)
+    return shape_bucket(*arrays)
+
+
+def _elapsed_ms(t0: float, out) -> float | None:
+    """Wall time since ``t0`` with ``out`` materialized first (so the
+    sample is device time, not async-dispatch time — the same
+    observer cost the engine spans document); None when blocking
+    fails. Observation only: never raises."""
+    try:
+        import jax
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) * 1e3
+    except Exception:  # noqa: BLE001 — observation only
+        return None
+
+
+def _record_sample(op: str, branch: str, bound, t0: float, out) -> None:
+    """One live perf sample for an EAGER op call, into
+    ``obs.perfwatch``. Telemetry must never break the call it
+    measures."""
+    ms = _elapsed_ms(t0, out)
+    if ms is None:
+        return
+    try:
+        from triton_dist_tpu.obs import perfwatch
+        perfwatch.record(op, branch, _shape_bucket(bound), ms)
+    except Exception:  # noqa: BLE001 — observation only
+        pass
+
+
 def _all_finite(out) -> bool:
     from triton_dist_tpu.runtime.utils import tree_all_finite
     return tree_all_finite(out)
@@ -427,6 +497,16 @@ def resilient(op: str, *, fused_impls: tuple[str, ...] = ("pallas",),
                 # Let the entry raise its own signature error.
                 return fn(*args, **kwargs)
             if bound.arguments.get("impl") not in fused_impls:
+                # Untouched by routing — but an explicit eager call of
+                # the reference path is a live "xla" sample for the
+                # perf watch (tests, benches, and direct users are the
+                # main source of reference-branch wall times).
+                if (bound.arguments.get("impl") == fallback_impl
+                        and obs.enabled() and not _has_tracer(bound)):
+                    t0 = time.perf_counter()
+                    out = fn(*args, **kwargs)
+                    _record_sample(op, "xla", bound, t0, out)
+                    return out
                 return fn(*args, **kwargs)
             config = (config_fn(bound) if config_fn
                       else _default_config(bound, env_keys))
@@ -446,7 +526,35 @@ def resilient(op: str, *, fused_impls: tuple[str, ...] = ("pallas",),
 
             reason = decide(op, key)
             if reason is not None:
+                if reason == "policy":
+                    from triton_dist_tpu.obs import perfwatch
+                    from triton_dist_tpu.resilience.breaker import (
+                        CLOSED)
+                    # Exploration probe (the policy-route analog of
+                    # the breaker's half-open): every Nth
+                    # policy-routed call runs the fused branch anyway,
+                    # so fused medians stay fresh and a recovered
+                    # kernel can route back in — never for known-bad
+                    # routes (decide() ordered them first), and only
+                    # while the breaker is fully CLOSED: decide()
+                    # checks policy before the breaker, so "policy"
+                    # can mask a breaker that is open over real infra
+                    # failures, and a probe must not re-enter those
+                    # (nor steal the half-open state's single-probe
+                    # slot).
+                    if (perfwatch.routing_enabled()
+                            and get_breaker(op).state == CLOSED
+                            and perfwatch.take_probe(op)):
+                        obs.counter(
+                            f"resilience.{op}.policy_probes").inc()
+                        return _guarded(op, key, config, call,
+                                        bound, fallback_impl)
                 _count_fallback(op, reason)
+                if obs.enabled() and not _has_tracer(bound):
+                    t0 = time.perf_counter()
+                    out = call(fallback_impl)
+                    _record_sample(op, "xla", bound, t0, out)
+                    return out
                 return call(fallback_impl)
             return _guarded(op, key, config, call,
                             bound, fallback_impl)
@@ -466,6 +574,8 @@ def _guarded(op, key, config, call, bound, fallback_impl):
     obs.counter(f"resilience.{op}.fused_total").inc()
     tracing = _has_tracer(bound)
     timeout = compile_timeout_s()
+    rec = not tracing and obs.enabled()
+    t0 = time.perf_counter() if rec else 0.0
     try:
         f = faults.take("comm_error", op) if faults.active() else None
         if f is not None:
@@ -489,6 +599,11 @@ def _guarded(op, key, config, call, bound, fallback_impl):
             out = run_with_timeout(thunk, timeout, op=op, key=key)
         else:
             out = call(fused_impl)
+        # Stop the fused clock HERE: the numeric guard below is
+        # measurement overhead the xla branch never pays — timing it
+        # into the fused median would bias live ratios low and route
+        # ops to XLA on observer cost, not kernel performance.
+        fused_ms = _elapsed_ms(t0, out) if rec else None
         if not tracing:
             f = (faults.take("nan_payload", op)
                  if faults.active() else None)
@@ -510,6 +625,11 @@ def _guarded(op, key, config, call, bound, fallback_impl):
                   else "nonfinite" if isinstance(e, NonFiniteOutput)
                   else "error")
         _count_fallback(op, reason)
+        if rec:
+            t1 = time.perf_counter()
+            out = call(fallback_impl)
+            _record_sample(op, "xla", bound, t1, out)
+            return out
         return call(fallback_impl)
     if not tracing:
         # Only a real execution proves anything: a successful TRACE
@@ -518,15 +638,24 @@ def _guarded(op, key, config, call, bound, fallback_impl):
         # the watchdog) nor close a half-open breaker.
         _COMPILED.add(key)
         get_breaker(op).record_success()
+        if rec and fused_ms is not None:
+            try:
+                from triton_dist_tpu.obs import perfwatch
+                perfwatch.record(op, "fused", _shape_bucket(bound),
+                                 fused_ms)
+            except Exception:  # noqa: BLE001 — observation only
+                pass
     return out
 
 
 def reset_router() -> None:
     """Drop router process state (tests): compiled-key set, baseline
-    cache, breakers, known-bad singleton. The fallback registry is
-    code-derived and survives."""
+    cache, breakers, known-bad singleton, live perf-ratio windows. The
+    fallback registry is code-derived and survives."""
+    from triton_dist_tpu.obs import perfwatch
     from triton_dist_tpu.resilience.breaker import reset_breakers
     _COMPILED.clear()
     _BASELINE_CACHE.clear()
     reset_breakers()
     knownbad.reset_cache()
+    perfwatch.reset()

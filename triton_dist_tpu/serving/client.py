@@ -73,6 +73,16 @@ class ChatClient:
             req["seconds"] = seconds
         return self.request(req)
 
+    def request_stats(self, last: int | None = None) -> list:
+        """The newest ``last`` finished requests' latency-attribution
+        waterfalls (``{"cmd": "request_stats"}`` — queue_wait →
+        prefill → decode segments, prefix savings, per-token share;
+        docs/observability.md "Request attribution"), newest first."""
+        req: dict = {"cmd": "request_stats"}
+        if last is not None:
+            req["last"] = last
+        return self.request(req).get("requests", [])
+
     def chat(self, text: str, gen_len: int = 64) -> str:
         assert self.tokenizer is not None, "text chat needs a tokenizer"
         ids = self.tokenizer(text, return_tensors="np")["input_ids"]

@@ -48,6 +48,19 @@ Design:
   ``serving.retire`` instants on the trace timeline carrying each
   request's trace ID — a Perfetto dump of a loaded server shows rows
   churning through the batch.
+- **SLO observatory** (ISSUE 8, docs/observability.md "SLOs and burn
+  rates"). The pump feeds an :class:`obs.slo.SLOTracker`: every
+  request's TTFT, queue wait, and per-output-token time (TPOT), plus
+  each pump iteration's duration, land in rolling-window histograms
+  (``serving.rolling.*`` gauges), and declarative SLO targets
+  (``Engine(slo=...)`` / ``TDT_SLO_*`` env) are burn-rate-evaluated
+  Google-SRE style each iteration — a breach arms the flight recorder
+  so a latency regression leaves a Perfetto postmortem before
+  anything crashes. Each retired request also gets a latency
+  waterfall (``obs.attrib``: queue_wait → prefill → decode, prefix
+  savings, per-token share) attached to its future (the server
+  returns it under ``"timing"``) and pushed to the last-K ring behind
+  ``{"cmd": "request_stats"}``.
 
 Greedy results are bit-identical to per-request ``Engine.serve()``
 (tests/test_scheduler.py): the scheduler drives the same
@@ -65,7 +78,7 @@ import time
 import warnings
 
 from triton_dist_tpu import obs
-from triton_dist_tpu.obs import trace
+from triton_dist_tpu.obs import attrib, slo, trace
 
 __all__ = ["DEFAULT_MAX_WAITING", "QueueFull", "Request", "Scheduler"]
 
@@ -85,7 +98,7 @@ class Request:
 
     __slots__ = ("prompt", "gen_len", "stop_set", "trace_id", "rid",
                  "t_submit", "t_admit", "t_first", "tokens", "error",
-                 "done")
+                 "done", "cached", "chunks", "timing")
 
     def __init__(self, prompt, gen_len: int, stop_set, trace_id, rid):
         self.prompt = prompt
@@ -99,6 +112,9 @@ class Request:
         self.tokens: list[int] = []     # generated tokens (no prompt)
         self.error: BaseException | None = None
         self.done = threading.Event()
+        self.cached = 0            # prefix-cache-hit prompt tokens
+        self.chunks = 0            # prefill slices dispatched
+        self.timing: dict | None = None   # attribution waterfall
 
     def result(self, timeout: float | None = None) -> list[int]:
         """Block until the request finishes; returns the generated
@@ -122,7 +138,7 @@ class Scheduler:
     """
 
     def __init__(self, engine, params, max_waiting: int | None = None,
-                 prefill_chunk: int | None = None):
+                 prefill_chunk: int | None = None, slo_tracker=None):
         if getattr(engine, "use_mega", False):
             raise ValueError(
                 "use_mega decodes uniform-offset batches only — the "
@@ -142,6 +158,19 @@ class Scheduler:
             raise ValueError(
                 f"prefill_chunk must be positive: {prefill_chunk}")
         self.prefill_chunk = prefill_chunk
+        # The SLO observatory for this scheduler: rolling TTFT / TPOT /
+        # queue-wait / pump-time windows + burn-rate targets. Targets
+        # come from Engine(slo=...), falling back to the env-overridable
+        # defaults; pass an SLOTracker (tests: injectable clock) or a
+        # target list to override, False to disable (TDT_SLO=0 does too).
+        self.slo: slo.SLOTracker | None = None
+        if slo_tracker is not False and slo.enabled():
+            if isinstance(slo_tracker, slo.SLOTracker):
+                self.slo = slo_tracker
+            else:
+                targets = (slo_tracker if slo_tracker is not None
+                           else getattr(engine, "slo", None))
+                self.slo = slo.SLOTracker(targets=targets)
         self._cond = threading.Condition()
         self._queue: collections.deque[Request] = collections.deque()
         self._rid = 0
@@ -321,14 +350,32 @@ class Scheduler:
             req.tokens.append(tok)
             if req.t_first is None:
                 req.t_first = time.perf_counter()
-                obs.histogram("serving.ttft_ms").observe(
-                    (req.t_first - req.t_submit) * 1e3)
+                ttft_ms = (req.t_first - req.t_submit) * 1e3
+                obs.histogram("serving.ttft_ms").observe(ttft_ms)
+                if self.slo is not None:
+                    self.slo.observe("ttft", ttft_ms)
             budgets[row] -= 1
             if budgets[row] <= 0 or tok in req.stop_set:
                 sess.retire_row(row)
                 rows.pop(row)
                 budgets.pop(row)
                 obs.counter("serving.retired").inc()
+                t_done = time.perf_counter()
+                # The request's latency waterfall (obs.attrib): same
+                # clock readings as the trace instants, partitioned
+                # queue_wait → prefill → decode so the segments sum to
+                # the request's wall time by construction.
+                req.timing = attrib.build(
+                    rid=req.rid, trace_id=req.trace_id,
+                    t_submit=req.t_submit, t_admit=req.t_admit,
+                    t_first=req.t_first, t_done=t_done,
+                    prompt_tokens=len(req.prompt),
+                    tokens=len(req.tokens), cached_tokens=req.cached,
+                    prefill_chunks=req.chunks)
+                attrib.push(req.timing)
+                if self.slo is not None and req.timing["tpot_ms"] \
+                        is not None:
+                    self.slo.observe("tpot", req.timing["tpot_ms"])
                 trace.emit("i", "serving.retire", "serving",
                            args={"row": row, "rid": req.rid,
                                  "tokens": len(req.tokens)},
@@ -337,8 +384,10 @@ class Scheduler:
 
         def admit(row: int, req: Request) -> None:
             req.t_admit = time.perf_counter()
-            obs.histogram("serving.queue_wait_ms").observe(
-                (req.t_admit - req.t_submit) * 1e3)
+            qw_ms = (req.t_admit - req.t_submit) * 1e3
+            obs.histogram("serving.queue_wait_ms").observe(qw_ms)
+            if self.slo is not None:
+                self.slo.observe("queue_wait", qw_ms)
             obs.counter("serving.admitted").inc()
             trace.emit("i", "serving.admit", "serving",
                        args={"row": row, "rid": req.rid,
@@ -356,11 +405,14 @@ class Scheduler:
                 obs.counter("serving.admit_errors").inc()
                 self._fail(req, e)
                 return
+            req.chunks = 1          # one-shot, or the first slice
             rows[row] = req
             budgets[row] = req.gen_len
             if first is None:
                 prefilling.add(row)
             else:
+                req.cached = (getattr(sess, "admit_info", None)
+                              or {}).get("cached", 0)
                 record(row, req, first)
 
         while True:
@@ -392,6 +444,7 @@ class Scheduler:
                 obs.gauge("serving.queue_depth").set(len(self._queue))
             # Engine work happens OUTSIDE the lock: submitters only ever
             # wait on queue capacity, never on device time.
+            t_iter0 = time.perf_counter()
             for row, req in admits:
                 admit(row, req)
             for row in sorted(prefilling):   # one slice each, FIFO-ish
@@ -407,8 +460,11 @@ class Scheduler:
                     obs.counter("serving.admit_errors").inc()
                     self._fail(req, e)
                     continue
+                req.chunks += 1
                 if first is not None:
                     prefilling.discard(row)
+                    req.cached = (getattr(sess, "admit_info", None)
+                                  or {}).get("cached", 0)
                     record(row, req, first)
             occupancy.set(len(rows))
             live = [(r, rows[r]) for r in sorted(rows)
@@ -434,3 +490,14 @@ class Scheduler:
                     if rows.get(row) is req:   # not failed above
                         record(row, req, int(toks[row]))
             occupancy.set(len(rows))
+            if admits or live or prefilling:
+                # Iteration time = this pump turn's engine work (the
+                # cond wait above is idleness, not work). Evaluation is
+                # rate-limited inside the tracker; a breach arms the
+                # flight recorder (obs.slo).
+                it_ms = (time.perf_counter() - t_iter0) * 1e3
+                obs.histogram("serving.pump_iteration_ms").observe(
+                    it_ms)
+                if self.slo is not None:
+                    self.slo.observe("pump", it_ms)
+                    self.slo.evaluate()

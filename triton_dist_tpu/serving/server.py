@@ -30,8 +30,20 @@ protocol returns the process-local registry snapshot —
     ← {"metrics": {"counters": ..., "gauges": ..., "histograms": ...}}
 
 with ``"format": "prometheus"`` adding a ``prometheus`` text-exposition
-field for scrapers. Constructing a ModelServer enables the telemetry
-registry (``telemetry=False`` opts out).
+field for scrapers; a metrics scrape first forces a fresh SLO
+evaluation, so the ``serving.rolling.*`` / ``serving.slo_burn.*``
+gauges are current as of the reply (``tools/top.py`` polls this).
+Constructing a ModelServer enables the telemetry registry
+(``telemetry=False`` opts out).
+
+Per-request latency attribution (ISSUE 8): scheduler-served responses
+carry a ``"timing"`` waterfall per prompt (queue_wait → prefill →
+decode segments summing to the request's wall time, plus prefix-cache
+savings and per-token share — ``obs.attrib``), and the last-K ring is
+queryable —
+
+    → {"cmd": "request_stats", "last": 8}
+    ← {"requests": [waterfall, ...]}        # newest first
 
 Tracing (docs/observability.md "Tracing"): the server also runs the
 event tracer / flight recorder by default (``TDT_TRACE=0`` opts out).
@@ -205,6 +217,11 @@ class ModelServer:
             # Snapshot under the generation lock is NOT needed: the
             # registry is internally locked, and a scraper must not
             # queue behind a multi-second generation.
+            if self.scheduler is not None \
+                    and self.scheduler.slo is not None:
+                # Rolling/burn gauges current as of THIS scrape (the
+                # pump only evaluates while it is doing work).
+                self.scheduler.slo.evaluate(force=True)
             snap = obs.snapshot()
             if trace.enabled():
                 # Tracing counts + last flight record ride inside the
@@ -221,9 +238,14 @@ class ModelServer:
                 return {"error": "tracing is disabled (TDT_TRACE)"}
             path = flight.dump("cmd", last_s=req.get("seconds"))
             return {"dumped": path, "trace": trace.stats()}
+        if cmd == "request_stats":
+            # The attribution ring (obs.attrib): the newest `last`
+            # finished requests' waterfalls, newest first.
+            from triton_dist_tpu.obs import attrib
+            return {"requests": attrib.last(req.get("last"))}
         obs.counter("server.errors").inc()
         return {"error": f"unknown cmd {cmd!r} "
-                         f"(known: metrics, dump_trace)"}
+                         f"(known: metrics, dump_trace, request_stats)"}
 
     def _effective_gen_len(self, req: dict, prompts) -> int:
         """Clamp the requested gen_len to the protocol cap (4096) AND
@@ -266,8 +288,15 @@ class ModelServer:
             tokens = [f.result() for f in futures]
             ms = (time.perf_counter() - t_req0) * 1e3
             obs.histogram("server.request_ms").observe(ms)
-            return {"tokens": tokens, "gen_len": gen_len,
+            resp = {"tokens": tokens, "gen_len": gen_len,
                     "latency_ms": round(ms, 3)}
+            # Per-prompt latency attribution (obs.attrib): where this
+            # request's time went, segment sums matching latency_ms
+            # up to handler↔pump handoff (docs/observability.md).
+            timing = [f.timing for f in futures]
+            if any(t is not None for t in timing):
+                resp["timing"] = timing
+            return resp
         return self._serve_generate_serialized(req, prompts, gen_len,
                                                stop, t_req0)
 

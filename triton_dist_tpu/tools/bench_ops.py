@@ -228,6 +228,32 @@ def check_regression(extras: dict, floors: dict) -> list[str]:
     return fails
 
 
+#: Rolling-window serving percentiles a bench run's extras must carry
+#: once it produced serving numbers (ISSUE 8): lifetime-histogram
+#: percentiles hide a fresh regression under hours of good samples, so
+#: the gate pins the extras to the WINDOWED gauges.
+SERVING_ROLLING_KEYS = (
+    "serving_rolling_ttft_p50_ms", "serving_rolling_ttft_p99_ms",
+    "serving_rolling_tpot_p50_ms", "serving_rolling_tpot_p99_ms",
+)
+
+
+def check_serving_wellformed(extras: dict) -> list[str]:
+    """Failure strings when a run that measured serving throughput is
+    missing its rolling-window TTFT/TPOT percentiles (empty when the
+    serving part did not run — kernel-only sweeps pass untouched — or
+    when the run recorded the explicit ``TDT_SLO=0`` opt-out)."""
+    if "serving_tokens_per_s" not in extras:
+        return []
+    if extras.get("serving_rolling_disabled"):
+        return []
+    return [f"{k}: missing/non-numeric (serving extras must carry "
+            f"rolling-window percentiles)"
+            for k in SERVING_ROLLING_KEYS
+            if not isinstance(extras.get(k), (int, float))
+            or isinstance(extras.get(k), bool)]
+
+
 def _extras_from_file(path: str) -> dict:
     """Extras dict from any bench artifact: a bench.py checkpoint
     ({"extras": ...}), a bench.py result line ({"metric", "extras"}),
@@ -285,6 +311,7 @@ def run_regress(baseline_path: str, from_file: str | None,
         skipped = sorted(set(floors) - sweep_keys)
         floors = {k: v for k, v in floors.items() if k in sweep_keys}
     fails = check_regression(extras, floors)
+    fails += check_serving_wellformed(extras)
     report = {"tier": tier, "floors": floors, "failures": fails,
               "floors_skipped_not_swept": skipped,
               "checked": {k: extras.get(k) for k in sorted(floors)}}

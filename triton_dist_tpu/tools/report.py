@@ -150,12 +150,43 @@ def render_tracing(stats: dict | None) -> str:
         return ""
     lines = ["#### tracing", "| metric | value |", "|---|---|"]
     for k in ("events_total", "dropped_total", "tracks",
-              "ring_capacity", "flight_dumps"):
+              "ring_capacity", "ring_high_water", "flight_dumps"):
         if k in stats:
             lines.append(f"| {k} | {stats[k]} |")
     if stats.get("last_flight_record"):
         lines.append(
             f"| last_flight_record | {stats['last_flight_record']} |")
+    if stats.get("dropped_total"):
+        # An undersized TDT_TRACE_RING silently truncates every flight
+        # record's window; surface it where the numbers are read
+        # instead of only inside a dump.
+        lines.append(
+            f"\n⚠ {int(stats['dropped_total'])} trace events were "
+            f"overwritten before export — the flight-recorder window "
+            f"is truncated; raise TDT_TRACE_RING "
+            f"(capacity {stats.get('ring_capacity', '?')}, high water "
+            f"{stats.get('ring_high_water', '?')}).")
+    return "\n".join(lines)
+
+
+def render_waterfalls(wf: dict | None) -> str:
+    """Render sampled request-attribution waterfalls (``obs.attrib``
+    records bench.py embeds under ``extras.telemetry.waterfalls``):
+    where one request's TTFT went — queue vs prefill vs decode — next
+    to the aggregate numbers."""
+    if not wf:
+        return ""
+    lines = ["#### request waterfalls",
+             "| part | total_ms | queue_wait | prefill | decode | "
+             "tokens | cached |", "|---|---|---|---|---|---|---|"]
+    for part in sorted(wf):
+        r = wf[part] or {}
+        seg = r.get("segments", {})
+        lines.append(
+            f"| {part} | {r.get('total_ms')} | "
+            f"{seg.get('queue_wait_ms')} | {seg.get('prefill_ms')} | "
+            f"{seg.get('decode_ms')} | {r.get('tokens')} | "
+            f"{r.get('cached_tokens')} |")
     return "\n".join(lines)
 
 
@@ -169,6 +200,7 @@ def render_telemetry(snap: dict) -> str:
     serving = render_serving(snap)
     kv = render_kv(snap)
     tracing = render_tracing(snap.get("trace"))
+    waterfalls = render_waterfalls(snap.get("waterfalls"))
     # trace.* gauges mirror what the tracing section already shows
     # (they exist for the Prometheus exposition path) — don't render
     # the same numbers twice when that section is present; ditto the
@@ -191,6 +223,8 @@ def render_telemetry(snap: dict) -> str:
         lines += [kv, ""]
     if tracing:
         lines += [tracing, ""]
+    if waterfalls:
+        lines += [waterfalls, ""]
     if scalars:
         lines += ["| metric | type | value |", "|---|---|---|"]
         for kind, k, v in scalars:
