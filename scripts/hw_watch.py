@@ -31,6 +31,16 @@ QUEUE = [
     # cases 1-27 PASS; run stopped at the flash_decode/paged compile
     # hang). Perf-first again; the wedge-risky paged case is LAST.
     #
+    # Position 0: static-analysis preflight (docs/analysis.md) — pure
+    # Python on the host, no tunnel contact. A ring-protocol or
+    # VMEM-budget finding stops the whole queue before any step can
+    # dial the chip with a schedule/config the checker rejects.
+    # (tpu_smoke runs it again internally; this front-position copy
+    # also guards the bench steps.)
+    ("tdt_check_preflight",
+     [sys.executable, "-m", "triton_dist_tpu.tools.tdt_check"],
+     600.0, {"JAX_PLATFORMS": "cpu"}),
+    #
     # Position 1: the parts the aborted full bench never reached
     # (sp_attn, train) plus the mega deep retry — all three now run
     # under the 64 MB scoped-VMEM limit that fixed the SP kernel's
@@ -126,7 +136,7 @@ def run_step(name: str, argv: list[str], deadline_s: float,
     finally:
         out.close()
     log(f"step {name}: done rc={child.returncode}")
-    return "done"
+    return "done" if child.returncode == 0 else "failed"
 
 
 def main() -> None:
@@ -144,6 +154,13 @@ def main() -> None:
         status = run_step(name, argv, deadline, env_extra)
         i += 1
         commit_evidence()
+        if name == "tdt_check_preflight" and status == "failed":
+            # The gate step: a static finding means later steps would
+            # dial the chip with a schedule/config the checker rejects
+            # — stop the whole queue (its log has the findings).
+            log("preflight FAILED — queue stopped before any chip "
+                "contact (see artifacts/hw_tdt_check_preflight.out)")
+            return
         if status == "abandoned":
             # The abandoned child may still own the (single) TPU client
             # slot — do NOT race it. But a later probe SUCCEEDING means

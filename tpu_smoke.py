@@ -53,10 +53,23 @@ def _init_backend(retries: int = 3, backoff_s: float = 20.0):
     raise last
 
 
+def run_preflight() -> int:
+    """Static-analysis preflight (docs/analysis.md): model-check the
+    ring protocols and vet every autotune candidate table's VMEM
+    footprint — pure Python, before the first Mosaic compile — plus
+    the repo contract lints. A finding here stops the queue: two
+    rounds of smoke queues were wedged by a compile hang this check
+    class rejects statically (ROADMAP item 1)."""
+    from triton_dist_tpu.tools.tdt_check import preflight
+    print("== tdt-check preflight ==", flush=True)
+    return preflight()
+
+
 def run_smoke(log_path: str | None = None, only: str | None = None,
               interpret: bool = False, list_only: bool = False,
               skip: str | None = None, export_lint: bool = False,
-              world: int = 1, case_timeout: float = 420.0) -> int:
+              world: int = 1, case_timeout: float = 420.0,
+              preflight: bool = True) -> int:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -87,6 +100,13 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     if not list_only:
         _obs.enable()
         _trace.enable()
+
+    if preflight and not list_only:
+        rc = run_preflight()
+        if rc != 0:
+            print("tdt-check preflight FAILED — queue not started "
+                  "(--no-preflight overrides)", flush=True)
+            return rc
 
     results: list[tuple[str, str, str]] = []  # (name, status, detail)
 
@@ -627,7 +647,8 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
 def run_subproc(log_path: str, timeout_s: float,
                 skip: str | None = None,
                 start_after: str | None = None,
-                only: str | None = None) -> int:
+                only: str | None = None,
+                preflight: bool = True) -> int:
     """Run every case in its OWN subprocess with a hard deadline.
 
     A Mosaic compile hang through the tunnel has been observed to wedge
@@ -652,6 +673,15 @@ def run_subproc(log_path: str, timeout_s: float,
     got to compile), so the run stops there. ``--start-after`` resumes
     a partial run."""
     import subprocess
+    # Preflight ONCE in the parent (children get --no-preflight): a
+    # protocol or VMEM-budget finding stops the queue before the first
+    # child ever dials the tunnel (docs/analysis.md).
+    if preflight:
+        rc = run_preflight()
+        if rc != 0:
+            print("tdt-check preflight FAILED — queue not started "
+                  "(--no-preflight overrides)", flush=True)
+            return rc
     names = subprocess.run(
         [sys.executable, __file__, "--list"], capture_output=True,
         text=True, timeout=600).stdout.split()
@@ -702,7 +732,8 @@ def run_subproc(log_path: str, timeout_s: float,
         with open(out_path, "w") as out:
             child = subprocess.Popen(
                 [sys.executable, __file__, "--only", f"={name}",
-                 "--hard-exit", "--case-timeout", str(timeout_s),
+                 "--hard-exit", "--no-preflight",
+                 "--case-timeout", str(timeout_s),
                  "--log", log_path + ".case"],
                 stdout=out, stderr=subprocess.STDOUT)
         hung = False
@@ -796,6 +827,10 @@ if __name__ == "__main__":
                     help="mesh size for --export-lint: verifies the "
                          "world-N ring/remote-DMA variants' Mosaic "
                          "lowering (world>1 never executes)")
+    ap.add_argument("--no-preflight", action="store_true",
+                    help="skip the tdt-check static-analysis preflight "
+                         "(docs/analysis.md) — per-case subprocesses "
+                         "use this; the parent already ran it")
     args = ap.parse_args()
     if args.world != 1:
         # Early, clear validation: the smoke shapes divide by powers of
@@ -813,7 +848,8 @@ if __name__ == "__main__":
             "--export-lint runs in-process on the CPU host; "
             "drop --subproc (no tunnel involved, nothing to isolate)")
         sys.exit(run_subproc(args.log, args.case_timeout, skip=args.skip,
-                             start_after=args.start_after, only=args.only))
+                             start_after=args.start_after, only=args.only,
+                             preflight=not args.no_preflight))
     if args.world > 1:
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
@@ -823,7 +859,8 @@ if __name__ == "__main__":
             ).strip()
     rc = run_smoke(args.log, args.only, skip=args.skip,
                    export_lint=args.export_lint, world=args.world,
-                   case_timeout=args.case_timeout)
+                   case_timeout=args.case_timeout,
+                   preflight=not args.no_preflight)
     if args.hard_exit:
         sys.stdout.flush()
         sys.stderr.flush()

@@ -1,0 +1,144 @@
+"""``triton_dist_tpu.analysis`` — static-analysis framework.
+
+A plugin pass API over a shared findings model (docs/analysis.md).
+Each pass is a function ``(repo_root: Path) -> list[Finding]``
+registered under a stable name; ``run_passes`` runs a selection,
+applies inline ``# tdt: ignore[...]`` suppression pragmas, and hands
+the surviving findings to the ``tools/tdt_check.py`` driver (JSON or
+human output, nonzero exit on errors). The quick tier runs every pass
+over the repo (tests/test_tdt_check.py) and ``tpu_smoke.py`` runs
+them as a preflight, so a protocol or contract regression fails CI —
+not a smoke queue, and not a chip.
+
+Built-in passes:
+
+- ``ring-protocol`` — model-checks the fused GEMM family's ring
+  signal/wait protocols for worlds 1..8 x both ring directions
+  (:mod:`.ring_model`);
+- ``vmem-budget`` — every autotune candidate the config tables can
+  emit fits the declared-footprint cap, statically (:mod:`.vmem`);
+- ``metric-catalog`` — emitted metrics and docs/observability.md
+  agree, both directions (:mod:`.lint_metrics`);
+- ``env-knobs`` — every ``TDT_*`` knob documented; integer knobs
+  parse via ``obs.registry.env_int`` (:mod:`.lint_env`);
+- ``trace-balance`` — host-side trace emitters close what they open
+  (:mod:`.lint_trace`);
+- ``fallback-coverage`` — every public op entry has a registered XLA
+  escape hatch (:mod:`.lint_fallback`, migrated from
+  ``tools/fallback_lint.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+from triton_dist_tpu.analysis.findings import (  # noqa: F401
+    Finding, SEVERITIES, exit_code, filter_suppressed, render_human,
+    render_json)
+
+__all__ = ["Finding", "Pass", "PASSES", "register_pass", "repo_root",
+           "run_passes", "exit_code", "filter_suppressed",
+           "render_human", "render_json"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Pass:
+    name: str
+    description: str
+    fn: object     # (root: Path) -> list[Finding]
+
+
+PASSES: dict = {}
+
+
+def register_pass(name: str, description: str):
+    """Decorator adding a pass to the registry (docs/analysis.md
+    "Adding a pass"). Pass functions take the repo root and return
+    findings; they must be side-effect-free and fast enough for the
+    quick tier."""
+    def deco(fn):
+        if name in PASSES:
+            raise ValueError(f"pass {name!r} already registered")
+        PASSES[name] = Pass(name=name, description=description, fn=fn)
+        return fn
+    return deco
+
+
+def repo_root() -> Path:
+    import triton_dist_tpu
+    return Path(triton_dist_tpu.__file__).parent.parent
+
+
+def run_passes(root=None, names=None, apply_suppression=True) -> list:
+    """Run passes (all by default) and return surviving findings,
+    stamped with their pass name and sorted errors-first."""
+    root = Path(root) if root is not None else repo_root()
+    if names is None:
+        names = list(PASSES)
+    unknown = [n for n in names if n not in PASSES]
+    if unknown:
+        raise ValueError(f"unknown pass(es): {unknown}; "
+                         f"available: {sorted(PASSES)}")
+    findings = []
+    for name in names:
+        for f in PASSES[name].fn(root):
+            if not f.pass_name:
+                f = dataclasses.replace(f, pass_name=name)
+            findings.append(f)
+    if apply_suppression:
+        findings = filter_suppressed(findings)
+    findings.sort(key=lambda f: (f.severity != "error", f.file or "",
+                                 f.line or 0, f.code))
+    return findings
+
+
+# -- built-in pass registrations -------------------------------------------
+# Heavy imports (jax via ops/) stay inside the pass bodies so importing
+# the framework itself is cheap.
+
+@register_pass("ring-protocol",
+               "model-check the fused-family ring schedules, worlds "
+               "1..8 x both ring_dirs")
+def _ring_pass(root):
+    from triton_dist_tpu.analysis import ring_model
+    return ring_model.verify_family()
+
+
+@register_pass("vmem-budget",
+               "every autotune candidate fits HARD_FOOTPRINT_CAP "
+               "statically (no compile)")
+def _vmem_pass(root):
+    from triton_dist_tpu.analysis import vmem
+    return vmem.sweep_candidate_tables()
+
+
+@register_pass("metric-catalog",
+               "emitted metrics and the docs/observability.md catalog "
+               "agree, both directions")
+def _metrics_pass(root):
+    from triton_dist_tpu.analysis import lint_metrics
+    return lint_metrics.run(root)
+
+
+@register_pass("env-knobs",
+               "every TDT_* knob documented; integer knobs via "
+               "obs.registry.env_int")
+def _env_pass(root):
+    from triton_dist_tpu.analysis import lint_env
+    return lint_env.run(root)
+
+
+@register_pass("trace-balance",
+               "host-side trace.begin/end emitters are balanced")
+def _trace_pass(root):
+    from triton_dist_tpu.analysis import lint_trace
+    return lint_trace.run(root)
+
+
+@register_pass("fallback-coverage",
+               "every public op entry has a registered XLA escape "
+               "hatch")
+def _fallback_pass(root):
+    from triton_dist_tpu.analysis import lint_fallback
+    return lint_fallback.collect_findings()

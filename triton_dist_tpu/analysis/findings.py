@@ -1,0 +1,130 @@
+"""Findings model for the static-analysis framework (docs/analysis.md).
+
+A :class:`Finding` is one defect a pass surfaced: a stable ``code``
+(the finding class mutation tests assert on), a severity, a
+``file:line`` anchor pointing at the code that must change, and a fix
+hint. Passes return lists of findings; the driver
+(``tools/tdt_check.py``) renders them human- or JSON-side and exits
+nonzero when any ``error`` survives suppression.
+
+Suppression is inline and anchored: a ``# tdt: ignore[<code>]``
+pragma on the flagged line (or ``# tdt: ignore`` for any code) drops
+the finding — the pragma lives next to the code it excuses, so a
+suppression can never outlive its reason invisibly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+
+__all__ = ["Finding", "SEVERITIES", "filter_suppressed", "render_human",
+           "render_json", "exit_code"]
+
+SEVERITIES = ("error", "warning")
+
+#: ``# tdt: ignore`` or ``# tdt: ignore[code, other.code]``
+_PRAGMA = re.compile(r"#\s*tdt:\s*ignore(?:\[([^\]]*)\])?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One defect surfaced by a pass.
+
+    ``code`` is the stable finding class (``ring.deadlock``,
+    ``vmem.over_budget``, ``lint.metric_undocumented``, ...) —
+    mutation tests and suppression pragmas key on it, so renaming one
+    is a breaking change to both.
+    """
+    code: str
+    message: str
+    file: str | None = None
+    line: int | None = None
+    severity: str = "error"
+    pass_name: str = ""
+    fix_hint: str = ""
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"severity must be one of {SEVERITIES}: "
+                             f"{self.severity!r}")
+
+    @property
+    def anchor(self) -> str:
+        if self.file is None:
+            return "<repo>"
+        return f"{self.file}:{self.line}" if self.line else str(self.file)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        out = (f"{self.anchor}: {self.severity}[{self.code}] "
+               f"{self.message}")
+        if self.fix_hint:
+            out += f"\n    fix: {self.fix_hint}"
+        return out
+
+
+def _suppressed_codes(line_text: str):
+    """Codes suppressed by a pragma on this source line; ``None`` when
+    no pragma, ``()`` for the bare catch-all form."""
+    m = _PRAGMA.search(line_text)
+    if m is None:
+        return None
+    if m.group(1) is None:
+        return ()
+    return tuple(c.strip() for c in m.group(1).split(",") if c.strip())
+
+
+def filter_suppressed(findings, read_line=None):
+    """Drop findings whose anchored source line carries a matching
+    ``# tdt: ignore`` pragma. ``read_line(file, line)`` is injectable
+    for tests; the default reads the file from disk (missing files /
+    lines keep the finding — a suppression must be provable)."""
+    if read_line is None:
+        def read_line(path, lineno):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    for i, text in enumerate(f, 1):
+                        if i == lineno:
+                            return text
+            except OSError:
+                return None
+            return None
+
+    kept = []
+    for f in findings:
+        if f.file and f.line:
+            text = read_line(f.file, f.line)
+            codes = _suppressed_codes(text) if text is not None else None
+            if codes is not None and (codes == () or f.code in codes):
+                continue
+        kept.append(f)
+    return kept
+
+
+def exit_code(findings) -> int:
+    """Driver exit status: nonzero iff any error-severity finding."""
+    return 1 if any(f.severity == "error" for f in findings) else 0
+
+
+def render_human(findings, n_passes: int | None = None) -> str:
+    lines = [f.render() for f in findings]
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = len(findings) - n_err
+    suffix = f" across {n_passes} passes" if n_passes is not None else ""
+    if not findings:
+        lines.append(f"tdt-check OK: no findings{suffix}")
+    else:
+        lines.append(f"tdt-check: {n_err} error(s), {n_warn} "
+                     f"warning(s){suffix}")
+    return "\n".join(lines)
+
+
+def render_json(findings) -> str:
+    return json.dumps({"findings": [f.to_dict() for f in findings],
+                       "errors": sum(1 for f in findings
+                                     if f.severity == "error")},
+                      indent=2, sort_keys=True)

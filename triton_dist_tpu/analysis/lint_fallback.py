@@ -1,0 +1,116 @@
+"""Escape-hatch pass: no public op entry ships without a fallback.
+
+Migrated from ``tools/fallback_lint.py`` (which remains as a thin
+deprecation shim): every module-level function in ``ops/*.py`` with an
+``impl`` parameter must either wear ``@resilient`` (and have actually
+reached the router registry) or be a documented delegate of a
+registered op. Findings now carry the ``file:line`` of the offending
+``def`` — the shim's string list is derived from these messages, so
+its output is unchanged.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+from pathlib import Path
+
+from triton_dist_tpu.analysis.findings import Finding
+
+__all__ = ["DELEGATES", "EXCLUDED_MODULES", "collect_findings"]
+
+#: Entries that intentionally carry no decorator of their own because
+#: they are thin forwards into a decorated entry (the registered op
+#: name on the right). The pass verifies the target op IS registered.
+DELEGATES = {
+    # ag_gemm(a, b) == ag_gemm_multi(a, [b]) — single-b sugar.
+    "allgather_gemm.ag_gemm": "ag_gemm",
+    # fp8 wire wrapper: quantize → fast_all_to_all → dequantize; the
+    # custom_vjp object cannot wear the wrapper, and routing happens
+    # at the inner (decorated) exchange anyway.
+    "all_to_all.fast_all_to_all_fp8": "all_to_all",
+}
+
+#: Modules exempt wholesale: ``autodiff`` re-exports forward-identical
+#: custom_vjp wrappers that CALL the decorated entries (double-routing
+#: them would re-run the router inside its own fallback).
+EXCLUDED_MODULES = {"autodiff"}
+
+
+def _impl_functions(tree: ast.Module):
+    """(name, lineno, has_resilient_decorator) for public module-level
+    defs taking an ``impl`` parameter."""
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if node.name.startswith("_"):
+            continue
+        argnames = [a.arg for a in (node.args.args
+                                    + node.args.kwonlyargs)]
+        if "impl" not in argnames:
+            continue
+        decorated = False
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = (target.attr if isinstance(target, ast.Attribute)
+                    else getattr(target, "id", None))
+            if name == "resilient":
+                decorated = True
+        yield node.name, node.lineno, decorated
+
+
+def collect_findings(delegates=None) -> list:
+    """Contract violations as anchored findings (empty == clean).
+    ``delegates`` overrides :data:`DELEGATES` (mutation tests)."""
+    import triton_dist_tpu.ops as ops_pkg
+    from triton_dist_tpu.resilience import registered_fallbacks
+
+    if delegates is None:
+        delegates = DELEGATES
+    ops_dir = Path(ops_pkg.__file__).parent
+    findings: list = []
+    candidates: list = []
+    for py in sorted(ops_dir.glob("*.py")):
+        if py.stem.startswith("_") or py.stem in EXCLUDED_MODULES:
+            continue
+        tree = ast.parse(py.read_text(), filename=str(py))
+        for name, lineno, decorated in _impl_functions(tree):
+            candidates.append((py, name, lineno, decorated))
+
+    # Import the modules so the decorators have run and the router
+    # registry is populated, then cross-check both directions.
+    for mod in sorted({py.stem for py, _, _, _ in candidates}):
+        importlib.import_module(f"triton_dist_tpu.ops.{mod}")
+    registered = registered_fallbacks()
+    entry_to_op = {spec.entry.rsplit("triton_dist_tpu.ops.", 1)[-1]: op
+                   for op, spec in registered.items()}
+
+    def finding(py, lineno, msg):
+        findings.append(Finding(
+            code="lint.fallback_uncovered", message=msg,
+            file=str(py), line=lineno, pass_name="fallback-coverage",
+            fix_hint="decorate the entry with @resilient (or add a "
+                     "DELEGATES entry naming its registered op) — "
+                     "docs/resilience.md 'Escape-hatch lint'"))
+
+    for py, name, lineno, decorated in candidates:
+        qual = f"{py.stem}.{name}"
+        if decorated:
+            if qual not in entry_to_op:
+                finding(py, lineno,
+                        f"{qual}: @resilient present in source but no "
+                        f"registration reached the router (import-order "
+                        f"or decorator bug?)")
+            continue
+        delegate_op = delegates.get(qual)
+        if delegate_op is None:
+            finding(py, lineno,
+                    f"{qual}: public op entry with an impl= parameter "
+                    f"but no @resilient decorator and no DELEGATES "
+                    f"entry — every op needs an XLA escape hatch "
+                    f"(docs/resilience.md)")
+        elif delegate_op not in registered:
+            finding(py, lineno,
+                    f"{qual}: delegates to op {delegate_op!r}, which "
+                    f"is not registered with the fallback router")
+    return findings

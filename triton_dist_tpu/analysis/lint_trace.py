@@ -1,0 +1,99 @@
+"""Trace-span balance pass: host-side ``trace.begin`` emitters close
+what they open.
+
+An unbalanced begin/end pair corrupts every Perfetto dump that
+includes the emitter (the export validator then flags the WHOLE trace,
+long after the bug merged). ``obs.span`` pairs them structurally;
+anything calling ``obs.trace.begin``/``end`` by hand is checked here:
+within one function the begin and end multisets (by name template)
+must match — or balance across the methods of one class, the
+``__enter__``/``__exit__`` shape ``obs.registry._Span`` uses.
+Deliberately-unclosed spans (a hang recorder pattern) carry a
+``# tdt: ignore[lint.trace_unbalanced]`` pragma at the begin site.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import Counter
+from pathlib import Path
+
+from triton_dist_tpu.analysis.findings import Finding
+from triton_dist_tpu.analysis.lint_metrics import _templates
+
+__all__ = ["run"]
+
+
+def _is_trace_call(node):
+    """(kind, name-template) for ``<...>trace.begin/end(...)`` calls."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("begin", "end")):
+        return None
+    recv = node.func.value
+    recv_name = recv.id if isinstance(recv, ast.Name) else \
+        recv.attr if isinstance(recv, ast.Attribute) else None
+    if recv_name not in ("trace", "_trace", "tracer"):
+        return None
+    tpl = "*"
+    if node.args:
+        tpls = _templates(node.args[0])
+        if tpls:
+            tpl = tpls[0]
+    return node.func.attr, tpl
+
+
+def _counts(tree) -> tuple:
+    begins: Counter = Counter()
+    ends: Counter = Counter()
+    first_line = {}
+    for node in ast.walk(tree):
+        got = _is_trace_call(node)
+        if got is None:
+            continue
+        kind, tpl = got
+        (begins if kind == "begin" else ends)[tpl] += 1
+        first_line.setdefault(tpl, node.lineno)
+    return begins, ends, first_line
+
+
+def run(root=None, files=None) -> list:
+    if root is None:
+        import triton_dist_tpu
+        root = Path(triton_dist_tpu.__file__).parent.parent
+    root = Path(root)
+    if files is None:
+        files = [p for p in sorted((root / "triton_dist_tpu")
+                                   .rglob("*.py"))
+                 if p.name != "trace.py"]   # the emitter itself
+    findings = []
+    for py in files:
+        try:
+            tree = ast.parse(Path(py).read_text(), filename=str(py))
+        except SyntaxError:
+            continue
+        # Scope = top-level function, or a whole class (so
+        # __enter__/__exit__ pairs balance across methods).
+        scopes = []
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.ClassDef)):
+                scopes.append(node)
+        for scope in scopes:
+            begins, ends, first_line = _counts(scope)
+            for tpl in sorted(set(begins) | set(ends)):
+                if begins[tpl] == ends[tpl]:
+                    continue
+                findings.append(Finding(
+                    code="lint.trace_unbalanced",
+                    message=f"{scope.name}: trace span {tpl!r} has "
+                            f"{begins[tpl]} begin(s) vs {ends[tpl]} "
+                            f"end(s)",
+                    file=str(py), line=first_line[tpl],
+                    pass_name="trace-balance",
+                    fix_hint="close the span (or use obs.span, which "
+                             "pairs begin/end structurally); a "
+                             "deliberately-unclosed hang marker takes "
+                             "a # tdt: ignore[lint.trace_unbalanced] "
+                             "pragma"))
+    return findings

@@ -1,0 +1,519 @@
+"""Static model checker for the fused GEMM family's ring protocols.
+
+The bidirectional ring schedules (ops/common.py ``ring_chunk_schedule``,
+ops/allgather_gemm.py ``_make_ring``, the GEMM-RS/AR mirrored-ring
+column splits in ops/gemm_reduce_scatter.py) are signal/wait protocols
+whose deadlock and race bugs only manifest on chip. This module checks
+them *before* any compile: it symbolically executes the schedule —
+calling the kernels' own ``ring_chunk_schedule`` / ``ring_hop_counts``
+with concrete (rank, step) values, then mirroring ``_make_ring``'s
+copy/wait/forward structure into an explicit per-rank event trace —
+and verifies, for every world size and both ``ring_dirs`` settings:
+
+- **signal/wait balance** per (src, dst, semaphore): every remote-copy
+  start is matched by exactly one ``wait_recv`` at the destination and
+  one ``wait_send`` at the source (a surplus leaves a semaphore
+  nonzero at kernel exit; a deficit is a hang);
+- **chunk-coverage exactness**: every shard is consumed exactly once
+  per output tile (and every GEMM-RS output chunk sums exactly one
+  partial from every rank);
+- **absence of wait-before-signal cycles**: a greedy maximal execution
+  of the traces (semaphore waits are the only blocking ops and signals
+  are monotonic, so the maximal execution is unique) — any rank left
+  blocked is a deadlock, reported with the blocked semaphores;
+- **arrival ordering** (the race the dynamic ``TDT_DETECT_RACES``
+  interpreter checks at runtime): no remote chunk is read without a
+  preceding wait on its delivery semaphore in program order.
+
+The interpret-mode race detector checks only the (world, config) pairs
+a CPU test happens to run; this checker enumerates worlds 1..8 x both
+directions x every kernel schedule shape in milliseconds, so autotune
+candidates no test ever executed are still vetted
+(docs/analysis.md "ring-protocol").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import inspect
+from collections import Counter
+
+from triton_dist_tpu.analysis.findings import Finding
+
+__all__ = [
+    "Ev", "Trace", "Violation", "ag_ring_trace", "gemm_rs_trace",
+    "check_trace", "family_traces", "verify_family",
+    "drop_first_wait", "double_signal", "shift_consume",
+    "swap_direction",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Ev:
+    """One protocol event in a rank's program order.
+
+    ``signal``: a remote-copy start at ``rank`` whose recv semaphore
+    ``sem`` fires at ``dst`` (and whose send semaphore fires back at
+    ``rank``). ``wait_recv``/``wait_send``: blocking decrements of the
+    local side of ``sem``. ``consume``: a read of output-tile ``key``
+    guarded by delivery semaphore ``guard`` (``None`` = local data).
+    """
+    kind: str
+    rank: int
+    sem: tuple | None = None
+    dst: int | None = None
+    key: tuple | None = None
+    guard: tuple | None = None
+
+
+@dataclasses.dataclass
+class Trace:
+    """Per-rank event lists for one kernel schedule, plus the coverage
+    oracle (``expected`` consume keys per rank; ``outputs`` are the
+    GEMM-RS reduction results as {chunk: contributor-tuple} maps)."""
+    name: str
+    world: int
+    dirs: int
+    events: dict
+    expected: dict
+    outputs: list = dataclasses.field(default_factory=list)
+    anchor: tuple = (None, None)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    code: str       # ring.deadlock / ring.signal_wait_imbalance /
+    #                 ring.race / ring.coverage
+    detail: str
+
+
+@functools.lru_cache(maxsize=None)
+def _schedule_table(world: int, dirs: int):
+    """{(me, s): (chunk, is_bwd, off)} from the REAL
+    ``ring_chunk_schedule`` — the checker executes the kernels' own
+    schedule code, not a reimplementation of it."""
+    from triton_dist_tpu.ops.common import ring_chunk_schedule
+    table = {}
+    for me in range(world):
+        for s in range(world):
+            c, b, o = ring_chunk_schedule(me, s, world, dirs)
+            table[(me, s)] = (int(c), bool(b), int(o))
+    return table
+
+
+@functools.lru_cache(maxsize=None)
+def _hops(world: int, dirs: int):
+    from triton_dist_tpu.ops.common import ring_hop_counts
+    n_fwd, n_bwd = ring_hop_counts(world, dirs)
+    return int(n_fwd), int(n_bwd)
+
+
+def _anchor_of(obj) -> tuple:
+    try:
+        file = inspect.getsourcefile(obj)
+        _, line = inspect.getsourcelines(obj)
+        return file, line
+    except (OSError, TypeError):
+        return None, None
+
+
+def ag_ring_trace(world: int, dirs: int, m_tiles: int = 1,
+                  n_blocks: int = 1) -> Trace:
+    """Event trace of the fused AG-GEMM family's ring schedule.
+
+    ``m_tiles=n_blocks=1`` mirrors the vmem kernel
+    (``_ag_gemm_kernel``: consume chunk s, then ``advance(s+1)``);
+    tiled shapes mirror ``_ag_gemm_hbm_nb_kernel`` (ring bookkeeping at
+    chunk boundaries of N-block 0 only — later N-blocks re-read the
+    workspace with no waits, safe because panel 0's waits all precede
+    them in program order, which the race check verifies rather than
+    assumes). The AG-SwiGLU kernel shares this exact structure
+    (``_ag_swiglu_hbm_kernel`` consumes each tile twice through the
+    same single arrival wait, so one consume event per tile models it).
+    """
+    sched = _schedule_table(world, dirs)
+    n_fwd, n_bwd = _hops(world, dirs)
+    tiled = (m_tiles, n_blocks) != (1, 1)
+    events: dict = {}
+    expected: dict = {}
+    for me in range(world):
+        ev: list = []
+        left, right = (me - 1) % world, (me + 1) % world
+
+        def advance(s, ev=ev, me=me, left=left, right=right):
+            # mirrors _make_ring.advance: position 0 launches the local
+            # chunk both ways; positions 1..world-1 wait the arrival
+            # and keep it travelling while hops remain; >= world no-op.
+            if world == 1:
+                return
+            if s == 0:
+                if n_fwd:
+                    ev.append(Ev("signal", me, sem=("ag", 0, me),
+                                 dst=right))
+                if n_bwd:
+                    ev.append(Ev("signal", me, sem=("ag", 1, me),
+                                 dst=left))
+            elif s < world:
+                chunk, is_bwd, off = sched[(me, s)]
+                d = 1 if is_bwd else 0
+                ev.append(Ev("wait_recv", me, sem=("ag", d, chunk)))
+                if off < (n_bwd if is_bwd else n_fwd):
+                    ev.append(Ev("signal", me, sem=("ag", d, chunk),
+                                 dst=(left if d else right)))
+
+        def consume(spos, mt, nb, ev=ev, me=me):
+            chunk, is_bwd, _ = sched[(me, spos)] if world > 1 else \
+                (me, False, 0)
+            guard = None if chunk == me else \
+                ("ag", 1 if is_bwd else 0, chunk)
+            ev.append(Ev("consume", me, key=(chunk, mt, nb),
+                         guard=guard))
+
+        if world == 1:
+            for nb in range(n_blocks):
+                for mt in range(m_tiles):
+                    consume(0, mt, nb)
+        elif not tiled:
+            advance(0)
+            for s in range(world):
+                consume(s, 0, 0)
+                advance(s + 1)
+        else:
+            per_nb = world * m_tiles
+            total = n_blocks * per_nb
+
+            def ring_advance(i):
+                if i < per_nb and i % m_tiles == 0:
+                    advance(i // m_tiles)
+
+            ring_advance(0)
+            for i in range(total):
+                ring_advance(i + 1)
+                consume((i % per_nb) // m_tiles, i % m_tiles,
+                        i // per_nb)
+        # mirrors _make_ring.drain
+        if world > 1:
+            for s in range(max(n_fwd, n_bwd)):
+                if s < n_fwd:
+                    ev.append(Ev("wait_send", me,
+                                 sem=("ag", 0, (me - s) % world)))
+                if n_bwd > 0 and s < n_bwd:
+                    ev.append(Ev("wait_send", me,
+                                 sem=("ag", 1, (me + s) % world)))
+        events[me] = ev
+        expected[me] = {(c, mt, nb): 1
+                        for c in range(world)
+                        for mt in range(m_tiles)
+                        for nb in range(n_blocks)}
+    from triton_dist_tpu.ops import allgather_gemm
+    return Trace(name=f"ag_ring[w{world} d{dirs} "
+                      f"{m_tiles}x{n_blocks}]",
+                 world=world, dirs=dirs, events=events,
+                 expected=expected,
+                 anchor=_anchor_of(allgather_gemm._make_ring))
+
+
+def _merge(a: dict, b: dict) -> dict:
+    out = dict(a)
+    for chunk, contribs in b.items():
+        out[chunk] = out.get(chunk, ()) + contribs
+    return out
+
+
+def gemm_rs_trace(world: int, dirs: int,
+                  all_gather_epilogue: bool = False,
+                  send_idx_shift: int = 0) -> Trace:
+    """Event trace of the GEMM-RS mirrored-ring schedule
+    (``_gemm_rs_kernel``; the N-blocked kernel splits the same two
+    rings over N-block ranges instead of column halves — identical
+    protocol, so one trace shape covers both).
+
+    ``dirs=2``: column half 0 reduces on the rightward ring (step s
+    sends the partial for chunk me-s-1), half 1 on the mirrored
+    leftward ring (chunk me+s+1). Reduction values are tracked
+    symbolically as {chunk: contributor-tuple} maps so the checker can
+    assert every output chunk sums every rank exactly once.
+    ``all_gather_epilogue=True`` appends the GEMM-AR ring AG of the
+    reduced chunks. ``send_idx_shift`` exists for mutation tests (an
+    off-by-one chunk index feeds partials of the wrong shard into the
+    travelling sum)."""
+    cols = (0,) if dirs == 1 else (0, 1)
+    events: dict = {}
+    expected: dict = {}
+    outputs: list = []
+
+    def send_idx(r, d, s):
+        idx = (r - s - 1) % world if d == 0 else (r + s + 1) % world
+        return (idx + send_idx_shift) % world
+
+    # Symbolic reduction chain: val[(r, d, s)] is the value rank r
+    # sends at step s on ring d, as {chunk: contributors}.
+    val: dict = {}
+    for s in range(max(world - 1, 0)):
+        for r in range(world):
+            for d in cols:
+                own = {send_idx(r, d, s): (r,)}
+                if s == 0:
+                    val[(r, d, s)] = own
+                else:
+                    src = (r - 1) % world if d == 0 else (r + 1) % world
+                    val[(r, d, s)] = _merge(val[(src, d, s - 1)], own)
+
+    for me in range(world):
+        ev: list = []
+        left, right = (me - 1) % world, (me + 1) % world
+        if world == 1:
+            for d in cols:
+                outputs.append((me, d, {me: (me,)}))
+                ev.append(Ev("consume", me, key=("out", me, d)))
+            events[me] = ev
+            expected[me] = {("out", me, d): 1 for d in cols}
+            continue
+        for s in range(world - 1):
+            for d in cols:
+                if s > 0:
+                    ev.append(Ev("wait_recv", me, sem=("rs", d, s - 1)))
+                ev.append(Ev("signal", me, sem=("rs", d, s),
+                             dst=(right if d == 0 else left)))
+        for d in cols:
+            ev.append(Ev("wait_recv", me, sem=("rs", d, world - 2)))
+            src = left if d == 0 else right
+            outputs.append((me, d,
+                            _merge(val[(src, d, world - 2)],
+                                   {me: (me,)})))
+            ev.append(Ev("consume", me, key=("out", me, d),
+                         guard=("rs", d, world - 2)))
+        expected[me] = {("out", me, d): 1 for d in cols}
+        if all_gather_epilogue:
+            # mirrors the ring AG epilogue: step s forwards the chunk
+            # received at step s-1 (s=0: the locally reduced chunk) and
+            # waits the next arrival.
+            for s in range(world - 1):
+                ev.append(Ev("signal", me,
+                             sem=("arag", (me - s) % world), dst=right))
+                c = (me - s - 1) % world
+                ev.append(Ev("wait_recv", me, sem=("arag", c)))
+                ev.append(Ev("consume", me, key=("agchunk", c),
+                             guard=("arag", c)))
+                expected[me][("agchunk", c)] = 1
+            for s in range(world - 1):
+                ev.append(Ev("wait_send", me,
+                             sem=("arag", (me - s) % world)))
+        for s in range(world - 1):
+            for d in cols:
+                ev.append(Ev("wait_send", me, sem=("rs", d, s)))
+        events[me] = ev
+
+    from triton_dist_tpu.ops import gemm_reduce_scatter
+    op = "gemm_ar" if all_gather_epilogue else "gemm_rs"
+    return Trace(name=f"{op}[w{world} d{dirs}]", world=world, dirs=dirs,
+                 events=events, expected=expected, outputs=outputs,
+                 anchor=_anchor_of(gemm_reduce_scatter._gemm_rs_kernel))
+
+
+# ---------------------------------------------------------------------------
+# Checker
+# ---------------------------------------------------------------------------
+
+def check_trace(trace: Trace) -> list:
+    """All protocol violations in one trace (empty list == verified)."""
+    v: list[Violation] = []
+    events = trace.events
+
+    # --- deadlock: greedy maximal execution -------------------------------
+    # Waits are the only blocking ops and signals are monotonic (each
+    # (dst, sem) counter only grows), so running every rank as far as
+    # it can, repeatedly, reaches THE unique maximal execution: any
+    # rank still blocked there is deadlocked under every schedule.
+    pos = {r: 0 for r in events}
+    sig_recv: Counter = Counter()   # (dst, sem) -> signals executed
+    sig_send: Counter = Counter()   # (src, sem)
+    got_recv: Counter = Counter()
+    got_send: Counter = Counter()
+    progress = True
+    while progress:
+        progress = False
+        for r, evs in events.items():
+            while pos[r] < len(evs):
+                e = evs[pos[r]]
+                if e.kind == "signal":
+                    sig_recv[(e.dst, e.sem)] += 1
+                    sig_send[(r, e.sem)] += 1
+                elif e.kind == "wait_recv":
+                    if got_recv[(r, e.sem)] >= sig_recv[(r, e.sem)]:
+                        break
+                    got_recv[(r, e.sem)] += 1
+                elif e.kind == "wait_send":
+                    if got_send[(r, e.sem)] >= sig_send[(r, e.sem)]:
+                        break
+                    got_send[(r, e.sem)] += 1
+                pos[r] += 1
+                progress = True
+    stuck = {r: events[r][pos[r]] for r in events
+             if pos[r] < len(events[r])}
+    if stuck:
+        blocked = ", ".join(
+            f"rank {r} blocked in {e.kind} on sem {e.sem}"
+            for r, e in sorted(stuck.items()))
+        v.append(Violation(
+            "ring.deadlock",
+            f"{trace.name}: wait-before-signal cycle — {blocked}"))
+
+    # --- signal/wait balance (full traces, independent of execution) ------
+    want_recv: Counter = Counter()
+    want_send: Counter = Counter()
+    have_recv: Counter = Counter()
+    have_send: Counter = Counter()
+    for r, evs in events.items():
+        for e in evs:
+            if e.kind == "signal":
+                have_recv[(e.dst, e.sem)] += 1
+                have_send[(r, e.sem)] += 1
+            elif e.kind == "wait_recv":
+                want_recv[(r, e.sem)] += 1
+            elif e.kind == "wait_send":
+                want_send[(r, e.sem)] += 1
+    for side, have, want in (("recv", have_recv, want_recv),
+                             ("send", have_send, want_send)):
+        for key in sorted(set(have) | set(want), key=repr):
+            if have[key] != want[key]:
+                rank, sem = key
+                v.append(Violation(
+                    "ring.signal_wait_imbalance",
+                    f"{trace.name}: sem {sem} at rank {rank}: "
+                    f"{have[key]} signal(s) vs {want[key]} "
+                    f"wait_{side}(s)"))
+
+    # --- arrival ordering (the static analog of TDT_DETECT_RACES) --------
+    for r, evs in events.items():
+        waited: set = set()
+        for e in evs:
+            if e.kind == "wait_recv":
+                waited.add(e.sem)
+            elif e.kind == "consume" and e.guard is not None \
+                    and e.guard not in waited:
+                v.append(Violation(
+                    "ring.race",
+                    f"{trace.name}: rank {r} consumes {e.key} before "
+                    f"any wait on its delivery sem {e.guard} "
+                    f"(read of an in-flight chunk)"))
+
+    # --- chunk-coverage exactness -----------------------------------------
+    for r, evs in events.items():
+        seen = Counter(e.key for e in evs if e.kind == "consume")
+        want = trace.expected.get(r, {})
+        for key in sorted(set(seen) | set(want), key=repr):
+            if seen[key] != want.get(key, 0):
+                v.append(Violation(
+                    "ring.coverage",
+                    f"{trace.name}: rank {r} consumes tile {key} "
+                    f"{seen[key]}x (expected {want.get(key, 0)}x)"))
+    all_ranks = tuple(range(trace.world))
+    for rank, unit, value in trace.outputs:
+        if set(value) != {rank} or \
+                tuple(sorted(value.get(rank, ()))) != all_ranks:
+            v.append(Violation(
+                "ring.coverage",
+                f"{trace.name}: output chunk {rank} (col unit {unit}) "
+                f"reduces {value!r}, want every rank's partial of "
+                f"chunk {rank} exactly once"))
+    return v
+
+
+def family_traces(world: int, dirs: int, m_tiles: int = 2,
+                  n_blocks: int = 2) -> list:
+    """Every fused-family schedule shape at one (world, dirs)."""
+    return [
+        ag_ring_trace(world, dirs),
+        ag_ring_trace(world, dirs, m_tiles=m_tiles, n_blocks=n_blocks),
+        gemm_rs_trace(world, dirs),
+        gemm_rs_trace(world, dirs, all_gather_epilogue=True),
+    ]
+
+
+def verify_family(worlds=range(1, 9), dirs_list=(1, 2)) -> list:
+    """Model-check every fused-family ring schedule; returns Findings."""
+    findings = []
+    for world in worlds:
+        for dirs in dirs_list:
+            for trace in family_traces(world, dirs):
+                for viol in check_trace(trace):
+                    file, line = trace.anchor
+                    findings.append(Finding(
+                        code=viol.code, message=viol.detail,
+                        file=file, line=line,
+                        pass_name="ring-protocol",
+                        fix_hint=("the schedule this trace mirrors "
+                                  "violates the ring protocol — see "
+                                  "docs/analysis.md 'ring-protocol'")))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Mutators (tests/test_tdt_check.py): known-bad schedule mutants. Each
+# returns a NEW trace; a checker that passes all of them is untested.
+# ---------------------------------------------------------------------------
+
+def _copy(trace: Trace) -> Trace:
+    return dataclasses.replace(
+        trace, events={r: list(evs) for r, evs in trace.events.items()},
+        expected={r: dict(x) for r, x in trace.expected.items()},
+        outputs=list(trace.outputs), name=trace.name + "+mut")
+
+
+def _first(trace: Trace, kind: str, rank=None) -> tuple:
+    for r in sorted(trace.events):
+        if rank is not None and r != rank:
+            continue
+        for i, e in enumerate(trace.events[r]):
+            if e.kind == kind:
+                return r, i
+    raise ValueError(f"no {kind} event in {trace.name}")
+
+
+def drop_first_wait(trace: Trace, rank=None) -> Trace:
+    """Dropped-wait mutant: a chunk is read while still in flight."""
+    t = _copy(trace)
+    r, i = _first(t, "wait_recv", rank)
+    del t.events[r][i]
+    return t
+
+
+def double_signal(trace: Trace, rank=None) -> Trace:
+    """Doubled-signal mutant: a semaphore is left nonzero at exit."""
+    t = _copy(trace)
+    r, i = _first(t, "signal", rank)
+    t.events[r].insert(i, t.events[r][i])
+    return t
+
+
+def shift_consume(trace: Trace, by: int = 1) -> Trace:
+    """Off-by-one chunk-index mutant: one tile consumes the wrong
+    shard (and skips the right one)."""
+    t = _copy(trace)
+    r, i = _first(t, "consume")
+    e = t.events[r][i]
+    chunk = (e.key[0] + by) % t.world
+    guard = (e.guard[0], e.guard[1], chunk) if e.guard else \
+        ("ag", 0, chunk)
+    t.events[r][i] = dataclasses.replace(e, key=(chunk,) + e.key[1:],
+                                         guard=guard)
+    return t
+
+
+def swap_direction(trace: Trace, rank: int = 0) -> Trace:
+    """Swapped-ring-direction mutant: one rank sends every chunk the
+    wrong way round — its neighbors wait on deliveries that never
+    come."""
+    t = _copy(trace)
+    evs = t.events[rank]
+    for i, e in enumerate(evs):
+        if e.kind == "signal":
+            sem = (e.sem[0], 1 - e.sem[1], *e.sem[2:]) \
+                if len(e.sem) > 2 else e.sem
+            w = t.world
+            other = {(rank + 1) % w: (rank - 1) % w,
+                     (rank - 1) % w: (rank + 1) % w}.get(e.dst, e.dst)
+            evs[i] = dataclasses.replace(e, sem=sem, dst=other)
+    return t

@@ -1,0 +1,116 @@
+"""Static VMEM-budget pass: every fused-family autotune candidate must
+fit the declared-footprint cap *before* any compile.
+
+Two rounds of smoke queues were wedged by compile hangs a static
+VMEM/shape check could have rejected pre-compile (ROADMAP item 1).
+This pass closes that hole from two sides:
+
+- :func:`vet_candidate` turns one (op, config, shape) into a Finding
+  when ``tools.perf_model.declared_footprint`` exceeds the cap — the
+  same gate ``tools.autotuner.autotune(vet=...)`` applies to every
+  sweep candidate at runtime, and ``tpu_smoke.py``'s preflight applies
+  before a queue starts.
+- the registered ``vmem-budget`` pass sweeps the FULL candidate tables
+  (``tier_caps=False``, generated against ``TUNED_VMEM_BUDGET``) for
+  representative shapes x worlds 1..8 and flags any entry over
+  ``HARD_FOOTPRINT_CAP`` — a config-generator change that starts
+  emitting uncompilable candidates fails CI, not a smoke queue.
+
+Complementary to ``testing/vmem.assert_vmem_within``: that checker
+intercepts real ``pallas_call``s under ``jax.eval_shape`` (exact for
+the kernel it traces, but it must build the kernel); this one is
+formula-based over config dicts (``perf_model.declared_footprint``),
+so it can sweep whole candidate tables in microseconds with no jax
+tracing at all.
+"""
+
+from __future__ import annotations
+
+from triton_dist_tpu.analysis.findings import Finding
+
+__all__ = ["vet_candidate", "sweep_candidate_tables"]
+
+#: Representative sweep shapes: the bench shape family (docs/perf.md)
+#: at bf16. (m, k, n) are GLOBAL dims; per-op local dims derive from
+#: the world size exactly as the op entries derive them.
+SWEEP_SHAPES = ((4096, 4096, 4096), (8192, 8192, 8192))
+
+
+def _generator_anchor(op: str) -> tuple:
+    """(file, line) of the config generator that emits candidates for
+    ``op`` — the code a ``vmem.over_budget`` finding asks you to
+    change (a pass-wide anchor would let one suppression pragma mute
+    the whole finding class)."""
+    import inspect
+    from triton_dist_tpu.ops import allgather_gemm, gemm_reduce_scatter
+    gen = {"ag_gemm": allgather_gemm.ag_gemm_configs,
+           "ag_swiglu": allgather_gemm.ag_swiglu_configs,
+           "gemm_rs": gemm_reduce_scatter.gemm_rs_configs,
+           "gemm_ar": gemm_reduce_scatter.gemm_rs_configs}.get(op)
+    if gen is None:
+        return None, None
+    try:
+        _, line = inspect.getsourcelines(gen)
+        return inspect.getsourcefile(gen), line
+    except (OSError, TypeError):  # pragma: no cover
+        return None, None
+
+
+def vet_candidate(op: str, cfg: dict, *, cap: int | None = None,
+                  **dims) -> Finding | None:
+    """One candidate's static VMEM verdict (None == fits)."""
+    from triton_dist_tpu.tools import perf_model as _pm
+    reason = _pm.vet_vmem(op, cfg, cap=cap, **dims)
+    if reason is None:
+        return None
+    file, line = _generator_anchor(op)
+    return Finding(
+        code="vmem.over_budget", message=reason, file=file,
+        line=line, pass_name="vmem-budget",
+        fix_hint="shrink block_m/block_n/block_k or drop the config "
+                 "from the table; HARD_FOOTPRINT_CAP rationale in "
+                 "ops/common.py")
+
+
+def sweep_candidate_tables(worlds=range(1, 9)) -> list:
+    """Findings for every over-cap candidate any config table emits at
+    the representative shapes (empty == every sweep the autotuner
+    could run compiles under the cap)."""
+    from triton_dist_tpu.ops.allgather_gemm import (
+        ag_gemm_configs, ag_swiglu_configs)
+    from triton_dist_tpu.ops.common import TUNED_VMEM_BUDGET
+    from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs_configs
+
+    item = 2  # bf16 — the fused family's serving dtype
+    findings = []
+    for world in worlds:
+        for m, k, n in SWEEP_SHAPES:
+            rows = m // world
+            n_loc = n // world
+            k_loc = k // world
+            if not (rows and n_loc and k_loc):
+                continue
+            for cfg in ag_gemm_configs(m, rows, k, n_loc, item,
+                                       TUNED_VMEM_BUDGET,
+                                       tier_caps=False):
+                f = vet_candidate("ag_gemm", cfg, rows=rows, m=m, k=k,
+                                  n_loc=n_loc, itemsize=item,
+                                  world=world)
+                if f:
+                    findings.append(f)
+            for cfg in ag_swiglu_configs(rows, k, n_loc, item,
+                                         TUNED_VMEM_BUDGET,
+                                         tier_caps=False):
+                f = vet_candidate("ag_swiglu", cfg, rows=rows, k=k,
+                                  itemsize=item)
+                if f:
+                    findings.append(f)
+            for cfg in gemm_rs_configs(m, rows, k_loc, n, item, world,
+                                       TUNED_VMEM_BUDGET,
+                                       tier_caps=False):
+                f = vet_candidate("gemm_rs", cfg, rows=rows, m=m,
+                                  k_loc=k_loc, n=n, itemsize=item,
+                                  world=world)
+                if f:
+                    findings.append(f)
+    return findings

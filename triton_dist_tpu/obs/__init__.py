@@ -41,6 +41,7 @@ from triton_dist_tpu.obs.registry import (  # noqa: F401
     disable,
     enable,
     enabled,
+    env_int,
     gauge,
     get_registry,
     histogram,

@@ -72,13 +72,10 @@ DEFAULT_RING_CAPACITY = 32768
 
 
 def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name, "").strip()
-    if not v:
-        return default
-    try:
-        return int(v)
-    except ValueError:
-        raise ValueError(f"{name} must be an integer: {v!r}") from None
+    # Lazy: registry imports this module at load, so the shared parser
+    # is reached at call time, when both modules exist.
+    from triton_dist_tpu.obs.registry import env_int
+    return env_int(name, default)
 
 
 def env_enabled(default: bool = False) -> bool:

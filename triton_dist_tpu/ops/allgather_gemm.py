@@ -631,7 +631,10 @@ def _autotune_ag_gemm(a, bs, ctx, key, n_tot_loc):
         return make_perturbed_runner(fn, a, list(bs))
 
     result = autotune(make_fn, cfgs, key=f"ag_gemm:{key}", iters=8,
-                      warmup_iters=2)
+                      warmup_iters=2,
+                      vet=lambda c: _pm.vet_vmem(
+                          "ag_gemm", c, rows=rows, m=m, k=k,
+                          n_loc=n_tot_loc, itemsize=item, world=world))
     _TUNED[key] = result.config
     return result.config
 
@@ -940,7 +943,10 @@ def _autotune_ag_swiglu(a, w_gate, w_up, ctx, key):
         return make_perturbed_runner(fn, a, w_gate, w_up)
 
     result = autotune(make_fn, cfgs, key=f"ag_swiglu:{key}", iters=8,
-                      warmup_iters=2)
+                      warmup_iters=2,
+                      vet=lambda c: _pm.vet_vmem(
+                          "ag_swiglu", c, rows=rows, k=k,
+                          itemsize=item))
     _TUNED[key] = result.config
     return result.config
 

@@ -297,17 +297,14 @@ def resolve_ring_dirs(ring_dirs: int = 0) -> int:
     round-5-measured schedule stays selectable without code changes)
     and falls back to 2.
     """
-    import os
     if ring_dirs not in (0, 1, 2):
         raise ValueError(f"ring_dirs must be 0 (auto), 1 or 2: {ring_dirs}")
     if ring_dirs:
         return ring_dirs
-    env = os.environ.get("TDT_RING_DIRS", "").strip()
-    if env:
-        if env not in ("1", "2"):
-            raise ValueError(f"TDT_RING_DIRS must be 1 or 2: {env!r}")
-        return int(env)
-    return 2
+    env = obs.env_int("TDT_RING_DIRS", 2)
+    if env not in (1, 2):
+        raise ValueError(f"TDT_RING_DIRS must be 1 or 2: {env!r}")
+    return env
 
 
 def ring_hop_counts(world: int, dirs: int) -> tuple[int, int]:

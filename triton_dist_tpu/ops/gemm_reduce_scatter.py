@@ -175,7 +175,11 @@ def _autotune_gemm_rs(a, b, ctx, key, all_gather_epilogue):
         return make_perturbed_runner(fn, a, b)
 
     result = autotune(make_fn, cfgs, key=f"gemm_rs:{key}", iters=8,
-                      warmup_iters=2)
+                      warmup_iters=2,
+                      vet=lambda c: _pm.vet_vmem(
+                          "gemm_ar" if all_gather_epilogue else
+                          "gemm_rs", c, rows=rows, m=m, k_loc=k_loc,
+                          n=n, itemsize=item, world=world))
     _TUNED[key] = result.config
     return result.config
 

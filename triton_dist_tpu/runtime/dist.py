@@ -99,7 +99,7 @@ def _initialize_with_retry(coord: str, nproc: int, pid: int,
     from triton_dist_tpu.testing import faults
 
     if retries is None:
-        retries = int(os.environ.get("TDT_DIST_INIT_RETRIES", "5"))
+        retries = obs.env_int("TDT_DIST_INIT_RETRIES", 5, minimum=0)
     if backoff_s is None:
         backoff_s = float(os.environ.get("TDT_DIST_INIT_BACKOFF_S",
                                          "0.5"))

@@ -72,7 +72,6 @@ from __future__ import annotations
 
 import collections
 import contextlib
-import os
 import threading
 import time
 import warnings
@@ -146,14 +145,16 @@ class Scheduler:
         self.engine = engine
         self.params = params
         if max_waiting is None:
-            max_waiting = int(os.environ.get("TDT_MAX_WAITING",
-                                             DEFAULT_MAX_WAITING))
+            max_waiting = obs.env_int("TDT_MAX_WAITING",
+                                      DEFAULT_MAX_WAITING)
         if max_waiting <= 0:
             raise ValueError(f"max_waiting must be positive: {max_waiting}")
         self.max_waiting = max_waiting
         if prefill_chunk is None:
-            v = os.environ.get("TDT_PREFILL_CHUNK", "").strip()
-            prefill_chunk = int(v) if v else None
+            # minimum=1 keeps "0" an error (like any non-positive
+            # chunk); the unset default never hits the minimum check.
+            prefill_chunk = obs.env_int("TDT_PREFILL_CHUNK", 0,
+                                        minimum=1) or None
         if prefill_chunk is not None and prefill_chunk <= 0:
             raise ValueError(
                 f"prefill_chunk must be positive: {prefill_chunk}")

@@ -197,7 +197,9 @@ def consult_disk_for_trace(key: str) -> "TuneResult | None":
 
 def autotune(make_fn: Callable[..., Callable], configs: Sequence[dict],
              key: str | None = None, iters: int = 20,
-             warmup_iters: int = 5) -> TuneResult:
+             warmup_iters: int = 5,
+             vet: Callable[[dict], "str | None"] | None = None
+             ) -> TuneResult:
     """Pick the fastest config.
 
     Args:
@@ -207,6 +209,12 @@ def autotune(make_fn: Callable[..., Callable], configs: Sequence[dict],
         ``matmul_get_configs`` allgather_gemm.py:396).
       key: cache key — one sweep per key per process (reference caches on
         the Autotuner instance).
+      vet: optional static candidate gate (config → rejection reason or
+        None), e.g. ``perf_model.vet_vmem`` bound to the sweep shape.
+        Rejected candidates never reach ``make_fn`` — no compile is
+        invoked for them (``autotune.candidates_rejected_static``;
+        docs/analysis.md "vmem-budget"). Deterministic, so every rank
+        rejects the same set and the sweep stays SPMD-agreed.
     Returns the winning TuneResult (same on every process).
 
     Failure isolation: a config that raises scores inf (skipped, like
@@ -217,6 +225,31 @@ def autotune(make_fn: Callable[..., Callable], configs: Sequence[dict],
     the sweep itself — only configs whose failures are deterministic
     across ranks are fully safe to list.
     """
+    from triton_dist_tpu import obs
+    if vet is not None:
+        # BEFORE any cache consult: a persisted winner from a sweep
+        # that predates the vet (or a footprint-model fix) must fail
+        # the staleness membership check below against the VETTED
+        # list, not be resurrected unvetted. Deterministic, so every
+        # rank rejects the same set and the sweep stays SPMD-agreed.
+        kept = []
+        for cfg in configs:
+            reason = vet(dict(cfg))
+            if reason is None:
+                kept.append(cfg)
+                continue
+            import logging
+            logging.getLogger("triton_dist_tpu.autotuner").warning(
+                "autotune %s: candidate rejected statically: %s",
+                key, reason)
+            if obs.enabled():
+                obs.counter("autotune.candidates_rejected_static").inc()
+        if not kept:
+            raise ValueError(
+                f"autotune {key!r}: every candidate was rejected by "
+                f"the static vet — the config table and the vet "
+                f"disagree (docs/analysis.md)")
+        configs = kept
     if key is not None and key in _CACHE:
         return _CACHE[key]
     if key is not None:
@@ -259,7 +292,6 @@ def autotune(make_fn: Callable[..., Callable], configs: Sequence[dict],
             _CACHE[key] = hit
             return hit
 
-    from triton_dist_tpu import obs
     times = []
     errors = []
     for cfg in configs:

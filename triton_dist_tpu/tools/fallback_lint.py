@@ -1,113 +1,30 @@
-"""Escape-hatch lint: no public op entry ships without a fallback.
+"""Escape-hatch lint — DEPRECATION SHIM.
 
-The resilience contract (docs/resilience.md) is that EVERY public op
-entry in ``ops/`` — every module-level function with an ``impl``
-parameter — carries the ``@resilient`` decorator registering its XLA
-reference path with the fallback router, so a new op cannot merge
-without an escape hatch. This lint enforces that statically: it walks
-the AST of every ``ops/*.py``, collects the public ``impl``-taking
-functions, and fails unless each is either resilient-decorated or a
-documented delegate of one that is.
-
-Wired into the quick tier via tests/test_fallback_lint.py; also
-runnable standalone::
+The check lives in the static-analysis framework now
+(``triton_dist_tpu.analysis.lint_fallback``, run by
+``python -m triton_dist_tpu.tools.tdt_check`` as the
+``fallback-coverage`` pass, with ``file:line``-anchored findings).
+This module keeps the original entry points working::
 
     python -m triton_dist_tpu.tools.fallback_lint
+
+``missing_fallbacks()`` returns the same message strings it always
+did; prefer the pass API (findings with anchors) in new code.
 """
 
 from __future__ import annotations
 
-import ast
-import importlib
 import sys
-from pathlib import Path
+
+from triton_dist_tpu.analysis.lint_fallback import (  # noqa: F401
+    DELEGATES, EXCLUDED_MODULES, collect_findings)
 
 __all__ = ["DELEGATES", "EXCLUDED_MODULES", "missing_fallbacks", "main"]
 
-#: Entries that intentionally carry no decorator of their own because
-#: they are thin forwards into a decorated entry (the registered op
-#: name on the right). The lint verifies the target op IS registered.
-DELEGATES = {
-    # ag_gemm(a, b) == ag_gemm_multi(a, [b]) — single-b sugar.
-    "allgather_gemm.ag_gemm": "ag_gemm",
-    # fp8 wire wrapper: quantize → fast_all_to_all → dequantize; the
-    # custom_vjp object cannot wear the wrapper, and routing happens
-    # at the inner (decorated) exchange anyway.
-    "all_to_all.fast_all_to_all_fp8": "all_to_all",
-}
 
-#: Modules exempt wholesale: ``autodiff`` re-exports forward-identical
-#: custom_vjp wrappers that CALL the decorated entries (double-routing
-#: them would re-run the router inside its own fallback).
-EXCLUDED_MODULES = {"autodiff"}
-
-
-def _impl_functions(tree: ast.Module):
-    """(name, has_resilient_decorator) for public module-level defs
-    taking an ``impl`` parameter."""
-    for node in tree.body:
-        if not isinstance(node, ast.FunctionDef):
-            continue
-        if node.name.startswith("_"):
-            continue
-        argnames = [a.arg for a in (node.args.args
-                                    + node.args.kwonlyargs)]
-        if "impl" not in argnames:
-            continue
-        decorated = False
-        for dec in node.decorator_list:
-            target = dec.func if isinstance(dec, ast.Call) else dec
-            name = (target.attr if isinstance(target, ast.Attribute)
-                    else getattr(target, "id", None))
-            if name == "resilient":
-                decorated = True
-        yield node.name, decorated
-
-
-def missing_fallbacks() -> list[str]:
+def missing_fallbacks() -> list:
     """Entries violating the contract (empty list == clean)."""
-    import triton_dist_tpu.ops as ops_pkg
-    from triton_dist_tpu.resilience import registered_fallbacks
-
-    ops_dir = Path(ops_pkg.__file__).parent
-    problems: list[str] = []
-    candidates: list[tuple[str, str, bool]] = []
-    for py in sorted(ops_dir.glob("*.py")):
-        if py.stem.startswith("_") or py.stem in EXCLUDED_MODULES:
-            continue
-        tree = ast.parse(py.read_text(), filename=str(py))
-        for name, decorated in _impl_functions(tree):
-            candidates.append((py.stem, name, decorated))
-
-    # Import the modules so the decorators have run and the router
-    # registry is populated, then cross-check both directions.
-    for mod in sorted({m for m, _, _ in candidates}):
-        importlib.import_module(f"triton_dist_tpu.ops.{mod}")
-    registered = registered_fallbacks()
-    entry_to_op = {spec.entry.rsplit("triton_dist_tpu.ops.", 1)[-1]: op
-                   for op, spec in registered.items()}
-
-    for mod, name, decorated in candidates:
-        qual = f"{mod}.{name}"
-        if decorated:
-            if qual not in entry_to_op:
-                problems.append(
-                    f"{qual}: @resilient present in source but no "
-                    f"registration reached the router (import-order "
-                    f"or decorator bug?)")
-            continue
-        delegate_op = DELEGATES.get(qual)
-        if delegate_op is None:
-            problems.append(
-                f"{qual}: public op entry with an impl= parameter but "
-                f"no @resilient decorator and no DELEGATES entry — "
-                f"every op needs an XLA escape hatch "
-                f"(docs/resilience.md)")
-        elif delegate_op not in registered:
-            problems.append(
-                f"{qual}: delegates to op {delegate_op!r}, which is "
-                f"not registered with the fallback router")
-    return problems
+    return [f.message for f in collect_findings()]
 
 
 def main(argv=None) -> int:

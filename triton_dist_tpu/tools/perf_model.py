@@ -358,6 +358,66 @@ def prune_configs(cfgs, cost_ms_fn, *, factor: int = 4,
     return [cfgs[i] for i in picked], n_before
 
 
+def declared_footprint(op: str, cfg: dict, *, rows: int,
+                       itemsize: int = 2, world: int = 1,
+                       m: int | None = None, k: int | None = None,
+                       k_loc: int | None = None, n: int | None = None,
+                       n_loc: int | None = None) -> int:
+    """Declared VMEM bytes of one fused-family candidate config — the
+    number the per-op clamps compare against ``DEFAULT_VMEM_BUDGET`` /
+    ``HARD_FOOTPRINT_CAP`` (ops/common.py). Delegates to the kernels'
+    own footprint helpers where they exist so this stays a single
+    source of truth; the inline vmem/k-tiled formulas mirror the
+    config generators (``ag_gemm_configs`` / ``gemm_rs_configs``).
+
+    Used by the static analysis vet (``triton_dist_tpu.analysis.vmem``)
+    and the autotuner's pre-compile candidate gate — an over-budget
+    config is rejected from Python, before Mosaic ever sees it."""
+    variant = cfg.get("variant", "hbm")
+    bm = cfg.get("block_m", 256)
+    bn = cfg.get("block_n", 512)
+    bk = cfg.get("block_k", 256)
+    if op in ("ag_gemm", "ag_swiglu"):
+        from triton_dist_tpu.ops.allgather_gemm import (
+            _hbm_footprint, _swiglu_footprint)
+        if op == "ag_swiglu":
+            return _swiglu_footprint(bm, bn, k, itemsize)
+        if variant == "vmem":
+            return itemsize * (m * k + k * n_loc + m * n_loc + rows * k)
+        if variant == "hbm":
+            return _hbm_footprint(bm, bn, k, itemsize)
+        return (2 * bm * bk + 2 * bk * n_loc) * itemsize \
+            + bm * n_loc * (4 + 2 * itemsize)
+    if op in ("gemm_rs", "gemm_ar"):
+        from triton_dist_tpu.ops.gemm_reduce_scatter import (
+            _hbm_nb_footprint)
+        if variant == "vmem":
+            return itemsize * (m * k_loc + k_loc * n + rows * n
+                               + 2 * max(world - 1, 1) * rows * n)
+        if variant == "hbm":
+            return _hbm_nb_footprint(bm, bn, k_loc, itemsize)
+        return (2 * bm * bk + 2 * bk * n) * itemsize \
+            + bm * n * (4 + 3 * itemsize)
+    raise ValueError(f"no footprint model for op {op!r}")
+
+
+def vet_vmem(op: str, cfg: dict, *, cap: int | None = None,
+             **dims) -> str | None:
+    """Static VMEM gate for one autotune candidate: a rejection reason
+    when the declared footprint exceeds ``cap`` (default
+    ``HARD_FOOTPRINT_CAP``), else ``None``. Pure Python — no compile
+    is invoked, so a config that would wedge a Mosaic compile (the
+    BENCH_r02 / smoke-queue class) is refused up front."""
+    if cap is None:
+        from triton_dist_tpu.ops.common import HARD_FOOTPRINT_CAP
+        cap = HARD_FOOTPRINT_CAP
+    fp = declared_footprint(op, cfg, **dims)
+    if fp > cap:
+        return (f"{op} config {cfg} declares {fp / 2**20:.1f} MB VMEM "
+                f"> {cap / 2**20:.1f} MB cap")
+    return None
+
+
 def overlap_efficiency(gemm_ms: float, comm_ms: float) -> float:
     """Upper bound on fused-op gain: serial/(overlapped) time ratio. 1.0 =
     no win, 2.0 = perfect hiding of the shorter phase (the BASELINE.md

@@ -1,0 +1,74 @@
+"""``tdt-check`` driver: run the static-analysis passes over the repo.
+
+Usage::
+
+    python -m triton_dist_tpu.tools.tdt_check            # all passes
+    python -m triton_dist_tpu.tools.tdt_check --list
+    python -m triton_dist_tpu.tools.tdt_check --json
+    python -m triton_dist_tpu.tools.tdt_check --pass ring-protocol \
+        --pass vmem-budget
+
+Exits nonzero when any error-severity finding survives suppression
+(``# tdt: ignore[...]`` pragmas, docs/analysis.md). The quick tier
+runs this over the repo (tests/test_tdt_check.py) and ``tpu_smoke.py``
+calls :func:`preflight` before queuing any case, so a ring-protocol or
+VMEM-budget regression is rejected before a compile can wedge a smoke
+queue.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from triton_dist_tpu.analysis import (
+    PASSES, exit_code, render_human, render_json, run_passes)
+
+__all__ = ["main", "preflight"]
+
+
+def preflight(names=None, out=None) -> int:
+    """Smoke-queue preflight: run the passes, print findings, return
+    the would-be exit code. Cheap (pure Python, no compile) — a
+    protocol violation or an over-budget candidate table stops the
+    queue before the first Mosaic compile."""
+    out = out or sys.stdout
+    findings = run_passes(names=names)
+    print(render_human(findings, n_passes=len(names or PASSES)),
+          file=out)
+    return exit_code(findings)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tdt_check",
+        description="static ring-protocol verifier + repo contract "
+                    "lints (docs/analysis.md)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered passes and exit")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    metavar="NAME",
+                    help="run only this pass (repeatable)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: derived from the "
+                         "installed package)")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for p in PASSES.values():
+            print(f"{p.name}: {p.description}")
+        return 0
+
+    findings = run_passes(root=args.root, names=args.passes)
+    if args.json:
+        print(render_json(findings))
+    else:
+        print(render_human(
+            findings, n_passes=len(args.passes or PASSES)))
+    return exit_code(findings)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
