@@ -50,7 +50,18 @@ QUEUE = [
      {"TDT_BENCH_BUDGET_S": "2100",
       "TDT_BENCH_PARTS": "sp_attn,mega,train",
       "TDT_BENCH_PROGRESS":
-          os.path.join(ROOT, ".bench_progress_gapfill.json")}),
+          os.path.join(ROOT, ".bench_progress_gapfill.json"),
+      "TDT_DEVPROF_DIR": os.path.join(ARTIFACTS, "devprof_gapfill")}),
+    # Post-bench device-profile validation (ISSUE 10): every capture
+    # the bench step left must parse back through obs.devprof —
+    # rc!=0 on an unparseable one, the same contract as the trace
+    # validator. Host-side only, no tunnel contact. (The gapfill
+    # parts carry no fused-family profile, so an empty dir passes;
+    # the headline step's dir must hold them.)
+    ("profile_validate_gapfill",
+     [sys.executable, "-m", "triton_dist_tpu.tools.profile_export",
+      "--validate", os.path.join(ARTIFACTS, "devprof_gapfill")],
+     300.0, {"JAX_PLATFORMS": "cpu"}),
     # Position 2: headline re-run with the round-5 kernel changes
     # (24 MB default tile budget, large-tile sweep space, chained
     # sweep timing). Sweeps are now ~15 Mosaic compiles per GEMM op
@@ -61,7 +72,16 @@ QUEUE = [
      {"TDT_BENCH_BUDGET_S": "3000",
       "TDT_BENCH_PARTS": "ag_gemm,gemm_rs,gemm_ar,flash_decode,tp_mlp",
       "TDT_BENCH_PROGRESS":
-          os.path.join(ROOT, ".bench_progress_headline2.json")}),
+          os.path.join(ROOT, ".bench_progress_headline2.json"),
+      "TDT_DEVPROF_DIR": os.path.join(ARTIFACTS, "devprof_headline2")}),
+    # The headline step benches the fused family, so its devprof dir
+    # MUST hold parseable captures (--require): measured overlap
+    # evidence is the point of the next chip window (ROADMAP item 5).
+    ("profile_validate_headline2",
+     [sys.executable, "-m", "triton_dist_tpu.tools.profile_export",
+      "--validate", "--require",
+      os.path.join(ARTIFACTS, "devprof_headline2")],
+     300.0, {"JAX_PLATFORMS": "cpu"}),
     # Position 3: the full smoke queue. The former flash_decode/paged
     # DIRECT-kernel canary — the round-5 wedge trigger the old queue
     # had to --start-after / --skip / quarantine at position 5 — is

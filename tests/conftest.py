@@ -72,12 +72,19 @@ def _isolate_trace(monkeypatch, tmp_path):
     monkeypatch.delenv("TDT_TRACE", raising=False)
     monkeypatch.delenv("TDT_FLIGHT_SECONDS", raising=False)
     monkeypatch.setenv("TDT_TRACE_DIR", str(tmp_path / "traces"))
-    from triton_dist_tpu.obs import flight, trace
+    # Device-profile captures isolate the same way: per-test artifact
+    # dir, sampler knobs cleared, armed/last-profile state reset.
+    monkeypatch.delenv("TDT_DEVPROF_EVERY", raising=False)
+    monkeypatch.delenv("TDT_DEVPROF_ON_BREACH", raising=False)
+    monkeypatch.setenv("TDT_DEVPROF_DIR", str(tmp_path / "devprof"))
+    from triton_dist_tpu.obs import devprof, flight, trace
     trace.reset()
     flight.reset()
+    devprof.reset()
     yield
     trace.reset()
     flight.reset()
+    devprof.reset()
 
 
 @pytest.fixture(autouse=True)

@@ -492,3 +492,48 @@ def test_top_render_dashboard_sections():
     assert "BREACH" in top.render(snap)
     assert "(no serving metrics yet)" in top.render(
         {"gauges": {}, "counters": {}})
+
+
+def test_top_and_report_render_device_time_section():
+    """tools/top.py + tools/report.py: the device-time truth gauges
+    (obs.devprof) render as their own section — measured per-op
+    compute/comm, overlap + drift, unlabeled warning, last profile
+    path (docs/observability.md "Device-time truth")."""
+    from triton_dist_tpu.tools import report, top
+    snap = {
+        "gauges": {
+            "device.ag_gemm.total_ms": 2.0,
+            "device.ag_gemm.compute_ms": 1.2,
+            "device.ag_gemm.comm_ms": 0.8,
+            "device.step.total_ms": 5.0,
+            "device.step.compute_ms": 4.0,
+            "device.step.comm_ms": 0.0,
+            "device.unlabeled_ms": 0.25,
+            "comms.ag_gemm.overlap_pct_measured": 50.0,
+            "comms.ag_gemm.exposed_comm_ms_measured": 0.4,
+            "comms.ag_gemm.overlap_drift_pct": -40.0,
+        },
+        "counters": {"profile.captures": 3, "profile.parsed": 3},
+        "devprof": {"last_profile": "/tmp/x/pump_1/host0",
+                    "last_reason": "breach_slo_ttft_p99",
+                    "ops": ["ag_gemm", "step"]},
+    }
+    out = top.render(snap)
+    assert "device time (measured)" in out
+    assert "ag_gemm" in out and "overlap 50" in out
+    assert "drift -40" in out
+    assert "step" in out
+    assert "annotation-coverage" in out          # unlabeled warning
+    assert "/tmp/x/pump_1/host0" in out
+    md = report.render_devprof(snap, snap["devprof"])
+    assert "#### device time (measured)" in md
+    assert "comms.ag_gemm.overlap_drift_pct" in md and "-40" in md
+    assert "profile.captures" in md
+    assert "last_profile" in md and "breach_slo_ttft_p99" in md
+    assert "annotation-coverage" in md           # unlabeled warning
+    # The telemetry renderer routes device.*/profile.* rows into the
+    # section instead of duplicating them in the scalar table.
+    full = report.render_telemetry(snap)
+    assert full.count("device.ag_gemm.total_ms") == 1
+    # No devprof metrics at all → no section.
+    assert report.render_devprof({"gauges": {}, "counters": {}}) == ""

@@ -190,6 +190,46 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
         print(f"  {results[-1][0]:<28} {results[-1][1]:<9} "
               f"{results[-1][2]}", flush=True)
 
+    # Device-profile capture for the fused-family cases (ISSUE 10,
+    # docs/perf.md "Overlap accounting" measured tier): each wrapped
+    # case runs under jax.profiler and the capture is parsed back via
+    # obs.devprof — the end-of-run PROFILE lines carry measured
+    # compute/comm attribution per op, and an unparseable capture
+    # fails the run (same contract as the TRACE artifact).
+    prof_results: dict[str, dict] = {}
+
+    def profiled(op, fn):
+        if list_only or export_lint:
+            return fn
+
+        def wrapped():
+            from triton_dist_tpu.obs import devprof
+            from triton_dist_tpu.tools.profiler import group_profile
+            try:
+                cm = group_profile(f"smoke_{op.replace('/', '_')}",
+                                   devprof.devprof_dir())
+                cap = cm.__enter__()
+            except Exception as e:  # noqa: BLE001 — still smoke the op
+                prof_results[op] = {
+                    "error": f"capture failed: {type(e).__name__}: {e}"}
+                return fn()
+            try:
+                out = fn()
+                jax.block_until_ready(out)
+            finally:
+                cm.__exit__(None, None, None)
+            try:
+                summary = devprof.parse_capture(cap.path)
+                devprof.publish(summary)
+                prof_results[op] = {"path": cap.path,
+                                    "summary": summary}
+            except Exception as e:  # noqa: BLE001 — reported, fails the run
+                prof_results[op] = {
+                    "path": cap.path,
+                    "error": f"{type(e).__name__}: {e}"}
+            return out
+        return wrapped
+
     if list_only or export_lint:
         # Name-collection and export-lint run on CPU (work even while
         # the TPU tunnel is wedged); export-lint lowers each case FOR
@@ -284,7 +324,8 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     bb = sharded(randn((4096, 4096), k=13), P(None, "tp"))
     bench_ctx = create_ag_gemm_context(mesh, "tp", interpret=interpret)
     case("ag_gemm/bench_shape",
-         lambda: ag_gemm(ab, bb, bench_ctx, impl="pallas"))
+         profiled("ag_gemm",
+                  lambda: ag_gemm(ab, bb, bench_ctx, impl="pallas")))
     inj_ctx = create_ag_gemm_context(mesh, "tp", interpret=interpret)
     inj_ctx.for_correctness = True
     inj_ctx.straggler_option = (0, 10000)
@@ -299,7 +340,9 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     bu = sharded(randn((4096, 4096), k=17), P(None, "tp"))
     sw_bench_ctx = create_ag_gemm_context(mesh, "tp", interpret=interpret)
     case("ag_swiglu/bench_shape",
-         lambda: ag_swiglu(ab, bb, bu, sw_bench_ctx, impl="pallas"))
+         profiled("ag_swiglu",
+                  lambda: ag_swiglu(ab, bb, bu, sw_bench_ctx,
+                                    impl="pallas")))
 
     from triton_dist_tpu.ops.gemm_reduce_scatter import (
         create_gemm_rs_context, gemm_rs, gemm_ar)
@@ -311,12 +354,16 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
     a_rsb = sharded(randn((2048, 4096)), P(None, "tp"))
     b_rsb = sharded(randn((4096, 4096), k=14), P("tp"))
     case("gemm_rs/bench_shape",
-         lambda: gemm_rs(a_rsb, b_rsb, rs_ctx2, impl="pallas"))
+         profiled("gemm_rs",
+                  lambda: gemm_rs(a_rsb, b_rsb, rs_ctx2,
+                                  impl="pallas")))
     # Decode GEMM-AR at production width via the hbm epilogue path
     # (VERDICT r2 next 5).
     a_ar = sharded(randn((128, 4096)), P(None, "tp"))
     case("gemm_ar/decode_shape",
-         lambda: gemm_ar(a_ar, b_rsb, rs_ctx2, impl="pallas"))
+         profiled("gemm_ar",
+                  lambda: gemm_ar(a_ar, b_rsb, rs_ctx2,
+                                  impl="pallas")))
 
     # --- EP / MoE ---------------------------------------------------------
     from triton_dist_tpu.ops.all_to_all import (
@@ -635,6 +682,31 @@ def run_smoke(log_path: str | None = None, only: str | None = None,
             n_fail += 1
     except Exception as e:  # noqa: BLE001 — the artifact must not fail the run
         lines.append(f"TRACE export failed: {type(e).__name__}: {e}")
+    # Measured device-time attribution per fused-family op (parsed
+    # back from the per-case jax.profiler captures). An unparseable
+    # capture IS a failure: the next chip window's overlap numbers
+    # must be machine-recorded, not eyeballed (ROADMAP item 5).
+    for op in sorted(prof_results):
+        rec = prof_results[op]
+        if "error" in rec or "summary" not in rec:
+            lines.append(f"PROFILE {op} INVALID "
+                         f"{rec.get('error', 'no summary')} "
+                         f"({rec.get('path', '-')})")
+            n_fail += 1
+            continue
+        m = rec["summary"].get("ops", {}).get(op)
+        if m is None:
+            lines.append(
+                f"PROFILE {op} UNATTRIBUTED (no device.{op} label in "
+                f"window — see tdt-check annotation-coverage) "
+                f"({rec['path']})")
+            n_fail += 1
+            continue
+        ov = (f"overlap_measured {m['overlap_pct']}%"
+              if m["overlap_pct"] is not None
+              else "overlap_requires_chip (no comm in window)")
+        lines.append(f"PROFILE {op} compute {m['compute_ms']} ms "
+                     f"comm {m['comm_ms']} ms {ov} ({rec['path']})")
     lines.append(f"TOTAL {len(results)} ops, {n_fail} failing")
     report = "\n".join(lines)
     print(report)
@@ -776,10 +848,27 @@ def run_subproc(log_path: str, timeout_s: float,
         # detected ones; anything else resets the streak.
         consecutive_hangs = (consecutive_hangs + 1
                              if status == "TIMEOUT" else 0)
+        # Forward the child's PROFILE lines (the per-case device-
+        # capture evidence) into the parent report; an INVALID /
+        # UNATTRIBUTED capture fails the RUN even though the case's
+        # kernel passed — the parent scores cases from their result
+        # line, not the child rc, so the capture contract must be
+        # re-applied here.
+        profile_lines = []
+        try:
+            with open(out_path) as f:
+                profile_lines = [ln for ln in f.read().splitlines()
+                                 if ln.startswith("PROFILE ")]
+        except OSError:
+            pass
         if not hung:
             os.unlink(out_path)
         n_fail += status != "PASS"
         emit(f"{name:<28} {status:<9} {dt:.0f}s {detail}")
+        for ln in profile_lines:
+            emit(ln)
+            if " INVALID " in ln or " UNATTRIBUTED " in ln:
+                n_fail += 1
         if consecutive_hangs >= 2:
             emit("second consecutive hang — tunnel wedged, run stops")
             stopped = True

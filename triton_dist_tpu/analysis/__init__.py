@@ -25,7 +25,12 @@ Built-in passes:
   (:mod:`.lint_trace`);
 - ``fallback-coverage`` — every public op entry has a registered XLA
   escape hatch (:mod:`.lint_fallback`, migrated from
-  ``tools/fallback_lint.py``).
+  ``tools/fallback_lint.py``);
+- ``annotation-coverage`` — every ``@resilient`` invocation executes
+  under a ``device.<op>.*`` profiler label and the pump sampler keeps
+  its ``device.step`` window, so ``obs.devprof``'s measured
+  attribution never silently reads empty windows
+  (:mod:`.lint_annotations`).
 """
 
 from __future__ import annotations
@@ -142,3 +147,11 @@ def _trace_pass(root):
 def _fallback_pass(root):
     from triton_dist_tpu.analysis import lint_fallback
     return lint_fallback.collect_findings()
+
+
+@register_pass("annotation-coverage",
+               "every @resilient invocation runs under a device.<op>.* "
+               "profiler label; the pump sampler keeps device.step")
+def _annotation_pass(root):
+    from triton_dist_tpu.analysis import lint_annotations
+    return lint_annotations.run(root)

@@ -1,0 +1,236 @@
+"""Annotation-coverage pass: every fused-op invocation executes under
+a ``device.<op>.*`` profiler label the devprof parser can attribute.
+
+``obs.devprof`` (docs/observability.md "Device-time truth") keys its
+MEASURED per-op attribution on the ``TraceAnnotation`` labels the
+resilience router plants around each ``@resilient`` invocation
+(``device.<op>.<branch>``) and the serving pump sampler plants around
+a profiled iteration (``device.step``). Those labels are load-bearing:
+strip one and the parser does not fail — it silently books the op's
+device time as ``device.unlabeled_ms`` and every
+``*_overlap_pct_measured`` number quietly reads from an empty window.
+This pass makes that failure mode a CI error instead of a silent
+mis-attribution:
+
+- ``devprof.unlabeled`` — the router's per-invocation binder
+  (``call`` inside :func:`resilient`) no longer wraps the entry
+  invocation in an annotate call whose label starts with
+  ``device.`` (mutation test: strip the ``with`` → this finding).
+- ``devprof.step_unlabeled`` — the pump sampler's iteration wrapper
+  no longer plants :data:`obs.devprof.STEP_LABEL`, or the scheduler
+  pump no longer routes its engine work through ``.iteration()``.
+- ``devprof.bad_op_label`` — a ``@resilient`` op name contains a dot,
+  which would corrupt the ``device.<op>.*`` metric prefix the parser
+  derives from label segment 2.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from triton_dist_tpu.analysis.findings import Finding
+
+__all__ = ["check_router", "check_sampler", "collect_resilient_ops",
+           "run"]
+
+_ANNOTATE_NAMES = ("annotate", "_op_annotation", "TraceAnnotation")
+
+
+def _is_device_annotate(call: ast.Call) -> bool:
+    """Does ``call`` produce a ``device.``-prefixed profiler label?
+
+    Accepts ``annotate(f"device.{...}")`` directly and the router's
+    ``_op_annotation(op, ...)`` helper (whose own body is checked for
+    the literal prefix by :func:`check_router`)."""
+    name = call.func.attr if isinstance(call.func, ast.Attribute) \
+        else getattr(call.func, "id", None)
+    if name not in _ANNOTATE_NAMES:
+        return False
+    if name == "_op_annotation":
+        return True      # prefix verified at the helper's definition
+    if not call.args:
+        return False
+    a = call.args[0]
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        return a.value.startswith("device.")
+    if isinstance(a, ast.JoinedStr) and a.values:
+        first = a.values[0]
+        return (isinstance(first, ast.Constant)
+                and str(first.value).startswith("device."))
+    if isinstance(a, ast.Name):
+        return a.id in ("STEP_LABEL",)
+    if isinstance(a, ast.Attribute):
+        return a.attr in ("STEP_LABEL",)
+    return False
+
+
+def _invocation_labeled(fn: ast.FunctionDef, invoke_pred) -> bool:
+    """Is every call matching ``invoke_pred`` inside ``fn`` lexically
+    under a ``with`` whose items include a device-label annotation?"""
+    hits = [False]
+
+    def walk(node, labeled):
+        if isinstance(node, ast.With):
+            items_labeled = labeled or any(
+                isinstance(i.context_expr, ast.Call)
+                and _is_device_annotate(i.context_expr)
+                for i in node.items)
+            for child in node.body:
+                walk(child, items_labeled)
+            for i in node.items:
+                walk(i.context_expr, labeled)
+            return
+        if isinstance(node, ast.Call) and invoke_pred(node):
+            hits[0] = True
+            if not labeled:
+                raise _Unlabeled(node.lineno)
+        for child in ast.iter_child_nodes(node):
+            walk(child, labeled)
+
+    class _Unlabeled(Exception):
+        def __init__(self, lineno):
+            self.lineno = lineno
+
+    try:
+        for stmt in fn.body:
+            walk(stmt, False)
+    except _Unlabeled:
+        return False
+    return hits[0]
+
+
+def _helper_has_device_prefix(tree: ast.Module) -> bool:
+    """``_op_annotation``'s body builds a literal ``device.``-prefixed
+    label (the indirection :func:`_is_device_annotate` trusts)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) \
+                and node.name == "_op_annotation":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.JoinedStr) and sub.values:
+                    first = sub.values[0]
+                    if isinstance(first, ast.Constant) \
+                            and str(first.value).startswith("device."):
+                        return True
+            return False
+    return False
+
+
+def check_router(router_path) -> list[Finding]:
+    """The router's per-invocation binder wraps the entry call in a
+    ``device.<op>.*`` annotation."""
+    router_path = Path(router_path)
+    try:
+        tree = ast.parse(router_path.read_text(),
+                         filename=str(router_path))
+    except (OSError, SyntaxError) as e:
+        return [Finding(
+            code="devprof.unlabeled", severity="error",
+            message=f"cannot parse {router_path}: {e}",
+            file=str(router_path), pass_name="annotation-coverage")]
+    findings: list[Finding] = []
+
+    def is_entry_invocation(call: ast.Call) -> bool:
+        # The binder re-invokes the wrapped entry as fn(*b.args,
+        # **b.kwargs) — a Starred call of the closed-over `fn`.
+        return (isinstance(call.func, ast.Name)
+                and call.func.id == "fn"
+                and any(isinstance(a, ast.Starred) for a in call.args))
+
+    binders = [node for node in ast.walk(tree)
+               if isinstance(node, ast.FunctionDef)
+               and node.name == "call"]
+    helper_ok = _helper_has_device_prefix(tree)
+    labeled = any(_invocation_labeled(b, is_entry_invocation)
+                  for b in binders) and helper_ok
+    if not binders or not labeled:
+        anchor = binders[0].lineno if binders else None
+        findings.append(Finding(
+            code="devprof.unlabeled",
+            message="the @resilient invocation binder no longer runs "
+                    "the entry under a device.<op>.* profiler "
+                    "annotation — obs.devprof will attribute every "
+                    "fused op's device time to device.unlabeled_ms "
+                    "and *_overlap_pct_measured reads empty windows",
+            file=str(router_path), line=anchor,
+            pass_name="annotation-coverage",
+            fix_hint="wrap the fn(*b.args, **b.kwargs) invocation in "
+                     "_op_annotation(op, impl, fallback_impl) (an "
+                     "annotate(f'device.{op}.<branch>') context)"))
+    return findings
+
+
+def check_sampler(devprof_path, scheduler_path) -> list[Finding]:
+    """The pump sampler plants STEP_LABEL and the scheduler routes its
+    engine work through ``.iteration()``."""
+    findings: list[Finding] = []
+    devprof_path, scheduler_path = Path(devprof_path), Path(scheduler_path)
+    try:
+        dev_src = devprof_path.read_text()
+        sched_src = scheduler_path.read_text()
+    except OSError as e:
+        return [Finding(
+            code="devprof.step_unlabeled", severity="error",
+            message=f"cannot read sampler sources: {e}",
+            file=str(devprof_path), pass_name="annotation-coverage")]
+    if not re.search(r'STEP_LABEL\s*=\s*["\']device\.step["\']',
+                     dev_src) \
+            or not re.search(r"annotate\(STEP_LABEL\)", dev_src):
+        findings.append(Finding(
+            code="devprof.step_unlabeled",
+            message="obs/devprof.py no longer annotates profiled pump "
+                    "iterations with STEP_LABEL='device.step' — "
+                    "device.step.* gauges will read empty windows",
+            file=str(devprof_path), line=1,
+            pass_name="annotation-coverage",
+            fix_hint="keep STEP_LABEL='device.step' and the "
+                     "annotate(STEP_LABEL) wrapper in "
+                     "PumpSampler.iteration"))
+    if ".iteration()" not in sched_src:
+        findings.append(Finding(
+            code="devprof.step_unlabeled",
+            message="serving/scheduler.py pump no longer wraps its "
+                    "engine work in the devprof sampler's "
+                    ".iteration() window",
+            file=str(scheduler_path), line=1,
+            pass_name="annotation-coverage",
+            fix_hint="wrap the lock-free engine-work region of "
+                     "_pump_loop in self.devprof.iteration()"))
+    return findings
+
+
+_RESILIENT_DECOR = re.compile(r"^\s*@resilient\(\s*[\"']([^\"']+)[\"']",
+                              re.MULTILINE)
+
+
+def collect_resilient_ops(ops_dir) -> list[tuple[str, str, int]]:
+    """(op, file, line) for every ``@resilient("op")`` decorator."""
+    out = []
+    for py in sorted(Path(ops_dir).glob("*.py")):
+        text = py.read_text()
+        for m in _RESILIENT_DECOR.finditer(text):
+            line = text[:m.start()].count("\n") + 1
+            out.append((m.group(1), str(py), line))
+    return out
+
+
+def run(root=None) -> list[Finding]:
+    if root is None:
+        import triton_dist_tpu
+        root = Path(triton_dist_tpu.__file__).parent.parent
+    root = Path(root)
+    pkg = root / "triton_dist_tpu"
+    findings = check_router(pkg / "resilience" / "router.py")
+    findings += check_sampler(pkg / "obs" / "devprof.py",
+                              pkg / "serving" / "scheduler.py")
+    for op, file, line in collect_resilient_ops(pkg / "ops"):
+        if "." in op:
+            findings.append(Finding(
+                code="devprof.bad_op_label",
+                message=f"@resilient op name {op!r} contains a dot — "
+                        f"the device.<op>.* label/metric prefix "
+                        f"becomes ambiguous to the devprof parser",
+                file=file, line=line, pass_name="annotation-coverage",
+                fix_hint="use a dot-free op name"))
+    return findings

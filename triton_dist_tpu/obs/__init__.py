@@ -57,7 +57,8 @@ from triton_dist_tpu.obs.exposition import (  # noqa: F401
     merge_snapshots,
     render_prometheus,
 )
-from triton_dist_tpu.obs import attrib, flight, perfwatch, slo, trace  # noqa: F401,E501
+from triton_dist_tpu.obs import (  # noqa: F401
+    attrib, devprof, flight, perfwatch, slo, trace)
 from triton_dist_tpu.obs.slo import (  # noqa: F401
     SLOTarget,
     SLOTracker,

@@ -107,6 +107,16 @@ def dump(reason: str, last_s: float | None = None) -> str | None:
                  "count": _COUNT}
     _registry.counter("resilience.flight_dumps").inc()
     _registry.counter(f"resilience.flight_dump.{safe}").inc()
+    try:
+        # Every flight dump also ARMS a bounded device-profile capture
+        # (obs.devprof): with TDT_DEVPROF_ON_BREACH set, the serving
+        # pump profiles its next N iterations, so the postmortem pairs
+        # this host-event dump with what the chip actually did. A
+        # no-op (one flag write) when no sampler consumes it.
+        from triton_dist_tpu.obs import devprof as _devprof
+        _devprof.arm(reason)
+    except Exception:  # noqa: BLE001 — arming must never worsen a failure
+        pass
     return path
 
 

@@ -43,6 +43,7 @@ Metric surface (docs/observability.md): ``resilience.fallbacks_total``,
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import inspect
@@ -448,6 +449,24 @@ def _record_sample(op: str, branch: str, bound, t0: float, out) -> None:
         pass
 
 
+def _op_annotation(op: str, impl, fallback_impl):
+    """xprof ``TraceAnnotation`` labeling this invocation's branch —
+    ``device.<op>.fused`` / ``device.<op>.xla`` — the label
+    ``obs.devprof`` attributes measured device time by (an eager call
+    brackets real execution; under jit it brackets trace time, like
+    the ``comms.*`` counters). Must never break the call: degrades to
+    a null context when the profiler side is unavailable. The
+    annotation-coverage pass (``tdt-check``) statically verifies this
+    wrapper stays on the invocation path — without it the parser
+    silently books every op's device time as ``device.unlabeled_ms``."""
+    try:
+        from triton_dist_tpu.tools.profiler import annotate
+        branch = "xla" if impl == fallback_impl else "fused"
+        return annotate(f"device.{op}.{branch}")
+    except Exception:  # noqa: BLE001 — labeling is observation only
+        return contextlib.nullcontext()
+
+
 def _all_finite(out) -> bool:
     from triton_dist_tpu.runtime.utils import tree_all_finite
     return tree_all_finite(out)
@@ -521,7 +540,8 @@ def resilient(op: str, *, fused_impls: tuple[str, ...] = ("pallas",),
                 b = sig.bind(*args, **kwargs)
                 b.apply_defaults()
                 b.arguments["impl"] = impl
-                with _Reentrant():
+                with _Reentrant(), \
+                        _op_annotation(op, impl, fallback_impl):
                     return fn(*b.args, **b.kwargs)
 
             reason = decide(op, key)

@@ -77,7 +77,7 @@ import time
 import warnings
 
 from triton_dist_tpu import obs
-from triton_dist_tpu.obs import attrib, slo, trace
+from triton_dist_tpu.obs import attrib, devprof, slo, trace
 
 __all__ = ["DEFAULT_MAX_WAITING", "QueueFull", "Request", "Scheduler"]
 
@@ -137,7 +137,8 @@ class Scheduler:
     """
 
     def __init__(self, engine, params, max_waiting: int | None = None,
-                 prefill_chunk: int | None = None, slo_tracker=None):
+                 prefill_chunk: int | None = None, slo_tracker=None,
+                 devprof_sampler=None):
         if getattr(engine, "use_mega", False):
             raise ValueError(
                 "use_mega decodes uniform-offset batches only — the "
@@ -172,6 +173,19 @@ class Scheduler:
                 targets = (slo_tracker if slo_tracker is not None
                            else getattr(engine, "slo", None))
                 self.slo = slo.SLOTracker(targets=targets)
+        # Device-profile sampling of pump iterations (obs.devprof,
+        # docs/observability.md "Device-time truth"): continuous
+        # (TDT_DEVPROF_EVERY) and/or breach-armed
+        # (TDT_DEVPROF_ON_BREACH via the flight recorder). None when
+        # both knobs are off — the pump then pays nothing. Pass a
+        # PumpSampler to override (tests: sync parse), False to
+        # disable regardless of env.
+        if devprof_sampler is False:
+            self.devprof = None
+        elif devprof_sampler is not None:
+            self.devprof = devprof_sampler
+        else:
+            self.devprof = devprof.PumpSampler.from_env()
         self._cond = threading.Condition()
         self._queue: collections.deque[Request] = collections.deque()
         self._rid = 0
@@ -334,6 +348,13 @@ class Scheduler:
                     sess.close()
                 except Exception:  # noqa: BLE001 — shutdown best-effort
                     pass
+            if self.devprof is not None:
+                try:
+                    # A stop mid-capture must still end the profiler
+                    # session (and parse what it got).
+                    self.devprof.close()
+                except Exception:  # noqa: BLE001 — shutdown best-effort
+                    pass
         if exc is not None:
             # The waiters already carry the exception; re-raising from
             # a daemon thread would only add unhandled-thread noise.
@@ -417,6 +438,14 @@ class Scheduler:
                 record(row, req, first)
 
         while True:
+            if self.devprof is not None and not rows and not self._queue:
+                # Going idle with a multi-iteration capture open would
+                # leave the jax.profiler session running until the
+                # next request (maybe hours: a breach often precedes a
+                # traffic drain). End it here — BEFORE the cond lock,
+                # session teardown is file I/O — and parse what it
+                # got: a short postmortem beats a never-closing one.
+                self.devprof.close()
             admits = []
             with self._cond:
                 while self._running and not self._queue and not rows:
@@ -444,52 +473,58 @@ class Scheduler:
                     admits.append((free.pop(0), self._queue.popleft()))
                 obs.gauge("serving.queue_depth").set(len(self._queue))
             # Engine work happens OUTSIDE the lock: submitters only ever
-            # wait on queue capacity, never on device time.
+            # wait on queue capacity, never on device time. The devprof
+            # sampler wraps exactly this lock-free region — a capture
+            # can span it but never a held scheduler lock.
             t_iter0 = time.perf_counter()
-            for row, req in admits:
-                admit(row, req)
-            for row in sorted(prefilling):   # one slice each, FIFO-ish
-                req = rows[row]
-                try:
-                    with self._bind(req):
-                        first = sess.prefill_step(row)
-                except Exception as e:  # noqa: BLE001
-                    sess.cancel_prefill(row)
-                    prefilling.discard(row)
-                    rows.pop(row)
-                    budgets.pop(row, None)
-                    obs.counter("serving.admit_errors").inc()
-                    self._fail(req, e)
-                    continue
-                req.chunks += 1
-                if first is not None:
-                    prefilling.discard(row)
-                    req.cached = (getattr(sess, "admit_info", None)
-                                  or {}).get("cached", 0)
-                    record(row, req, first)
-            occupancy.set(len(rows))
-            live = [(r, rows[r]) for r in sorted(rows)
-                    if r not in prefilling]
-            if live:
-                try:
-                    toks = sess.decode_step()
-                except Exception as e:  # noqa: BLE001
-                    # The SHARED step died: every occupant degrades (the
-                    # cache state is suspect) and the session restarts
-                    # fresh; the scheduler itself keeps serving.
-                    obs.counter("serving.pump_errors").inc()
-                    for _, req in list(rows.items()):
+            prof = (self.devprof.iteration()
+                    if self.devprof is not None and (admits or rows)
+                    else contextlib.nullcontext())
+            with prof:
+                for row, req in admits:
+                    admit(row, req)
+                for row in sorted(prefilling):  # one slice each, FIFO-ish
+                    req = rows[row]
+                    try:
+                        with self._bind(req):
+                            first = sess.prefill_step(row)
+                    except Exception as e:  # noqa: BLE001
+                        sess.cancel_prefill(row)
+                        prefilling.discard(row)
+                        rows.pop(row)
+                        budgets.pop(row, None)
+                        obs.counter("serving.admit_errors").inc()
                         self._fail(req, e)
-                    rows.clear()
-                    budgets.clear()
-                    prefilling.clear()
-                    sess = self.engine.stream_session(self.params)
-                    self._session = sess
-                    occupancy.set(0)
-                    continue
-                for row, req in live:
-                    if rows.get(row) is req:   # not failed above
-                        record(row, req, int(toks[row]))
+                        continue
+                    req.chunks += 1
+                    if first is not None:
+                        prefilling.discard(row)
+                        req.cached = (getattr(sess, "admit_info", None)
+                                      or {}).get("cached", 0)
+                        record(row, req, first)
+                occupancy.set(len(rows))
+                live = [(r, rows[r]) for r in sorted(rows)
+                        if r not in prefilling]
+                if live:
+                    try:
+                        toks = sess.decode_step()
+                    except Exception as e:  # noqa: BLE001
+                        # The SHARED step died: every occupant degrades
+                        # (the cache state is suspect) and the session
+                        # restarts fresh; the scheduler keeps serving.
+                        obs.counter("serving.pump_errors").inc()
+                        for _, req in list(rows.items()):
+                            self._fail(req, e)
+                        rows.clear()
+                        budgets.clear()
+                        prefilling.clear()
+                        sess = self.engine.stream_session(self.params)
+                        self._session = sess
+                        occupancy.set(0)
+                        continue
+                    for row, req in live:
+                        if rows.get(row) is req:   # not failed above
+                            record(row, req, int(toks[row]))
             occupancy.set(len(rows))
             if admits or live or prefilling:
                 # Iteration time = this pump turn's engine work (the

@@ -228,6 +228,14 @@ class ModelServer:
                 # snapshot (tools/report.py renders them as the
                 # Tracing section; merge_snapshots ignores the key).
                 snap["trace"] = trace.stats()
+            from triton_dist_tpu.obs import devprof
+            if devprof.last_profile() is not None \
+                    or devprof.armed_reason() is not None:
+                # Device-profile state (last parsed capture path,
+                # armed reason) rides the same way — tools/report.py
+                # and tools/top.py render it as the device-time
+                # section.
+                snap["devprof"] = devprof.stats()
             resp = {"metrics": snap}
             if req.get("format") == "prometheus":
                 resp["prometheus"] = obs.render_prometheus(snap)

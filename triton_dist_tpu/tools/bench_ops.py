@@ -238,6 +238,78 @@ SERVING_ROLLING_KEYS = (
 )
 
 
+#: Fused-family bench parts that must publish MEASURED overlap
+#: evidence (ISSUE 10): once a part ran (its `<part>_pallas_ms` /
+#: fused-ms key exists), its extras must carry either a numeric
+#: `<part>_overlap_pct_measured` (chip, world>1) or an explicit
+#: marker — `<part>_overlap_requires_chip` (no comm events in the
+#: profiled window) or `<part>_profile_error` / `_profile_unattributed`
+#: (the capture path failed, recorded rather than silently absent).
+#: (part, ran-sentinel-key) pairs.
+OVERLAP_MEASURED_PARTS = (
+    ("ag_gemm", "ag_gemm_pallas_ms"),
+    ("gemm_rs", "gemm_rs_pallas_ms"),
+    ("gemm_ar", "gemm_ar_pallas_ms"),
+    ("tp_mlp", "tp_mlp_fused_ms"),
+)
+
+
+def check_overlap_measured_wellformed(extras: dict) -> list[str]:
+    """Failure strings when a fused-family part ran without leaving
+    measured-overlap evidence, or left a malformed value. The measured
+    number is the device-timeline tier of the overlap accounting
+    (docs/perf.md): a part publishing neither the number nor an
+    explicit marker would let the next chip window report modeled
+    numbers as if they were measured again."""
+    fails = []
+    for part, ran_key in OVERLAP_MEASURED_PARTS:
+        if ran_key not in extras:
+            continue          # part did not run this time
+        val = extras.get(f"{part}_overlap_pct_measured")
+        if val is not None:
+            if not isinstance(val, (int, float)) \
+                    or isinstance(val, bool) \
+                    or not 0.0 <= float(val) <= 100.0:
+                fails.append(f"{part}_overlap_pct_measured: malformed "
+                             f"value {val!r} (want 0..100)")
+            continue
+        if not (extras.get(f"{part}_overlap_requires_chip")
+                or extras.get(f"{part}_profile_error")
+                or extras.get(f"{part}_profile_unattributed")):
+            fails.append(
+                f"{part}: ran but published neither "
+                f"{part}_overlap_pct_measured nor an explicit "
+                f"overlap_requires_chip / profile_error marker")
+    return fails
+
+
+def load_measured_overlap_floors(baseline_path: str, tier: str) -> dict:
+    """Per-tier floors for `*_overlap_pct_measured` from BASELINE.json
+    ``measured_overlap_floors`` (absent → empty). Deliberately
+    generous: the hook exists so the NEXT chip window's measured
+    numbers are machine-compared, not so today's 0% chip evidence
+    fails retroactively."""
+    with open(baseline_path) as f:
+        floors = json.load(f).get("measured_overlap_floors", {})
+    return {k: v for k, v in floors.get(tier, {}).items()
+            if not k.startswith("_")}
+
+
+def check_measured_overlap_floors(extras: dict, floors: dict) \
+        -> list[str]:
+    """Compare `*_overlap_pct_measured` values that EXIST against the
+    tier floors (a CPU run's explicit requires-chip marker passes the
+    wellformedness check instead; a present-but-below value fails)."""
+    fails = []
+    for key, floor in sorted(floors.items()):
+        val = extras.get(key)
+        if isinstance(val, (int, float)) and not isinstance(val, bool) \
+                and float(val) < float(floor):
+            fails.append(f"{key}: {val} < measured-overlap floor "
+                         f"{floor}")
+    return fails
+
+
 def check_serving_wellformed(extras: dict) -> list[str]:
     """Failure strings when a run that measured serving throughput is
     missing its rolling-window TTFT/TPOT percentiles (empty when the
@@ -312,6 +384,9 @@ def run_regress(baseline_path: str, from_file: str | None,
         floors = {k: v for k, v in floors.items() if k in sweep_keys}
     fails = check_regression(extras, floors)
     fails += check_serving_wellformed(extras)
+    fails += check_overlap_measured_wellformed(extras)
+    fails += check_measured_overlap_floors(
+        extras, load_measured_overlap_floors(baseline_path, tier))
     report = {"tier": tier, "floors": floors, "failures": fails,
               "floors_skipped_not_swept": skipped,
               "checked": {k: extras.get(k) for k in sorted(floors)}}

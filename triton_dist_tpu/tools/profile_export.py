@@ -1,0 +1,173 @@
+"""Validate, summarize, and export parsed ``jax.profiler`` captures.
+
+The read-back CLI over ``obs.devprof`` (docs/observability.md
+"Device-time truth"): where ``tools/trace_export.py`` owns the
+host-side structured-event dumps, this tool owns the DEVICE-side
+captures ``tools/profiler.group_profile`` writes.
+
+CLI::
+
+    python -m triton_dist_tpu.tools.profile_export PATH... --validate
+    python -m triton_dist_tpu.tools.profile_export PATH... --summary
+    python -m triton_dist_tpu.tools.profile_export PATH --chrome out.json
+
+``PATH`` may be a capture file (``*.trace.json[.gz]`` /
+``*.xplane.pb``), a profile run directory, a ``group_profile``
+artifact dir, or a root holding several captures (every run found is
+processed; the hardware watcher points it at the bench's
+``TDT_DEVPROF_DIR`` after each bench step).
+
+- ``--validate`` — parse every capture; rc!=0 on an unparseable one
+  (the same contract as ``trace_export --validate``: the queue stops
+  before an unreadable artifact masquerades as evidence). A path with
+  NO captures is a warning by default (a CPU part may legitimately
+  skip profiling); ``--require`` upgrades that to a failure.
+- ``--summary`` — the parsed attribution as JSON: per-op
+  total/compute/comm ms, measured overlap, unlabeled time.
+- ``--chrome`` — convert the device timeline to Chrome trace events
+  (wall-clock shifted via the capture's ``tdt_capture.json`` anchor),
+  the form ``trace_export --merge-profile`` overlays into a host dump.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from triton_dist_tpu.obs import devprof
+
+__all__ = ["capture_paths", "main", "to_chrome_events",
+           "validate_capture"]
+
+#: pid base for overlaid device-profile rows in a merged Perfetto dump
+#: — far from host pids (0..n_hosts) and trace_export's collision
+#: remapping (1000·host steps).
+DEVICE_PID_BASE = 900
+
+
+def capture_paths(path: str) -> list[str]:
+    """Every capture under ``path`` (see module docstring for accepted
+    forms): run directories newest-last, or the file itself."""
+    import os
+    if os.path.isfile(path):
+        return [path]
+    return devprof.find_captures(path)
+
+
+def validate_capture(path: str) -> tuple[dict | None, str | None]:
+    """(summary, error): parse one capture; error string when it is
+    unparseable or empty."""
+    try:
+        summary = devprof.parse_capture(path)
+    except Exception as e:  # noqa: BLE001 — the rc is the contract
+        return None, f"{type(e).__name__}: {e}"
+    if not summary.get("n_events") and not summary.get("ops"):
+        return summary, "capture parsed but holds no execution events"
+    return summary, None
+
+
+def to_chrome_events(path: str, pid: int | None = None) -> list[dict]:
+    """The capture's events as Chrome trace events on one wall clock.
+
+    Capture timestamps are profile-session-relative; the
+    ``tdt_capture.json`` anchor (``t0_unix``) shifts them onto the
+    same epoch-micros clock ``obs.trace`` stamps host events with, so
+    a merged dump shows dispatch and device work in one Perfetto view.
+    Un-anchored (foreign) captures keep their relative clock."""
+    events = [e for e in devprof.load_capture(path)
+              # The overlay carries the MEANINGFUL timeline — label
+              # windows, device-plane work, host-side execution /
+              # comm events — not the thousands of python-frame
+              # events a capture also holds (Perfetto chokes and the
+              # merged dump stops being readable).
+              if e["device"]
+              or e["name"].startswith(devprof.LABEL_PREFIX)
+              or devprof._EXEC_PAT.search(e["name"])
+              or devprof._COMM_PAT.search(e["name"])]
+    meta = devprof.capture_meta(path)
+    shift_us = float(meta.get("t0_unix", 0.0)) * 1e6
+    if pid is None:
+        pid = DEVICE_PID_BASE + int(meta.get("host", 0))
+    out: list[dict] = [
+        {"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+         "args": {"name": f"devprof host{meta.get('host', '?')}"
+                          + ("" if meta else " (unanchored)")}},
+    ]
+    tids: dict[tuple, int] = {}
+    for e in events:
+        key = (e["pid"], e["tid"])
+        tid = tids.get(key)
+        if tid is None:
+            tid = tids[key] = len(tids) + 1
+            kind = "device" if e["device"] else "host"
+            out.append({"ph": "M", "pid": pid, "tid": tid,
+                        "name": "thread_name",
+                        "args": {"name": f"devprof.{kind}.{e['pid']}"
+                                         f".{e['tid']}"}})
+        out.append({"ph": "X", "pid": pid, "tid": tid,
+                    "ts": e["ts_us"] + shift_us, "dur": e["dur_us"],
+                    "name": e["name"], "cat": "devprof"})
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Parse / validate jax.profiler captures "
+                    "(obs.devprof)")
+    ap.add_argument("paths", nargs="+",
+                    help="capture file(s) / run dir(s) / capture roots")
+    ap.add_argument("--validate", action="store_true",
+                    help="rc!=0 on any unparseable capture")
+    ap.add_argument("--require", action="store_true",
+                    help="with --validate: a path holding NO captures "
+                         "is a failure, not a warning")
+    ap.add_argument("--summary", action="store_true",
+                    help="print the parsed per-op attribution as JSON")
+    ap.add_argument("--chrome", default=None,
+                    help="write the newest capture's device timeline "
+                         "as Chrome trace JSON (wall-clock anchored)")
+    args = ap.parse_args(argv)
+    if not (args.validate or args.summary or args.chrome):
+        ap.error("nothing to do: pass --validate, --summary, "
+                 "and/or --chrome")
+    rc = 0
+    all_caps: list[str] = []
+    for p in args.paths:
+        caps = capture_paths(p)
+        if not caps:
+            msg = f"{p}: no profile captures found"
+            if args.require:
+                print(f"{msg} (--require)")
+                rc = 1
+            else:
+                print(f"{msg} (skipped)")
+            continue
+        all_caps.extend(caps)
+        for c in caps:
+            summary, err = validate_capture(c)
+            if args.validate or err:
+                ops = sorted((summary or {}).get("ops", {}))
+                print(f"{c}: "
+                      + (f"INVALID {err}" if err else
+                         f"valid ({summary['n_events']} exec events, "
+                         f"ops: {', '.join(ops) if ops else '-'}, "
+                         f"unlabeled {summary['unlabeled_ms']} ms)"))
+                rc = rc or (1 if err else 0)
+            if args.summary and summary is not None:
+                print(json.dumps(summary, indent=1, sort_keys=True))
+    if args.chrome:
+        if not all_caps:
+            print("--chrome: no capture to convert")
+            return 1
+        events = to_chrome_events(all_caps[-1])
+        with open(args.chrome, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        print(f"wrote {args.chrome} ({len(events)} events from "
+              f"{all_caps[-1]})")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,30 +7,87 @@ writing per-rank chrome traces and merging them on rank 0 via
 the tracer is ``jax.profiler`` (XPlane/TensorBoard): each host writes its
 own trace under ``<dir>/<name>/host<idx>/``; the merge step of the
 reference collapses to pointing TensorBoard/xprof at the shared
-directory, which overlays all hosts' timelines.
+directory, which overlays all hosts' timelines — or, since the
+device-time truth layer (``obs.devprof``), to parsing the capture back
+into measured per-op metrics (``tools/profile_export.py``) and
+overlaying it into the host Perfetto dump
+(``tools/trace_export.py --merge-profile``).
+
+Each capture is counted through obs (``profile.captures`` /
+``profile.capture_ms``) and leaves a ``tdt_capture.json`` anchor in its
+artifact dir — the wall-clock instant the profiler session started, so
+the capture's session-relative timestamps can be placed on the same
+clock as ``obs.trace``'s wall-anchored events.
 """
 
 from __future__ import annotations
 
 import contextlib
 import glob
+import json
 import os
+import time
 
 import jax
+
+from triton_dist_tpu import obs
+
+
+class Capture(str):
+    """A ``group_profile`` artifact handle: the capture DIRECTORY as a
+    plain string (back-compatible with every ``os.path`` consumer),
+    plus the structured fields callers previously had to re-derive.
+
+    Attributes: ``path`` (== str(self)), ``name`` (the capture name),
+    ``host`` (process index), ``t0_unix`` (wall clock at session
+    start — the overlay anchor, also persisted as
+    ``tdt_capture.json``)."""
+
+    path: str
+    name: str
+    host: int
+    t0_unix: float
+
+    def __new__(cls, path: str, name: str, host: int, t0_unix: float):
+        self = super().__new__(cls, path)
+        self.path = str(path)
+        self.name = name
+        self.host = host
+        self.t0_unix = t0_unix
+        return self
 
 
 @contextlib.contextmanager
 def group_profile(name: str = "trace", out_dir: str = "/tmp/tdt_profile",
                   enabled: bool = True):
     """Profile the enclosed region on every host (reference
-    ``group_profile`` utils.py:505)."""
+    ``group_profile`` utils.py:505). Yields a :class:`Capture` (the
+    artifact dir, str-compatible); counts ``profile.captures`` and the
+    capture wall time into ``profile.capture_ms``."""
     if not enabled:
         yield None
         return
-    path = os.path.join(out_dir, name, f"host{jax.process_index()}")
+    host = jax.process_index()
+    path = os.path.join(out_dir, name, f"host{host}")
     os.makedirs(path, exist_ok=True)
+    t0p = time.perf_counter()
     with jax.profiler.trace(path):
-        yield path
+        # Anchor INSIDE the session: capture timestamps are relative
+        # to profiler start, so the closest wall-clock reading wins.
+        t0 = time.time()
+        cap = Capture(path, name, host, t0)
+        try:
+            with open(os.path.join(path, "tdt_capture.json"), "w") as f:
+                json.dump({"name": name, "host": host, "t0_unix": t0,
+                           "pid": os.getpid()}, f)
+        except OSError:
+            pass       # the anchor is an overlay nicety, not a gate
+        try:
+            yield cap
+        finally:
+            obs.counter("profile.captures").inc()
+            obs.histogram("profile.capture_ms").observe(
+                (time.perf_counter() - t0p) * 1e3)
 
 
 def trace_files(name: str = "trace",
@@ -45,7 +102,9 @@ def trace_files(name: str = "trace",
 @contextlib.contextmanager
 def annotate(label: str):
     """Named region inside a trace (reference launch_metadata hooks,
-    allgather_gemm.py:145-155)."""
+    allgather_gemm.py:145-155). The ``device.<op>.<branch>`` /
+    ``device.step`` labels the router and pump sampler plant this way
+    are what ``obs.devprof`` keys its measured attribution on."""
     with jax.profiler.TraceAnnotation(label):
         yield
 

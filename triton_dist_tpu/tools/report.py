@@ -190,6 +190,49 @@ def render_waterfalls(wf: dict | None) -> str:
     return "\n".join(lines)
 
 
+def render_devprof(snap: dict, stats: dict | None = None) -> str:
+    """Summarize the device-time truth layer (``obs.devprof``,
+    docs/observability.md "Device-time truth"): measured per-op
+    compute/comm attribution and overlap, drift vs the dispatch-time
+    model gauge, unlabeled device time, capture counts, and the last
+    parsed profile artifact path. Empty string when the snapshot holds
+    no ``device.*`` gauges and no devprof stats."""
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    dev = {k: v for k, v in gauges.items() if k.startswith("device.")}
+    meas = {k: v for k, v in gauges.items()
+            if k.startswith("comms.") and ("_measured" in k
+                                           or k.endswith("_drift_pct"))}
+    prof = {k: v for k, v in counters.items()
+            if k.startswith("profile.")}
+    if not dev and not meas and not prof and not stats:
+        return ""
+    lines = ["#### device time (measured)", "| metric | value |",
+             "|---|---|"]
+    for k in sorted(dev) + sorted(meas):
+        v = gauges[k]
+        lines.append(f"| {k} | "
+                     f"{int(v) if float(v) == int(v) else round(v, 4)} |")
+    for k in sorted(prof):
+        v = counters[k]
+        lines.append(f"| {k} | {int(v) if float(v) == int(v) else v} |")
+    if stats:
+        if stats.get("last_profile"):
+            lines.append(f"| last_profile | {stats['last_profile']} "
+                         f"({stats.get('last_reason', '?')}) |")
+        if stats.get("armed"):
+            lines.append(f"| armed | {stats['armed']} |")
+    if dev.get("device.unlabeled_ms"):
+        # Nonzero unlabeled time means execution ran outside every
+        # device.<op> window — the annotation-coverage pass guards the
+        # label plumbing; surface it where the numbers are read.
+        lines.append(
+            f"\n⚠ {round(float(dev['device.unlabeled_ms']), 3)} ms of "
+            f"device/runtime execution was attributed to NO "
+            f"device.<op> label (see tdt-check annotation-coverage).")
+    return "\n".join(lines)
+
+
 def render_telemetry(snap: dict) -> str:
     """Render an obs snapshot (bench ``extras.telemetry`` / server
     ``{"cmd": "metrics"}`` payload — docs/observability.md) as
@@ -200,6 +243,7 @@ def render_telemetry(snap: dict) -> str:
     serving = render_serving(snap)
     kv = render_kv(snap)
     tracing = render_tracing(snap.get("trace"))
+    devprof = render_devprof(snap, snap.get("devprof"))
     waterfalls = render_waterfalls(snap.get("waterfalls"))
     # trace.* gauges mirror what the tracing section already shows
     # (they exist for the Prometheus exposition path) — don't render
@@ -208,7 +252,13 @@ def render_telemetry(snap: dict) -> str:
     skip = lambda k: (k.startswith("resilience.")  # noqa: E731
                       or (bool(serving) and k.startswith("serving."))
                       or (bool(kv) and k.startswith("kv."))
-                      or (bool(tracing) and k.startswith("trace.")))
+                      or (bool(tracing) and k.startswith("trace."))
+                      or (bool(devprof)
+                          and (k.startswith("device.")
+                               or k.startswith("profile.")
+                               or (k.startswith("comms.")
+                                   and ("_measured" in k
+                                        or k.endswith("_drift_pct"))))))
     scalars = [("counter", k, v)
                for k, v in sorted(snap.get("counters", {}).items())
                if not skip(k)]
@@ -223,6 +273,8 @@ def render_telemetry(snap: dict) -> str:
         lines += [kv, ""]
     if tracing:
         lines += [tracing, ""]
+    if devprof:
+        lines += [devprof, ""]
     if waterfalls:
         lines += [waterfalls, ""]
     if scalars:

@@ -111,6 +111,33 @@ def render(snap: dict) -> str:
             ratio_rows.append((op, f"{_fmt(g[k])}x vs xla (live)"))
     _rows(lines, "live op ratios", ratio_rows)
 
+    # Device-time truth (obs.devprof): measured per-op attribution
+    # from parsed jax.profiler captures, drift vs the modeled gauge,
+    # and the last profile artifact a postmortem reader should open.
+    dev_rows = []
+    ops = sorted({k.split(".")[1] for k in g
+                  if k.startswith("device.") and k.count(".") == 2})
+    for op in ops:
+        comp = g.get(f"device.{op}.compute_ms")
+        comm = g.get(f"device.{op}.comm_ms")
+        ov = g.get(f"comms.{op}.overlap_pct_measured")
+        drift = g.get(f"comms.{op}.overlap_drift_pct")
+        val = (f"compute {_fmt(comp)} ms   comm {_fmt(comm)} ms"
+               + (f"   overlap {_fmt(ov)}%" if ov is not None else "")
+               + (f"   drift {_fmt(drift)}%" if drift is not None
+                  else ""))
+        dev_rows.append((op, val))
+    if g.get("device.unlabeled_ms"):
+        dev_rows.append(("(unlabeled)",
+                         f"{_fmt(g['device.unlabeled_ms'])} ms "
+                         f"(see tdt-check annotation-coverage)"))
+    dp = snap.get("devprof") or {}
+    if dp.get("last_profile"):
+        dev_rows.append(("last profile",
+                         f"{dp['last_profile']} "
+                         f"({dp.get('last_reason', '?')})"))
+    _rows(lines, "device time (measured)", dev_rows)
+
     req_rows = []
     for r in snap.get("requests", [])[:5]:
         seg = r.get("segments", {})

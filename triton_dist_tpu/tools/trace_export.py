@@ -21,11 +21,20 @@ that pipeline for ``obs.trace``'s structured events:
   instead of trusting the dispatch-time ``comms.<op>.overlap_pct``
   gauge.
 
+- :func:`merge_profile` — overlay a parsed device-profile capture
+  (``obs.devprof`` / ``tools/profile_export.py``) into a host dump on
+  ONE clock: capture timestamps are profile-session-relative and the
+  ``tdt_capture.json`` anchor shifts them onto the same wall-anchored
+  micros the tracer stamps, so a single Perfetto view shows dispatch,
+  the ring-chunk schedule, and what the chip actually did.
+
 CLI::
 
     python -m triton_dist_tpu.tools.trace_export --validate dump.json
     python -m triton_dist_tpu.tools.trace_export --overlap  dump.json
     python -m triton_dist_tpu.tools.trace_export a.json b.json --out merged.json
+    python -m triton_dist_tpu.tools.trace_export dump.json \
+        --merge-profile /tmp/tdt_devprof --out overlaid.json
 
 Load any output at https://ui.perfetto.dev (or chrome://tracing); the
 "reading a Perfetto dump" walkthrough lives in docs/observability.md.
@@ -39,7 +48,7 @@ import re
 import sys
 
 __all__ = ["compute_overlap", "gather_to_chrome", "merge_chrome",
-           "to_chrome", "validate", "write_trace"]
+           "merge_profile", "to_chrome", "validate", "write_trace"]
 
 
 def to_chrome(collected: dict, pid: int | None = None,
@@ -137,6 +146,32 @@ def gather_to_chrome(last_s: float | None = None,
                       process_name=process_name)
     gathered = allgather_json(local)
     return local if len(gathered) == 1 else merge_chrome(gathered)
+
+
+def merge_profile(chrome: dict, capture_path: str) -> dict:
+    """Overlay a device-profile capture into a host trace dump.
+
+    The capture's label windows, device-plane events, and host
+    execution/comm events land as extra process rows (pid 900+host —
+    ``tools/profile_export.DEVICE_PID_BASE``), timestamp-shifted onto
+    the host dump's wall-anchored clock via the capture's
+    ``tdt_capture.json`` anchor. The host events are untouched, so the
+    result stays ``--validate``-clean."""
+    from triton_dist_tpu.tools import profile_export as _pexp
+    caps = _pexp.capture_paths(capture_path)
+    if not caps:
+        raise ValueError(
+            f"no profile capture found under {capture_path!r}")
+    merged = dict(chrome)
+    merged["traceEvents"] = list(chrome.get("traceEvents", []))
+    for cap in caps:
+        merged["traceEvents"].extend(_pexp.to_chrome_events(cap))
+    meta = dict(chrome.get("metadata") or {})
+    meta["merged_profiles"] = meta.get("merged_profiles", 0) + len(caps)
+    meta["profile_sources"] = (meta.get("profile_sources") or []) + [
+        str(c) for c in caps]
+    merged["metadata"] = meta
+    return merged
 
 
 # ---------------------------------------------------------------------------
@@ -325,7 +360,13 @@ def main(argv=None) -> int:
                          "from ring-schedule chunk events")
     ap.add_argument("--out", default=None,
                     help="merge the inputs into this file")
+    ap.add_argument("--merge-profile", default=None, metavar="CAPTURE",
+                    help="overlay a jax.profiler capture (file / run "
+                         "dir / TDT_DEVPROF_DIR root) into the merged "
+                         "dump on one wall clock; requires --out")
     args = ap.parse_args(argv)
+    if args.merge_profile and not args.out:
+        ap.error("--merge-profile needs --out for the overlaid dump")
     traces = []
     for p in args.paths:
         with open(p) as f:
@@ -348,6 +389,8 @@ def main(argv=None) -> int:
         print(json.dumps(compute_overlap(merged), indent=2))
     if args.out:
         merged = merge_chrome(traces) if len(traces) > 1 else traces[0]
+        if args.merge_profile:
+            merged = merge_profile(merged, args.merge_profile)
         write_trace(merged, args.out)
         print(f"wrote {args.out} "
               f"({len(merged['traceEvents'])} events)")
