@@ -37,7 +37,9 @@ What it benches (BASELINE.md north star; reference e2e_dense.md:21-38):
   -32B per-chip TP8 slice dims — reference e2e table rows), overlap
   (ag_gemm DMA-under-MXU proxy), moe_ag_gg, mega (incl. 32-layer deep
   config), serving (continuous-batching scheduler vs serialized lock,
-  8 concurrent clients — valid on the CPU tier), prefix (shared-preamble
+  8 concurrent clients — valid on the CPU tier), serving_mega (mega vs
+  plain decode path through the SAME scheduler — CPU-valid parity
+  harness), prefix (shared-preamble
   clients, prefix cache warm vs cold — also CPU-valid), sp_attn, train. On a single chip the collective parts
   collapse, so the numbers measure Mosaic-kernel vs XLA compute
   quality; on a real slice the same code measures overlap.
@@ -171,7 +173,7 @@ def _probe_backend_subprocess(timeout_s: float) -> bool:
 #: can only cost the tail.
 _PART_ORDER = ("ag_gemm", "gemm_rs", "gemm_ar", "flash_decode", "tp_mlp",
                "layer_8b", "layer_32b", "overlap", "moe_ag_gg", "mega",
-               "serving", "prefix", "sp_attn", "train")
+               "serving", "serving_mega", "prefix", "sp_attn", "train")
 
 #: Sweep-heavy parts get longer deadlines: ag_gemm/gemm_rs autotune
 #: 6-8 candidates at ~25 s Mosaic compile each on a COLD cache (the
@@ -994,6 +996,27 @@ def _hist_delta(before, after, name):
             "min": None, "max": None}
 
 
+def _served_workload_run(srv, reqs):
+    """The shared serving-part harness (_bench_serving scheduler leg /
+    _bench_serving_mega): warm every compile the timed window touches,
+    reset the rolling SLO windows so the windowed percentiles price
+    the timed run (not the warmup's cold compiles), run the timed
+    fanout, and scrape metrics before/after for histogram deltas.
+    Returns (tokens_per_s, errors, warm_snapshot, end_snapshot)."""
+    from triton_dist_tpu.serving.client import fanout
+    fanout(srv.host, srv.port, [dict(r, gen_len=2) for r in reqs])
+    if srv.scheduler is not None and srv.scheduler.slo is not None:
+        srv.scheduler.slo.reset_windows()
+    warm = _scrape_metrics(srv.host, srv.port)
+    t0 = time.perf_counter()
+    outs = fanout(srv.host, srv.port, reqs)
+    dt = time.perf_counter() - t0
+    toks = sum(len(o["tokens"][0]) for o in outs if "tokens" in o)
+    errors = [o for o in outs if "tokens" not in o]
+    snap = _scrape_metrics(srv.host, srv.port)
+    return (toks / dt if dt > 0 else 0.0), errors, warm, snap
+
+
 def _bench_serving(mesh, n, on_tpu, extras):
     """Serving throughput under concurrency (ISSUE 5): N concurrent
     clients with mixed prompt/gen lengths against (a) the
@@ -1041,9 +1064,6 @@ def _bench_serving(mesh, n, on_tpu, extras):
              "gen_len": g}
             for i, (pl, g) in enumerate(zip(prompt_lens, gens))]
 
-    def scrape(host, port):
-        return _scrape_metrics(host, port)
-
     hist_delta = _hist_delta
 
     def run(use_scheduler):
@@ -1056,35 +1076,31 @@ def _bench_serving(mesh, n, on_tpu, extras):
         srv = ModelServer(eng, params, port=0,
                           scheduler=use_scheduler).start()
         try:
-            # Warm EVERY compile out of the timed window — including
-            # the serialized path's per-prompt-shape eager prefills
-            # (the scheduler's bucketed admission compiles once per
-            # power-of-two bucket; timing cold compiles would hand the
-            # scheduler a compile-amortization win on top of the
-            # scheduling win this probe is pricing).
+            if use_scheduler:
+                # Shared harness: warmup (every compile out of the
+                # timed window), rolling-window reset, timed fanout,
+                # before/after scrapes. The metrics scrape forces a
+                # fresh SLO evaluation, so the serving.rolling.*
+                # gauges below are current as of the window's end.
+                tps, errors, warm, snap = _served_workload_run(srv,
+                                                               reqs)
+                return (tps, errors, warm, snap,
+                        _sample_waterfall(srv.host, srv.port))
+            # Serialized leg: same warmup (the per-prompt-shape eager
+            # prefills must not be timed — a cold compile would hand
+            # the scheduler a compile-amortization win on top of the
+            # scheduling win this probe prices), no scrapes (no
+            # scheduler histograms to delta).
             fanout(srv.host, srv.port,
                    [dict(r, gen_len=2) for r in reqs])
-            if use_scheduler and srv.scheduler.slo is not None:
-                # Fresh rolling-window epoch: the windowed percentiles
-                # below must price the timed run, not the warmup's
-                # cold-compile TTFTs sharing the same 60s window.
-                srv.scheduler.slo.reset_windows()
-            warm = scrape(srv.host, srv.port) if use_scheduler else None
             t0 = time.perf_counter()
             outs = fanout(srv.host, srv.port, reqs)
             dt = time.perf_counter() - t0
             toks = sum(len(o["tokens"][0]) for o in outs
                        if "tokens" in o)
             errors = [o for o in outs if "tokens" not in o]
-            # The metrics scrape forces a fresh SLO evaluation, so the
-            # serving.rolling.* gauges below are current as of the end
-            # of the timed window.
-            snap = scrape(srv.host, srv.port) if use_scheduler else None
-            wf = None
-            if use_scheduler:
-                wf = _sample_waterfall(srv.host, srv.port)
-            return (toks / dt if dt > 0 else 0.0, errors, warm, snap,
-                    wf)
+            return (toks / dt if dt > 0 else 0.0, errors, None, None,
+                    None)
         finally:
             srv.stop()
 
@@ -1134,6 +1150,98 @@ def _bench_serving(mesh, n, on_tpu, extras):
                 extras[f"serving_rolling_{m}_{tag}_ms"] = (
                     round(float(v), 3) if v is not None else None)
     return tps_sched, extras.get("serving_sched_vs_serial")
+
+
+def _bench_serving_mega(mesh, n, on_tpu, extras):
+    """Mega-in-scheduler vs plain-in-scheduler (ISSUE 11): the same
+    model, same params, same concurrent request stream through the
+    same continuous-batching ``StreamSession`` — only the decode path
+    differs (``Engine(decode_path="mega")`` vs ``"plain"``). Greedy
+    outputs are bit-identical (tests/test_scheduler.py), so
+    ``serving_mega_vs_plain`` prices the one-program task-graph step
+    against the plain jitted step INSIDE the shared batch — the
+    composition ROADMAP item 1 asks for. On the CPU tier the ratio
+    mostly prices dispatch parity (floor 0.5, BASELINE.json — a
+    harness/wellformedness gate, not a perf claim); the chip number is
+    what the next hardware window reads against the 1.49x
+    uniform-batch measurement (docs/perf.md)."""
+    import jax
+    import jax.numpy as jnp
+    from triton_dist_tpu.models import DenseLLM, Engine, ModelConfig
+    from triton_dist_tpu.obs import histogram_quantile
+    from triton_dist_tpu.serving import ModelServer
+
+    if on_tpu:
+        cfg = ModelConfig(hidden_size=512, intermediate_size=1024,
+                          num_hidden_layers=2, num_attention_heads=8,
+                          num_key_value_heads=8, head_dim=64,
+                          vocab_size=2048, max_position_embeddings=512,
+                          dtype=jnp.bfloat16)
+        gen_short, gen_long = 16, 96
+    else:
+        cfg = ModelConfig(hidden_size=32, intermediate_size=64,
+                          num_hidden_layers=1, num_attention_heads=4,
+                          num_key_value_heads=4, head_dim=8,
+                          vocab_size=64, max_position_embeddings=256,
+                          dtype=jnp.float32)
+        gen_short, gen_long = 4, 24
+    model = DenseLLM(cfg, mesh=mesh, axis="tp", impl="xla")
+    params = model.init(jax.random.PRNGKey(0))
+    batch = 4
+    # Mixed prompt/gen lengths inside one admission bucket (8): ragged
+    # per-row offsets + mid-decode admission/retirement are exactly the
+    # batch shapes the vectorized mega step must not lose on.
+    prompt_lens = [3, 5, 8, 4, 6, 7, 5, 3]
+    gens = [gen_long, gen_short, gen_long, gen_short] * 2
+    reqs = [{"prompt_ids": [[(7 * i + j) % (cfg.vocab_size - 1) + 1
+                             for j in range(pl)]],
+             "gen_len": g}
+            for i, (pl, g) in enumerate(zip(prompt_lens, gens))]
+
+    def run(path):
+        eng = Engine(model, batch=batch,
+                     max_seq=cfg.max_position_embeddings,
+                     prefill_mode="xla_ar", decode_mode="gemm_ar",
+                     decode_path=path)
+        srv = ModelServer(eng, params, port=0).start()
+        try:
+            # Shared harness (warmup incl. this path's decode-step
+            # compile, rolling-window reset, timed fanout, scrapes).
+            return _served_workload_run(srv, reqs)
+        finally:
+            srv.stop()
+
+    from triton_dist_tpu.obs import slo as _slo
+    results = {}
+    for path in ("plain", "mega"):
+        tps, errors, warm, snap = run(path)
+        results[path] = tps
+        tag = "serving_mega" if path == "mega" else "serving_mega_plain"
+        extras[f"{tag}_tokens_per_s"] = round(tps, 2)
+        if errors:
+            extras[f"{tag}_errors"] = [str(e)[:120]
+                                       for e in errors[:4]]
+        ttft = _hist_delta(warm, snap, "serving.ttft_ms")
+        if ttft:
+            for q, qtag in ((0.50, "p50"), (0.99, "p99")):
+                v = histogram_quantile(ttft, q)
+                extras[f"{tag}_ttft_{qtag}_ms"] = (round(v, 3) if v
+                                                   else None)
+        # TPOT from the freshly-reset rolling windows (the timed run's
+        # own percentiles, same contract — and same TDT_SLO=0 opt-out
+        # — as the serving part).
+        if not _slo.enabled():
+            extras["serving_rolling_disabled"] = True
+        else:
+            for qtag in ("p50", "p99"):
+                v = (snap or {}).get("gauges", {}).get(
+                    f"serving.rolling.tpot_{qtag}_ms")
+                extras[f"{tag}_tpot_{qtag}_ms"] = (
+                    round(float(v), 3) if v is not None else None)
+    if results["plain"] > 0:
+        extras["serving_mega_vs_plain"] = round(
+            results["mega"] / results["plain"], 4)
+    return results["mega"], extras.get("serving_mega_vs_plain")
 
 
 def _bench_prefix(mesh, n, on_tpu, extras):
@@ -1796,6 +1904,8 @@ def main():
              lambda: _bench_mega_vs_engine(mesh, n, on_tpu, extras)),
             ("serving",
              lambda: _bench_serving(mesh, n, on_tpu, extras)),
+            ("serving_mega",
+             lambda: _bench_serving_mega(mesh, n, on_tpu, extras)),
             ("prefix",
              lambda: _bench_prefix(mesh, n, on_tpu, extras)),
             ("sp_attn",

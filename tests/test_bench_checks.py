@@ -319,6 +319,26 @@ def test_regress_gates_serving_ratio(tmp_path):
                for f in check_regression(gone, floors))
 
 
+def test_mega_serving_wellformed_gate():
+    """ISSUE 11 satellite: once the serving_mega part ran, its
+    serving_mega_vs_plain ratio must exist and be a positive number —
+    a run silently dropping the mega-in-scheduler evidence fails; a
+    run that never measured serving_mega passes untouched."""
+    from triton_dist_tpu.tools.bench_ops import (
+        check_mega_serving_wellformed)
+    assert check_mega_serving_wellformed({}) == []      # part didn't run
+    ok = {"serving_mega_tokens_per_s": 100.0,
+          "serving_mega_vs_plain": 0.97}
+    assert check_mega_serving_wellformed(ok) == []
+    for bad_val in (None, "fast", True, 0.0, -1.0):
+        bad = {"serving_mega_tokens_per_s": 100.0,
+               "serving_mega_vs_plain": bad_val}
+        fails = check_mega_serving_wellformed(bad)
+        assert fails and "serving_mega_vs_plain" in fails[0], bad_val
+    gone = {"serving_mega_tokens_per_s": 100.0}
+    assert check_mega_serving_wellformed(gone)
+
+
 def test_bench_parts_typo_fails_before_checkpoint(tmp_path, monkeypatch):
     """A typo'd TDT_BENCH_PARTS must SystemExit before the checkpoint
     clear — prior evidence survives (review r5a-2)."""

@@ -282,10 +282,57 @@ def test_pump_sampler_feeds_device_step_gauges(mesh8, key):
         step = last["summary"]["ops"].get("step")
         assert step is not None, last["summary"]
         assert step["total_ms"] > 0
+        # Nested inside the whole-iteration window, the scheduler
+        # brackets the shared decode step alone with the per-path
+        # label — decode-only device time, no admission contamination
+        # (the split Engine(decode_path="auto") arbitrates on).
+        sub = last["summary"]["ops"].get("step.plain")
+        assert sub is not None, last["summary"]
+        assert 0 < sub["total_ms"] <= step["total_ms"]
         g = reg.snapshot()["gauges"]
         assert g["device.step.total_ms"] > 0
+        assert g["device.step.plain.total_ms"] > 0
+        assert g["device.step.plain.windows"] >= 1
         assert g.get("device.step.compute_ms", 0) >= 0
         assert reg.snapshot()["counters"]["profile.parsed"] >= 1
+    finally:
+        obs.disable()
+
+
+def test_pump_sampler_attributes_mega_iterations_separately(mesh8, key):
+    """ISSUE 11 satellite: a mega-engine scheduler's profiled pump
+    iterations land in device.step.MEGA gauges, not blended into the
+    plain window — the auto policy's measured inputs."""
+    from triton_dist_tpu.serving import Scheduler
+    from triton_dist_tpu.models import DenseLLM, Engine, ModelConfig
+    cfg = ModelConfig(hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=8,
+                      num_key_value_heads=8, head_dim=4, vocab_size=64,
+                      max_position_embeddings=64, dtype=jnp.float32)
+    model = DenseLLM(cfg, mesh=mesh8, axis="tp", impl="xla")
+    params = model.init(key)
+    engine = Engine(model, batch=2, max_seq=64, prefill_mode="xla_ar",
+                    decode_mode="gemm_ar", use_mega=True)
+    reg = obs.enable(obs.Registry())
+    try:
+        sampler = devprof.PumpSampler(every=3, sync=True)
+        sched = Scheduler(engine, params,
+                          devprof_sampler=sampler).start()
+        try:
+            toks = sched.generate([1, 2, 3], 8)
+            assert len(toks) >= 1
+        finally:
+            sched.stop()
+        last = devprof.last_profile()
+        assert last is not None
+        ops = last["summary"]["ops"]
+        assert "step.mega" in ops and ops["step.mega"]["total_ms"] > 0
+        assert "step.plain" not in ops, ops
+        # ... and the decode-only sub-window stays inside the
+        # whole-iteration window.
+        assert ops["step.mega"]["total_ms"] <= ops["step"]["total_ms"]
+        g = reg.snapshot()["gauges"]
+        assert g["device.step.mega.total_ms"] > 0
     finally:
         obs.disable()
 
@@ -500,7 +547,90 @@ def test_mutant_sampler_without_step_label(tmp_path):
     p.write_text(mut)
     import triton_dist_tpu.serving.scheduler as sched_mod
     findings = lint_annotations.check_sampler(p, sched_mod.__file__)
-    assert [f.code for f in findings] == ["devprof.step_unlabeled"]
+    # The de-namespaced label ALSO breaks the per-path attribution
+    # (step_label("mega") no longer yields device.step.mega), so both
+    # finding classes fire.
+    codes = [f.code for f in findings]
+    assert "devprof.step_unlabeled" in codes, codes
+
+
+def test_summarize_keeps_step_paths_separate():
+    """The parser attributes device.step.mega / device.step.plain
+    windows to SEPARATE ops (router device.<op>.<branch> labels still
+    blend branches into one op) — the split the auto decode-path
+    policy reads."""
+    events = [
+        {"name": "device.step.mega", "ts_us": 0.0, "dur_us": 100.0,
+         "pid": 1, "tid": 1, "device": False},
+        {"name": "fusion.a", "ts_us": 10.0, "dur_us": 40.0,
+         "pid": 2, "tid": 1, "device": True},
+        {"name": "device.step.plain", "ts_us": 200.0, "dur_us": 100.0,
+         "pid": 1, "tid": 1, "device": False},
+        {"name": "fusion.b", "ts_us": 210.0, "dur_us": 80.0,
+         "pid": 2, "tid": 1, "device": True},
+        {"name": "device.ag_gemm.fused", "ts_us": 400.0,
+         "dur_us": 50.0, "pid": 1, "tid": 1, "device": False},
+        {"name": "device.ag_gemm.xla", "ts_us": 500.0, "dur_us": 50.0,
+         "pid": 1, "tid": 1, "device": False},
+    ]
+    ops = devprof.summarize(events)["ops"]
+    assert set(ops) == {"step.mega", "step.plain", "ag_gemm"}
+    assert ops["step.mega"]["compute_ms"] == pytest.approx(0.04)
+    assert ops["step.plain"]["compute_ms"] == pytest.approx(0.08)
+    assert devprof.step_label() == "device.step"
+    assert devprof.step_label("mega") == "device.step.mega"
+
+
+def test_mutant_step_label_blends(tmp_path):
+    """Mutation test (ISSUE 11): collapse step_label(kind) back to the
+    bare STEP_LABEL → the annotation-coverage pass reports
+    devprof.step_path_blended (the auto policy would arbitrate on a
+    blended device.step gauge)."""
+    from triton_dist_tpu.analysis import lint_annotations
+    dev_src = open(devprof.__file__.rstrip("c")).read()
+    mut = dev_src.replace(
+        'return f"{STEP_LABEL}.{kind}" if kind else STEP_LABEL',
+        'return STEP_LABEL')
+    assert mut != dev_src, "mutation site moved — update this test"
+    p = tmp_path / "devprof.py"
+    p.write_text(mut)
+    import triton_dist_tpu.serving.scheduler as sched_mod
+    findings = lint_annotations.check_sampler(p, sched_mod.__file__)
+    assert [f.code for f in findings] == ["devprof.step_path_blended"]
+
+
+def test_mutant_summarize_blends_step_paths(tmp_path):
+    """Mutation test: a parser that regexes clean but BLENDS the step
+    windows (two-segment rule stripped from _label_op) is caught by
+    the behavioral check, not just pattern matching."""
+    from triton_dist_tpu.analysis import lint_annotations
+    dev_src = open(devprof.__file__.rstrip("c")).read()
+    mut = dev_src.replace(
+        'if parts[0] == "step" and len(parts) > 1 and parts[1]:',
+        'if False:')
+    assert mut != dev_src, "mutation site moved — update this test"
+    p = tmp_path / "devprof.py"
+    p.write_text(mut)
+    import triton_dist_tpu.serving.scheduler as sched_mod
+    findings = lint_annotations.check_sampler(p, sched_mod.__file__)
+    assert [f.code for f in findings] == ["devprof.step_path_blended"]
+
+
+def test_mutant_scheduler_without_kind(tmp_path):
+    """Mutation test: a scheduler that stops bracketing the shared
+    decode step with the per-path step_label annotation blends mega
+    and plain decode time into the whole-iteration window."""
+    from triton_dist_tpu.analysis import lint_annotations
+    import triton_dist_tpu.serving.scheduler as sched_mod
+    sched_src = open(sched_mod.__file__.rstrip("c")).read()
+    mut = sched_src.replace("annotate(devprof.step_label(kind))",
+                            "contextlib.nullcontext()")
+    assert mut != sched_src, "mutation site moved — update this test"
+    p = tmp_path / "scheduler.py"
+    p.write_text(mut)
+    findings = lint_annotations.check_sampler(
+        devprof.__file__.rstrip("c"), p)
+    assert [f.code for f in findings] == ["devprof.step_path_blended"]
 
 
 # ---------------------------------------------------------------------------
@@ -561,6 +691,7 @@ def test_regress_from_file_gates_overlap(tmp_path):
               "flash_decode_vs_xla": 1.0,
               "serving_sched_vs_serial": 5.0,
               "serving_prefix_ttft_vs_cold": 5.0,
+              "serving_mega_vs_plain": 1.0,
               "ag_gemm_pallas_ms": 1.0, "baseline_anomaly": None}
     path = tmp_path / "ck.json"
     path.write_text(json.dumps({"extras": extras}))

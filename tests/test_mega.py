@@ -368,33 +368,36 @@ def test_engine_use_mega_matches_plain(mesh8, key):
                                   np.asarray(out_plain))
 
 
-def test_engine_use_mega_guards(mesh8, key):
-    """use_mega refuses the routes it cannot serve: sp/paged engines at
-    construction; per-row kv_start at decode."""
+def test_engine_decode_path_validation(mesh8):
+    """The remaining ILLEGAL combos stay config ValueErrors (not
+    asserts — they must survive ``python -O``): an unknown decode_path
+    and a use_mega/decode_path contradiction. The old
+    use_mega x (paged|sp|ragged) refusals are gone — those are real
+    code paths now (ISSUE 11, tests/test_scheduler.py)."""
     from triton_dist_tpu.models import Engine
     cfg = ModelConfig(hidden_size=64, intermediate_size=128,
                       num_hidden_layers=1, num_attention_heads=8,
                       num_key_value_heads=8, head_dim=8, vocab_size=128,
                       max_position_embeddings=32, dtype=jnp.float32)
     model = DenseLLM(cfg, mesh=mesh8, axis="tp", impl="xla")
-    # ValueError, not assert: the guard must survive ``python -O``
-    # (ADVICE r5 low).
-    with pytest.raises(ValueError, match="use_mega"):
-        Engine(model, batch=2, max_seq=16, prefill_mode="sp",
-               decode_mode="sp", use_mega=True)
-    params = model.init(key)
+    with pytest.raises(ValueError, match="decode_path"):
+        Engine(model, batch=2, max_seq=16, prefill_mode="xla_ar",
+               decode_mode="gemm_ar", decode_path="turbo")
+    with pytest.raises(ValueError, match="conflicting"):
+        Engine(model, batch=2, max_seq=16, prefill_mode="xla_ar",
+               decode_mode="gemm_ar", use_mega=True,
+               decode_path="plain")
+    # use_mega=True IS decode_path="mega" (legacy spelling).
     eng = Engine(model, batch=2, max_seq=16, prefill_mode="xla_ar",
                  decode_mode="gemm_ar", use_mega=True)
-    ids = jnp.ones((2, 4), jnp.int32)
-    with pytest.raises(ValueError, match="uniform-offset"):
-        eng.serve_ragged(params, [jnp.ones((3,), jnp.int32),
-                                  jnp.ones((5,), jnp.int32)], gen_len=2)
+    assert eng.decode_path == "mega" and eng.use_mega
 
 
-def test_engine_use_mega_stream_refused(mesh8, key):
-    """Continuous batching (per-row offsets) is unservable by the
-    uniform-offset mega program and must refuse loudly, not silently
-    fall back to the plain step (review r5m finding 1)."""
+def test_engine_use_mega_serves_ragged_and_stream(mesh8, key):
+    """ISSUE 11: the mega graph takes per-row kv_start/offset vectors,
+    so ragged serving AND continuous batching run under use_mega —
+    greedy outputs bit-identical to the plain decode path (the two
+    refusals this test replaces are deleted)."""
     from triton_dist_tpu.models import Engine
     cfg = ModelConfig(hidden_size=64, intermediate_size=128,
                       num_hidden_layers=1, num_attention_heads=8,
@@ -402,11 +405,18 @@ def test_engine_use_mega_stream_refused(mesh8, key):
                       max_position_embeddings=32, dtype=jnp.float32)
     model = DenseLLM(cfg, mesh=mesh8, axis="tp", impl="xla")
     params = model.init(key)
-    eng = Engine(model, batch=2, max_seq=16, prefill_mode="xla_ar",
-                 decode_mode="gemm_ar", use_mega=True)
-    with pytest.raises(ValueError, match="serve_stream"):
-        eng.serve_stream(params, [jnp.ones((3,), jnp.int32)], gen_len=2)
-    # ...and equal-length (all-zero kv_start) ragged batches ARE served.
-    out = eng.serve_ragged(params, [jnp.ones((4,), jnp.int32),
-                                    jnp.ones((4,), jnp.int32)], gen_len=2)
-    assert len(out) == 2
+
+    def eng(path):
+        return Engine(model, batch=2, max_seq=32, prefill_mode="xla_ar",
+                      decode_mode="gemm_ar", decode_path=path)
+
+    prompts = [[1, 2, 3], [9, 8, 7, 6, 5]]
+    rag_p = eng("plain").serve_ragged(params, prompts, gen_len=4)
+    rag_m = eng("mega").serve_ragged(params, prompts, gen_len=4)
+    for a, b in zip(rag_p, rag_m):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    st_p = eng("plain").serve_stream(params, prompts + [[4, 4], [5]],
+                                     gen_len=3)
+    st_m = eng("mega").serve_stream(params, prompts + [[4, 4], [5]],
+                                    gen_len=3)
+    assert st_p == st_m

@@ -23,6 +23,13 @@ overlap, uniform and ragged), oversubscribed pools running through the
 shared-batch path, prefix + block-pool metrics through the metrics
 command and tools/report.py, and an autouse leak audit asserting every
 paged engine's block pool is fully returned after each scenario.
+
+ISSUE 11 (mega decode in the shared batch) adds: greedy bit-identity
+mega-vs-plain under ragged offsets, mid-decode admission/retirement,
+oversubscribed paged pools, and prefix-cache warm hits; plus the
+decode-path auto-selection policy unit tests (injected device.step.*
+gauge values, both flip directions, the no-measurement default, and
+the TDT_MEGA_AUTO opt-out).
 """
 
 import json
@@ -94,11 +101,12 @@ def _engine(model, batch=2, max_seq=64):
 
 
 def _paged_engine(model, batch=2, max_seq=64, page=4, slots=None,
-                  prefix=True):
+                  prefix=True, decode_path=None):
     eng = Engine(model, batch=batch, max_seq=max_seq,
                  prefill_mode="sp", decode_mode="sp", paged=True,
                  page_size=page, prefix_cache=prefix,
-                 kv_slots_per_dev=slots)
+                 kv_slots_per_dev=slots,
+                 **({"decode_path": decode_path} if decode_path else {}))
     _PAGED_ENGINES.append(eng)
     return eng
 
@@ -650,8 +658,9 @@ def test_paged_prefix_metrics_and_report(paged_tiny):
 
 
 def test_server_serialized_path_still_works(tiny):
-    """scheduler=False keeps the pre-scheduler serialized route (the
-    mega-engine fallback) intact, clamp echo included."""
+    """scheduler=False keeps the pre-scheduler serialized route (now
+    an explicit override only — mega engines schedule) intact, clamp
+    echo included."""
     model, params = tiny
     srv = ModelServer(_engine(model, batch=1, max_seq=16), params,
                       port=0, scheduler=False).start()
@@ -810,6 +819,183 @@ def test_slo_no_false_positive_under_default_targets(tiny):
                 assert v == 0, k
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11: mega decode in the shared batch + decode-path auto-selection.
+# ---------------------------------------------------------------------------
+
+def test_scheduler_mega_matches_plain_ragged_overbatch(tiny):
+    """Tentpole acceptance (dense family): the mega one-program step
+    pumped by the scheduler is greedily bit-identical to the plain
+    path under ragged per-row offsets AND mid-decode
+    admission/retirement — 6 mixed-length prompts through a 2-row
+    window, so rows retire and re-admit while others decode."""
+    model, params = tiny
+    prompts = [[1, 2, 3], [9, 8], [4, 5, 6, 7], [11], [23, 29],
+               [7, 7, 7]]
+    outs = {}
+    for path in ("mega", "plain"):
+        eng = Engine(model, batch=2, max_seq=64, prefill_mode="xla_ar",
+                     decode_mode="gemm_ar", decode_path=path)
+        sched = Scheduler(eng, params).start()
+        try:
+            reqs = [sched.submit(p, 5) for p in prompts]
+            outs[path] = [r.result(timeout=180) for r in reqs]
+        finally:
+            sched.stop()
+    assert outs["mega"] == outs["plain"]
+    for p, row in zip(prompts, outs["mega"]):
+        assert row == _solo(model, params, p, 5), p
+
+
+def test_scheduler_mega_paged_prefix_matches_plain(paged_tiny):
+    """Tentpole acceptance (paged family): Engine(use_mega=True,
+    paged=True) serves through the scheduler — per-row offsets against
+    the paged pool's table lanes, prefix-cache WARM hits included —
+    bit-identical to the plain paged scheduler path and to the solo
+    golden."""
+    model, params = paged_tiny
+    pre = list(range(1, 9))                 # 8 tokens = 2 full pages
+    prompts = [pre + [20],                  # cold (indexes the preamble)
+               pre + [30, 31],              # warm full-prefix hit, ragged
+               pre[:4] + [40, 41],          # partial overlap
+               [50, 51, 52],                # no overlap
+               pre + [60]]                  # another warm hit
+    outs = {}
+    hits = {}
+    for path in ("mega", "plain"):
+        eng = _paged_engine(model, decode_path=path)
+        sched = Scheduler(eng, params).start()
+        try:
+            reqs = [sched.submit(p, 5) for p in prompts]
+            outs[path] = [r.result(timeout=180) for r in reqs]
+        finally:
+            sched.stop()
+        hits[path] = eng.kv.prefix.stats()["hit_blocks"]
+    assert outs["mega"] == outs["plain"]
+    assert hits["mega"] >= 4, hits          # the warm hits really hit
+    for p, row in zip(prompts, outs["mega"]):
+        assert row == _solo_paged_golden(model, params, p, 5), p
+
+
+def test_scheduler_mega_oversubscribed_pool(paged_tiny):
+    """The mega step streams an OVERSUBSCRIBED pool like the plain one:
+    more concurrent requests than whole-row capacity, block-granular
+    admission waits, correct results (the leak audit re-checks the
+    pool after teardown)."""
+    model, params = paged_tiny
+    eng = _paged_engine(model, batch=3, slots=5, decode_path="mega")
+    sched = Scheduler(eng, params).start()
+    try:
+        prompts = [[2 * i + 1, 2 * i + 2] for i in range(5)]
+        reqs = [sched.submit(p, 6) for p in prompts]
+        for p, r in zip(prompts, reqs):
+            assert r.result(timeout=180) == _solo_paged_golden(
+                model, params, p, 6), p
+    finally:
+        sched.stop()
+
+
+def test_decode_path_auto_policy_unit(monkeypatch):
+    """Auto-selection consumes MEASURED device.step.* gauges: both
+    flip directions, the no-measurement default, provenance counters,
+    and the TDT_MEGA_AUTO opt-out."""
+    from triton_dist_tpu import obs
+    from triton_dist_tpu.models.engine import DecodePathPolicy
+    reg = obs.enable(obs.Registry())
+    try:
+        pol = DecodePathPolicy()
+        # No measurement → the chip-prior default (mega), counted as
+        # provenance "default".
+        assert pol.decide() == "mega"
+        # One-sided measurement is NOT a comparison → still default.
+        reg.gauge("device.step.mega.total_ms").set(5.0)
+        assert pol.decide() == "mega"
+        snap = reg.snapshot()["counters"]
+        assert snap["engine.decode_path.auto_source.default"] == 2
+        # Both measured: slower mega → plain ...
+        reg.gauge("device.step.plain.total_ms").set(2.0)
+        assert pol.decide() == "plain"
+        assert reg.snapshot()["gauges"]["serving.mega_selected"] == 0.0
+        # ... and the other flip direction.
+        reg.gauge("device.step.plain.total_ms").set(9.0)
+        assert pol.decide() == "mega"
+        snap = reg.snapshot()
+        assert snap["counters"]["engine.decode_path.auto_mega"] == 3
+        assert snap["counters"]["engine.decode_path.auto_plain"] == 1
+        assert snap["counters"][
+            "engine.decode_path.auto_source.measured"] == 2
+        assert snap["gauges"]["serving.mega_selected"] == 1.0
+        # Per-WINDOW normalization: a 4-iteration breach capture's
+        # unioned plain total (9 ms / 4 windows = 2.25/step) must beat
+        # a single-window 5 ms mega step — comparing raw unions would
+        # pick mega.
+        reg.gauge("device.step.plain.windows").set(4.0)
+        assert pol.decide() == "plain"
+        reg.gauge("device.step.plain.windows").set(1.0)
+        # Probe beat: every PROBE_EVERY-th SAMPLABLE decision runs the
+        # OTHER path (provenance "probe") so a live sampler can
+        # measure or refresh it — without it, only the winning path's
+        # gauge ever updates and the policy could never correct
+        # itself. Doubly measurability-gated: no probes without a live
+        # devprof sampler, and none for non-samplable decisions
+        # (serve() resolved outside the pump would run a whole
+        # generation on the probed path with nothing able to capture
+        # it).
+        kinds = [pol.decide(samplable=True)
+                 for _ in range(DecodePathPolicy.PROBE_EVERY)]
+        assert "plain" not in kinds, "probe fired with no sampler"
+        from triton_dist_tpu.obs import devprof
+        sampler = devprof.PumpSampler(every=10 ** 9, sync=True)
+        kinds = [pol.decide()         # non-samplable: still no probe
+                 for _ in range(DecodePathPolicy.PROBE_EVERY)]
+        assert "plain" not in kinds, "probe fired for serve()-style call"
+        kinds = [pol.decide(samplable=True)
+                 for _ in range(DecodePathPolicy.PROBE_EVERY)]
+        assert "plain" in kinds, "no probe fired in a full period"
+        assert reg.snapshot()["counters"][
+            "engine.decode_path.auto_source.probe"] >= 1
+        del sampler
+        # Env opt-out: auto resolves to plain regardless of gauges.
+        monkeypatch.setenv("TDT_MEGA_AUTO", "0")
+        off = DecodePathPolicy()
+        reg.gauge("device.step.plain.total_ms").set(999.0)
+        assert off.decide() == "plain"
+        assert reg.snapshot()["counters"][
+            "engine.decode_path.auto_source.env_off"] == 1
+    finally:
+        obs.disable()
+
+
+def test_scheduler_auto_decode_path_serves(tiny):
+    """Engine(decode_path="auto") through the scheduler: decisions are
+    taken per pump iteration (provenance counted) and results stay
+    bit-identical to solo serving whatever the policy picks."""
+    from triton_dist_tpu import obs
+    model, params = tiny
+    eng = Engine(model, batch=2, max_seq=64, prefill_mode="xla_ar",
+                 decode_mode="gemm_ar", decode_path="auto")
+    reg = obs.enable(obs.Registry())
+    try:
+        sched = Scheduler(eng, params).start()
+        try:
+            prompts = [[1, 2, 3], [9, 8], [4, 5, 6, 7]]
+            reqs = [sched.submit(p, 4) for p in prompts]
+            got = [r.result(timeout=180) for r in reqs]
+        finally:
+            sched.stop()
+        for p, row in zip(prompts, got):
+            assert row == _solo(model, params, p, 4), p
+        snap = reg.snapshot()["counters"]
+        decisions = (snap.get("engine.decode_path.auto_mega", 0)
+                     + snap.get("engine.decode_path.auto_plain", 0))
+        assert decisions >= 1
+        sources = [k for k in snap
+                   if k.startswith("engine.decode_path.auto_source.")]
+        assert sources, snap
+    finally:
+        obs.disable()
 
 
 def test_metrics_catalog_wellformed(tiny, monkeypatch):

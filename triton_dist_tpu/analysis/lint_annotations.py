@@ -17,8 +17,19 @@ mis-attribution:
   invocation in an annotate call whose label starts with
   ``device.`` (mutation test: strip the ``with`` → this finding).
 - ``devprof.step_unlabeled`` — the pump sampler's iteration wrapper
-  no longer plants :data:`obs.devprof.STEP_LABEL`, or the scheduler
-  pump no longer routes its engine work through ``.iteration()``.
+  no longer plants :data:`obs.devprof.STEP_LABEL` (directly or via
+  ``step_label()``), or the scheduler pump no longer routes its
+  engine work through ``.iteration()``.
+- ``devprof.step_path_blended`` — the per-decode-path step labels
+  degraded: ``step_label("mega")`` no longer yields
+  ``device.step.mega``, ``summarize`` blends ``device.step.mega`` /
+  ``device.step.plain`` windows into one ``step`` op (checked
+  BEHAVIORALLY, on synthetic events, against the file under lint), or
+  the scheduler stopped bracketing the shared decode step with
+  ``annotate(devprof.step_label(kind))``. Any of these silently hands
+  the auto decode-path policy (``Engine(decode_path="auto")``) a
+  blended or empty gauge to arbitrate on — mutation tests strip each
+  in turn.
 - ``devprof.bad_op_label`` — a ``@resilient`` op name contains a dot,
   which would corrupt the ``device.<op>.*`` metric prefix the parser
   derives from label segment 2.
@@ -176,7 +187,8 @@ def check_sampler(devprof_path, scheduler_path) -> list[Finding]:
             file=str(devprof_path), pass_name="annotation-coverage")]
     if not re.search(r'STEP_LABEL\s*=\s*["\']device\.step["\']',
                      dev_src) \
-            or not re.search(r"annotate\(STEP_LABEL\)", dev_src):
+            or not re.search(r"annotate\((?:STEP_LABEL\)|step_label\()",
+                             dev_src):
         findings.append(Finding(
             code="devprof.step_unlabeled",
             message="obs/devprof.py no longer annotates profiled pump "
@@ -187,7 +199,7 @@ def check_sampler(devprof_path, scheduler_path) -> list[Finding]:
             fix_hint="keep STEP_LABEL='device.step' and the "
                      "annotate(STEP_LABEL) wrapper in "
                      "PumpSampler.iteration"))
-    if ".iteration()" not in sched_src:
+    if ".iteration(" not in sched_src:
         findings.append(Finding(
             code="devprof.step_unlabeled",
             message="serving/scheduler.py pump no longer wraps its "
@@ -197,6 +209,77 @@ def check_sampler(devprof_path, scheduler_path) -> list[Finding]:
             pass_name="annotation-coverage",
             fix_hint="wrap the lock-free engine-work region of "
                      "_pump_loop in self.devprof.iteration()"))
+    findings += _check_step_paths(devprof_path, scheduler_path,
+                                  dev_src, sched_src)
+    return findings
+
+
+#: Synthetic capture used for the BEHAVIORAL step-path check: one exec
+#: event inside a ``device.step.mega`` window, one inside a
+#: ``device.step.plain`` window. A correct parser attributes them to
+#: two distinct ops; a blending mutant books both under ``step``.
+_STEP_PATH_EVENTS = [
+    {"name": "device.step.mega", "ts_us": 0.0, "dur_us": 100.0,
+     "pid": 1, "tid": 1, "device": False},
+    {"name": "fusion.exec", "ts_us": 10.0, "dur_us": 50.0,
+     "pid": 2, "tid": 1, "device": True},
+    {"name": "device.step.plain", "ts_us": 200.0, "dur_us": 100.0,
+     "pid": 1, "tid": 1, "device": False},
+    {"name": "fusion.exec", "ts_us": 210.0, "dur_us": 50.0,
+     "pid": 2, "tid": 1, "device": True},
+]
+
+
+def _check_step_paths(devprof_path, scheduler_path, dev_src,
+                      sched_src) -> list[Finding]:
+    """The per-decode-path step attribution holds end to end: the
+    label builder, the parser (run on synthetic events — a behavioral
+    check, so a rewrite that regexes clean but still blends fails),
+    and the scheduler's path naming."""
+    findings: list[Finding] = []
+
+    def blended(msg: str, path, fix: str) -> Finding:
+        return Finding(
+            code="devprof.step_path_blended", message=msg,
+            file=str(path), line=1, pass_name="annotation-coverage",
+            fix_hint=fix)
+
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "_tdt_lint_devprof", devprof_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        lbl = mod.step_label("mega")
+        ops = mod.summarize(list(_STEP_PATH_EVENTS))["ops"]
+        ok = (lbl == "device.step.mega"
+              and "step.mega" in ops and "step.plain" in ops
+              and "step" not in ops)
+    except Exception as e:  # noqa: BLE001 — an unloadable file fails
+        findings.append(blended(
+            f"cannot evaluate obs/devprof.py step-path attribution: "
+            f"{e!r}", devprof_path,
+            "keep step_label() and summarize() importable"))
+        return findings
+    if not ok:
+        findings.append(blended(
+            "obs/devprof.py no longer attributes device.step.mega / "
+            "device.step.plain windows to separate step.<kind> ops — "
+            "the auto decode-path policy would arbitrate on a blended "
+            "(or empty) device.step gauge",
+            devprof_path,
+            "keep step_label(kind) -> f'{STEP_LABEL}.{kind}' and the "
+            "step two-segment rule in _label_op/summarize"))
+    if not re.search(r"annotate\(\s*devprof\.step_label\(", sched_src):
+        findings.append(blended(
+            "serving/scheduler.py no longer brackets the shared "
+            "decode step with the per-path devprof.step_label(kind) "
+            "annotation — mega and plain decode steps would blend "
+            "into the whole-iteration device.step window (admission/"
+            "prefill contamination included)", scheduler_path,
+            "wrap the sess.decode_step() call in "
+            "annotate(devprof.step_label(kind)) while a capture is "
+            "open"))
     return findings
 
 

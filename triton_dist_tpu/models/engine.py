@@ -59,6 +59,114 @@ def sample_token(logits: jax.Array, key: jax.Array | None = None,
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+#: The auto policy's prior when no measurement exists: the only silicon
+#: evidence on record has the mega one-program step 1.49x the plain
+#: jitted step (docs/perf.md "First chip contact").
+DEFAULT_AUTO_PATH = "mega"
+
+
+class DecodePathPolicy:
+    """``Engine(decode_path="auto")`` arbitration: measured device-step
+    gauges pick mega vs plain.
+
+    The devprof pump sampler (obs.devprof, docs/observability.md
+    "Device-time truth") labels each profiled pump iteration with the
+    decode path that drove it, so parsed captures land in SEPARATE
+    ``device.step.mega.*`` / ``device.step.plain.*`` gauges. The
+    comparison is PER WINDOW — ``total_ms / windows``, since a
+    multi-iteration breach capture unions several step windows into
+    one total and a union is not comparable across capture spans. When
+    both paths hold a measured per-iteration time, the faster one
+    wins; the decision is re-taken per batch (every pump iteration /
+    serve call), so the selection tracks the batch shape the captures
+    were taken at — silicon numbers arbitrating, the same way
+    perfwatch live ratios arbitrate router policy
+    (docs/resilience.md). With no measurement (or only one path
+    measured) the default is :data:`DEFAULT_AUTO_PATH` — except every
+    :data:`PROBE_EVERY`-th decision, which runs the OTHER path so the
+    sampler can ever measure it (the perfwatch-probe analog: a policy
+    that only runs its prior can never collect the numbers to correct
+    it; outputs are bit-identical, so a probe costs only the paths'
+    speed difference). Probes are doubly gated on measurability: only
+    SAMPLABLE decisions probe (stream-session decode steps under the
+    scheduler — ``decide(samplable=True)``; a serve() call resolved
+    outside the pump would run its whole generation on the probed
+    path with nothing able to capture it), and only while a devprof
+    sampler is alive (``obs.devprof.sampler_active()`` — the same
+    consumer-gating rationale as ``devprof.arm``). Every decision is
+    provenance-counted
+    (``engine.decode_path.auto_source.*``) so a dashboard can tell
+    measured decisions from prior-based and probe ones.
+    ``TDT_MEGA_AUTO=0`` opts out: auto resolves to plain, counted as
+    ``env_off``. Either path is greedily bit-identical
+    (tests/test_scheduler.py), so the policy is a pure perf choice.
+    """
+
+    #: Every Nth decision probes the non-default (or measured-stale)
+    #: path — keeps both device.step.* gauges collectable/refreshable.
+    PROBE_EVERY = 32
+
+    def __init__(self, enabled: bool | None = None):
+        if enabled is None:
+            import os
+            enabled = os.environ.get("TDT_MEGA_AUTO",
+                                     "1").strip() != "0"
+        self.enabled = bool(enabled)
+        self._n = 0
+
+    @staticmethod
+    def measured_step_ms(kind: str) -> float | None:
+        """The measured device time of one ``kind`` pump iteration
+        (per annotation window) from the last parsed capture, or None
+        when never measured (gauges default to 0 — a zero-length
+        capture is not a measurement)."""
+        total = float(obs.gauge(f"device.step.{kind}.total_ms").value)
+        if total <= 0.0:
+            return None
+        windows = float(obs.gauge(f"device.step.{kind}.windows").value)
+        return total / windows if windows > 0 else total
+
+    @staticmethod
+    def _can_probe() -> bool:
+        """A probe only makes sense where some sampler could capture
+        it into the gauges this policy reads."""
+        from triton_dist_tpu.obs import devprof
+        return devprof.sampler_active()
+
+    def decide(self, samplable: bool = False) -> str:
+        """"mega" or "plain" for the next decode step/serve call.
+
+        ``samplable``: this decision drives work a pump sampler could
+        actually capture (a StreamSession decode step under the
+        scheduler). Only those decisions may probe — a serve() call
+        resolved outside the pump would run its WHOLE generation on
+        the probed path with no possibility of measurement."""
+        if not self.enabled:
+            kind, source = "plain", "env_off"
+        else:
+            self._n += 1
+            mega_ms = self.measured_step_ms("mega")
+            plain_ms = self.measured_step_ms("plain")
+            if mega_ms is not None and plain_ms is not None:
+                kind = "mega" if mega_ms <= plain_ms else "plain"
+                source = "measured"
+            else:
+                kind, source = DEFAULT_AUTO_PATH, "default"
+            if samplable and self._n % self.PROBE_EVERY == 0 \
+                    and self._can_probe():
+                # Exploration beat: run the other path this once so a
+                # live sampler can (re)measure it — otherwise only the
+                # winning path's gauge ever refreshes and the policy
+                # can neither correct its prior nor notice staleness.
+                kind = "plain" if kind == "mega" else "mega"
+                source = "probe"
+        obs.counter(f"engine.decode_path.auto_{kind}").inc()
+        obs.counter(f"engine.decode_path.auto_source.{source}").inc()
+        obs.gauge("serving.mega_selected").set(
+            1.0 if kind == "mega" else 0.0)
+        return kind
+
+
 class Engine:
     """Serve loop around a DenseLLM / Qwen3MoE model."""
 
@@ -70,6 +178,7 @@ class Engine:
                  paged: bool = False, page_size: int = 16,
                  prefill_chunk: int | None = None,
                  use_mega: bool = False,
+                 decode_path: str | None = None,
                  prefix_cache: bool | None = None,
                  kv_slots_per_dev: int | None = None,
                  slo=None):
@@ -92,19 +201,34 @@ class Engine:
             prefix_cache = os.environ.get("TDT_PREFIX_CACHE",
                                           "1").strip() != "0"
         self.prefix_cache = bool(prefix_cache) and paged
-        # use_mega: decode through the MegaQwen3 fused one-program step
-        # (the task-graph megakernel analog) — measured 1.49x the plain
-        # jitted decode step on chip (docs/perf.md "First chip
-        # contact"). Uniform-offset decode only: no paged pools, no
-        # per-row kv_start (serve_ragged) — those routes raise.
-        self.use_mega = use_mega
-        if use_mega and (paged or "sp" in (prefill_mode, decode_mode)):
+        # decode_path: which decode-step program serves this engine.
+        # "plain" runs model.forward under jit; "mega" runs the
+        # MegaQwen3 fused one-program task-graph step (measured 1.49x
+        # the plain jitted step on chip, docs/perf.md "First chip
+        # contact"); "auto" arbitrates per batch on the measured
+        # device.step.{mega,plain}.total_ms gauges the devprof pump
+        # sampler publishes (DecodePathPolicy; TDT_MEGA_AUTO=0 opts
+        # out). use_mega=True is the legacy spelling of
+        # decode_path="mega". Every engine family serves every path —
+        # the mega graph takes per-row kv_start/offset vectors and
+        # paged block tables (ISSUE 11), so the old
+        # use_mega x (paged|sp|ragged) ValueErrors are gone.
+        if decode_path is None:
+            decode_path = "mega" if use_mega else "plain"
+        elif use_mega and decode_path != "mega":
             # ValueError, not assert: user-facing configuration
-            # validation must survive ``python -O`` (ADVICE r5 low;
-            # matches the serve()/serve_stream() guards).
+            # validation must survive ``python -O`` (ADVICE r5 low).
             raise ValueError(
-                "use_mega serves the dense uniform-offset engine — "
-                "not paged/sp configurations")
+                f"conflicting config: use_mega=True with "
+                f"decode_path={decode_path!r} — pass one or the other")
+        if decode_path not in ("plain", "mega", "auto"):
+            raise ValueError(
+                f"decode_path must be 'plain', 'mega' or 'auto': "
+                f"{decode_path!r}")
+        self.decode_path = decode_path
+        self.use_mega = decode_path == "mega"
+        self.decode_policy = (DecodePathPolicy()
+                              if decode_path == "auto" else None)
         self._mega = None
         if "sp" in (prefill_mode, decode_mode):
             # Sequence-parallel serving (long context): both phases must
@@ -163,9 +287,10 @@ class Engine:
             assert prefill_mode == "sp" and not paged, (
                 "prefill_chunk applies to the (non-paged) sp engine")
         self.prefill_chunk = prefill_chunk
-        self._decode_step = None
-        self._decode_step_stop = None
+        self._decode_step: dict = {}        # decode path → jitted step
+        self._decode_step_stop: dict = {}
         self._stream_step = None
+        self._stream_step_mega = None
         self._admit = None
         self._admit_prefix = None
         self._admit_chunk = None
@@ -176,26 +301,37 @@ class Engine:
         if self._mega is None:
             from triton_dist_tpu.mega import MegaQwen3
             self._mega = MegaQwen3(self.model,
-                                   decode_mode=self.decode_mode)
+                                   decode_mode=self.decode_mode,
+                                   paged=self.paged)
         return self._mega
 
     def _mega_forward(self, params, caches, token, offset, kv_start,
                       table):
-        """The mega program as a forward: uniform-offset decode only.
-        ``kv_start`` is ignored — serve()'s uniform path passes all
-        zeros and the ragged/paged routes are rejected at entry (the
-        array is a tracer here, so value checks cannot live in the
-        step)."""
-        if table is not None:
-            raise ValueError("use_mega does not serve paged tables")
-        return self._get_mega().step(params, token[:, None], caches,
-                                     offset)
+        """The mega one-program step as a decode forward: scalar OR
+        per-row ``offset``, ragged ``kv_start``, contiguous or paged
+        caches — the same surface the plain forward serves, so the two
+        paths interchange under every serving mode (ISSUE 11)."""
+        return self._get_mega().step(
+            params, token[:, None], caches, offset,
+            kv_start=None if self.decode_mode == "sp" else kv_start,
+            table=table)
 
-    def _decode_forward(self):
-        """The decode-step forward: the mega one-program step under
-        use_mega, model.forward otherwise — one place, so the sampling
+    def resolve_decode_path(self, samplable: bool = False) -> str:
+        """The decode path this call runs: the static config, or the
+        auto policy's measured-gauge decision — re-taken per call, so
+        the selection follows the batch as it changes (docs/serving.md
+        "Decode-path selection"). ``samplable`` marks decisions whose
+        work a pump sampler could capture (stream-session decode
+        steps) — the only ones allowed to probe."""
+        if self.decode_path != "auto":
+            return self.decode_path
+        return self.decode_policy.decide(samplable=samplable)
+
+    def _decode_forward(self, path: str = "plain"):
+        """The decode-step forward for one decode path: the mega
+        one-program step or model.forward — one place, so the sampling
         and stop bookkeeping below exist once per builder."""
-        if self.use_mega:
+        if path == "mega":
             return self._mega_forward
         model, mode = self.model, self.decode_mode
 
@@ -206,8 +342,8 @@ class Engine:
                 **({"block_table": table} if table is not None else {}))
         return fwd
 
-    def _build_decode_step(self):
-        fwd = self._decode_forward()
+    def _build_decode_step(self, path: str = "plain"):
+        fwd = self._decode_forward(path)
 
         @jax.jit
         def step(params, caches, token, offset, key, kv_start, table):
@@ -218,11 +354,11 @@ class Engine:
             return nxt, caches
         return step
 
-    def _build_decode_step_stop(self):
+    def _build_decode_step_stop(self, path: str = "plain"):
         """Decode step with in-graph stop bookkeeping: still ONE compiled
         program per token (jit caches per stop-set shape); stopped rows
         keep emitting their stop token."""
-        fwd = self._decode_forward()
+        fwd = self._decode_forward(path)
 
         @jax.jit
         def step(params, caches, token, offset, key, done, stop, kv_start,
@@ -262,23 +398,17 @@ class Engine:
         timed = tel or tr
         t_serve0 = time.perf_counter() if timed else 0.0
         obs.counter("engine.serve_calls").inc()
-        obs.counter("engine.decode_path.mega" if self.use_mega
-                    else "engine.decode_path.plain").inc()
+        # Resolve the decode path ONCE per serve call (auto re-decides
+        # here — per batch); the mega graph serves paged tables and
+        # ragged kv_start like the plain forward, so no shape guard.
+        path = self.resolve_decode_path()
+        obs.counter(f"engine.decode_path.{path}").inc()
         if stop_tokens is None:
             eos = getattr(self.model.config, "eos_token_id", -1)
             stop_tokens = (eos,) if eos >= 0 else ()
         stop_tokens = tuple(stop_tokens)
         has_stop = bool(stop_tokens)
         stop = jnp.asarray(list(stop_tokens) or [-1], jnp.int32)
-        if self.use_mega and kv_start is not None \
-                and np.any(np.asarray(kv_start)):
-            # All-zero kv_start IS the uniform batch (serve() itself
-            # passes zeros when the caller gave None), so equal-length
-            # ragged batches stay servable under mega.
-            raise ValueError(
-                "use_mega decodes uniform-offset batches only — "
-                "nonzero per-row kv_start (ragged serving) needs "
-                "use_mega=False")
         kv_start = (jnp.zeros((b,), jnp.int32) if kv_start is None
                     else jnp.asarray(kv_start, jnp.int32))
         self.kv.reset()
@@ -334,10 +464,13 @@ class Engine:
                     args={"batch": b, "prompt_len": s,
                           "chunked": bool(chunk and s > (chunk or 0))})
 
-        if self._decode_step is None:
-            self._decode_step = self._build_decode_step()
-        if has_stop and self._decode_step_stop is None:
-            self._decode_step_stop = self._build_decode_step_stop()
+        if path not in self._decode_step:
+            self._decode_step[path] = self._build_decode_step(path)
+        decode_step = self._decode_step[path]
+        if has_stop and path not in self._decode_step_stop:
+            self._decode_step_stop[path] = \
+                self._build_decode_step_stop(path)
+        decode_step_stop = self._decode_step_stop.get(path)
         # With stop tokens the bookkeeping lives INSIDE the jitted step —
         # still one dispatch per token; without, the plain step runs.
         done = jnp.isin(token, stop) if has_stop else None
@@ -355,11 +488,11 @@ class Engine:
                     self.key, sub = jax.random.split(self.key)
                     off = jnp.int32(self.kv.offset)
                     if has_stop:
-                        token, caches, done = self._decode_step_stop(
+                        token, caches, done = decode_step_stop(
                             params, caches, token, off, sub, done, stop,
                             kv_start, table)
                     else:
-                        token, caches = self._decode_step(
+                        token, caches = decode_step(
                             params, caches, token, off, sub, kv_start,
                             table)
                     if timed:
@@ -415,7 +548,7 @@ class Engine:
                     (now - t_serve0) * 1e6,
                     args={"batch": b, "prompt_len": s,
                           "gen_len": gen_len, "steps_run": steps_run,
-                          "mega": self.use_mega})
+                          "mega": path == "mega"})
         return jnp.concatenate(out, axis=1)
 
 
@@ -431,6 +564,26 @@ class Engine:
             logits, caches = model.forward(
                 params, token[:, None], caches, offsets, mode=mode,
                 **({"block_table": table} if table is not None else {}))
+            nxt = sample_token(logits[:, -1], key, self.temperature,
+                               self.top_k, self.top_p)
+            nxt = jnp.where(done, token, nxt)
+            return nxt, caches, jnp.where(done, offsets, offsets + 1)
+        return step
+
+    def _build_stream_step_mega(self):
+        """The continuous-batching decode step through the mega
+        one-program task graph: the per-row offset vector threads into
+        the graph's attention position math and per-row KV scatter
+        (contiguous lanes or paged table lanes) — same contract and
+        same ops as :meth:`_build_stream_step`, so greedy outputs are
+        bit-identical (tests/test_scheduler.py) and a session can flip
+        between the two steps mid-request (decode_path="auto")."""
+        fwd = self._mega_forward
+
+        @jax.jit
+        def step(params, caches, token, offsets, key, done, table):
+            logits, caches = fwd(params, caches, token, offsets, None,
+                                 table)
             nxt = sample_token(logits[:, -1], key, self.temperature,
                                self.top_k, self.top_p)
             nxt = jnp.where(done, token, nxt)
@@ -723,12 +876,6 @@ class StreamSession:
     """
 
     def __init__(self, engine: Engine, params):
-        if engine.use_mega:
-            raise ValueError(
-                "use_mega decodes uniform-offset batches only — "
-                "continuous batching runs every row at its own "
-                "cache offset; serve_stream / stream sessions need "
-                "use_mega=False")
         self.engine = engine
         self.params = params
         b = engine.kv.batch
@@ -760,6 +907,7 @@ class StreamSession:
         self.token = jnp.zeros((b,), jnp.int32)
         self.offsets = jnp.zeros((b,), jnp.int32)
         self.live = [False] * b
+        self._decode_kind: str | None = None  # decided path, unconsumed
         self._host_off = [0] * b     # host shadow of per-row offsets
         self._pending: dict[int, dict] = {}   # row → chunked-prefill state
         #: Facts about the most recent completed admission (currently
@@ -996,11 +1144,36 @@ class StreamSession:
         self.live[row] = True
 
     # -- decode / retire ---------------------------------------------------
+    def decode_kind(self) -> str:
+        """The decode path the NEXT :meth:`decode_step` will run
+        ("mega"/"plain"): the engine's static config, or the auto
+        policy's measured-gauge decision for the current batch. The
+        scheduler calls this right before opening a devprof iteration
+        window so the capture's ``device.step.<kind>`` label names the
+        path that actually drove it; the decision is cached and
+        consumed by the following decode_step. Stream decode steps are
+        samplable work, so these decisions may probe."""
+        self._decode_kind = self.engine.resolve_decode_path(
+            samplable=True)
+        return self._decode_kind
+
     def decode_step(self) -> np.ndarray:
         """One shared decode step: every live row decodes at its own
         cache position, frozen rows re-emit their token. Returns the
-        (batch,) token vector as numpy."""
+        (batch,) token vector as numpy.
+
+        Runs the plain stream step or the mega one-program step per
+        :meth:`decode_kind` — both are greedily bit-identical, so the
+        auto policy may flip paths between steps of one request."""
         eng = self.engine
+        kind = self._decode_kind or self.decode_kind()
+        self._decode_kind = None
+        if kind == "mega":
+            if eng._stream_step_mega is None:
+                eng._stream_step_mega = eng._build_stream_step_mega()
+            step_fn = eng._stream_step_mega
+        else:
+            step_fn = eng._stream_step
         if eng.paged:
             # Incremental block allocation: grow any live row whose
             # NEXT write position crosses into an unallocated page —
@@ -1014,7 +1187,7 @@ class StreamSession:
         done = jnp.asarray([not alive for alive in self.live])
         with obs.span("engine.stream_step"):
             eng.key, sub = jax.random.split(eng.key)
-            self.token, self.caches, self.offsets = eng._stream_step(
+            self.token, self.caches, self.offsets = step_fn(
                 self.params, self.caches, self.token, self.offsets, sub,
                 done, self.cur_table)
             if obs.enabled() or _trace.enabled():

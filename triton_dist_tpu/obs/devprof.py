@@ -36,7 +36,11 @@ missing read-back side:
 - **Sample serving continuously** (:class:`PumpSampler`):
   ``TDT_DEVPROF_EVERY=N`` profiles one pump iteration every N, parses
   ASYNC off the pump thread, and feeds the ``device.step.*``
-  attribution gauges; ``TDT_DEVPROF_ON_BREACH=N`` arms a bounded
+  attribution gauges — plus the decode-step-only sub-windows the
+  scheduler brackets per decode path (``device.step.mega.*`` /
+  ``device.step.plain.*``, :func:`step_label`), so the auto
+  decode-path policy (models/engine.py) arbitrates on unblended,
+  admission-free numbers; ``TDT_DEVPROF_ON_BREACH=N`` arms a bounded
   capture of the next N pump iterations when the flight recorder
   dumps (SLO breach, watchdog trip, breaker open) — the postmortem
   then includes what the chip actually did, not just host events.
@@ -76,13 +80,17 @@ __all__ = [
     "PumpSampler", "STEP_LABEL", "arm", "armed_reason",
     "devprof_dir", "find_captures", "last_profile", "load_capture",
     "op_label", "parse_capture", "parse_xplane", "publish", "reset",
-    "stats", "summarize", "wait_idle",
+    "sampler_active", "stats", "step_label", "summarize", "wait_idle",
 ]
 
 #: Annotation label the serving pump sampler plants around a profiled
 #: pump iteration (the shared decode step + that iteration's
-#: admissions). The parser attributes execution under it to the
-#: ``device.step.*`` gauges.
+#: admissions) — the whole-iteration ``device.step.*`` gauges. INSIDE
+#: a profiled iteration the scheduler additionally brackets the shared
+#: decode step alone with the per-path variant (:func:`step_label` —
+#: ``device.step.mega`` / ``device.step.plain``), so the decode paths
+#: attribute separately and WITHOUT admission/prefill contamination —
+#: the gauges ``Engine(decode_path="auto")`` arbitrates on.
 STEP_LABEL = "device.step"
 
 #: Label prefix every op-attribution annotation shares. The resilience
@@ -102,6 +110,32 @@ def op_label(op: str, branch: str = "fused") -> str:
     the third segment so a Perfetto reader can tell a fallback's
     window from a fused one."""
     return f"{LABEL_PREFIX}{op}.{branch}"
+
+
+def step_label(kind: str | None = None) -> str:
+    """The step annotation label: bare :data:`STEP_LABEL` for the
+    whole-iteration window, or the per-path variant
+    (``device.step.mega`` / ``device.step.plain``) the scheduler
+    brackets the SHARED DECODE STEP alone with — decode-step device
+    time only, no admission/prefill contamination. The per-path
+    segment is load-bearing: the parser keeps it (:func:`_label_op`),
+    so the two decode paths attribute into separate
+    ``device.step.<kind>.*`` gauges and the auto decode-path policy
+    never reads a blend (annotation-coverage pass,
+    docs/analysis.md)."""
+    return f"{STEP_LABEL}.{kind}" if kind else STEP_LABEL
+
+
+def _label_op(tail: str) -> str:
+    """Attribution key for one ``device.*`` label tail. Router labels
+    are ``device.<op>.<branch>`` → the key is ``<op>`` (branches
+    blend into one op window); STEP labels keep their decode-path
+    segment (``step.mega`` vs ``step.plain`` must NOT blend — the
+    auto decode-path policy arbitrates on exactly these gauges)."""
+    parts = tail.split(".")
+    if parts[0] == "step" and len(parts) > 1 and parts[1]:
+        return parts[0] + "." + parts[1]
+    return parts[0]
 
 
 def devprof_dir() -> str:
@@ -415,7 +449,7 @@ def summarize(events: list[dict]) -> dict:
         name, ts, dur = e["name"], e["ts_us"], e["dur_us"]
         t_lo, t_hi = min(t_lo, ts), max(t_hi, ts + dur)
         if name.startswith(LABEL_PREFIX):
-            op = name[len(LABEL_PREFIX):].split(".", 1)[0]
+            op = _label_op(name[len(LABEL_PREFIX):])
             if op:
                 windows.setdefault(op, []).append((ts, ts + dur))
             continue
@@ -444,6 +478,12 @@ def summarize(events: list[dict]) -> dict:
             "overlap_pct": (round(100.0 * (1 - exposed_us / comm_us), 2)
                             if comm_us > 0 else None),
             "n_events": len(compute) + len(comm),
+            # Annotation windows in the capture: a multi-iteration
+            # breach capture unions N step windows into total_ms, so
+            # per-window consumers (the auto decode-path policy)
+            # normalize by this count instead of comparing unions of
+            # different spans.
+            "n_windows": len(wins),
         }
     all_windows = [iv for wins in windows.values() for iv in wins]
     unlabeled_us = (_union_len(exec_iv + comm_iv)
@@ -481,6 +521,7 @@ def publish(summary: dict) -> None:
         reg.gauge(f"device.{op}.total_ms").set(m["total_ms"])
         reg.gauge(f"device.{op}.compute_ms").set(m["compute_ms"])
         reg.gauge(f"device.{op}.comm_ms").set(m["comm_ms"])
+        reg.gauge(f"device.{op}.windows").set(m.get("n_windows", 1))
         if m["overlap_pct"] is not None:
             reg.gauge(f"comms.{op}.overlap_pct_measured").set(
                 m["overlap_pct"])
@@ -510,6 +551,19 @@ _PARSE_THREADS: list[threading.Thread] = []
 #: later metrics scrape would advertise a capture that can never
 #: happen.
 _CONSUMERS = weakref.WeakSet()
+
+#: ALL live samplers (any trigger config). The auto decode-path
+#: policy's exploration probe gates on this — running the other
+#: decode path "so a sampler can measure it" is pure waste in a
+#: process where no sampler can ever capture (same consumer-gating
+#: rationale as :func:`arm`).
+_SAMPLERS = weakref.WeakSet()
+
+
+def sampler_active() -> bool:
+    """Is any :class:`PumpSampler` alive in this process (i.e. could a
+    pump iteration ever be captured into ``device.step.*`` gauges)?"""
+    return any(True for _ in _SAMPLERS)
 
 
 def arm(reason: str) -> None:
@@ -647,6 +701,7 @@ class PumpSampler:
         self._iter = 0
         self._n_captures = 0
         self._cap: _ActiveCapture | None = None
+        _SAMPLERS.add(self)
         if on_breach > 0:
             _CONSUMERS.add(self)
 
@@ -711,6 +766,13 @@ class PumpSampler:
                                  if x.is_alive()]
             _PARSE_THREADS.append(t)
         t.start()
+
+    @property
+    def capturing(self) -> bool:
+        """A capture is open right now — the scheduler consults this
+        to bracket the shared decode step with the per-path
+        :func:`step_label` only while it would land in a capture."""
+        return self._cap is not None
 
     @contextlib.contextmanager
     def iteration(self):

@@ -40,6 +40,12 @@ Design:
   shared batch instead of falling back to the serialized path; a
   request that could never fit fails at ``submit()`` as ``ValueError``
   (docs/serving.md "Block-granular admission").
+- **Decode-path agnostic** (ISSUE 11). The pump drives whatever decode
+  step the session resolves — the plain jitted step or the mega
+  one-program task-graph step (``Engine(use_mega=True)`` /
+  ``decode_path="auto"``) — through the same
+  :meth:`StreamSession.decode_step` verb; greedy outputs are
+  bit-identical either way (docs/serving.md "Decode-path selection").
 - **Observability** (docs/observability.md): ``serving.queue_depth``
   and ``serving.batch_occupancy`` gauges, per-request
   ``serving.ttft_ms`` and ``serving.queue_wait_ms`` histograms,
@@ -139,10 +145,6 @@ class Scheduler:
     def __init__(self, engine, params, max_waiting: int | None = None,
                  prefill_chunk: int | None = None, slo_tracker=None,
                  devprof_sampler=None):
-        if getattr(engine, "use_mega", False):
-            raise ValueError(
-                "use_mega decodes uniform-offset batches only — the "
-                "continuous-batching scheduler needs use_mega=False")
         self.engine = engine
         self.params = params
         if max_waiting is None:
@@ -506,8 +508,28 @@ class Scheduler:
                 live = [(r, rows[r]) for r in sorted(rows)
                         if r not in prefilling]
                 if live:
+                    # Resolve the decode path for THIS step, and — only
+                    # while a device capture is open — bracket the
+                    # shared step alone with the per-path label
+                    # (devprof.step_label: device.step.mega vs .plain),
+                    # nested inside the whole-iteration device.step
+                    # window. Admission/prefill work stays OUTSIDE the
+                    # per-path window, so the device.step.<kind>.*
+                    # gauges hold pure decode-step time — what the auto
+                    # policy (Engine(decode_path="auto")) arbitrates
+                    # on; labeling the whole iteration would book
+                    # prefill compiles as decode cost.
+                    kind_fn = getattr(sess, "decode_kind", None)
+                    kind = kind_fn() if kind_fn is not None else None
+                    ann = contextlib.nullcontext()
+                    if kind and self.devprof is not None \
+                            and self.devprof.capturing:
+                        from triton_dist_tpu.tools.profiler import \
+                            annotate
+                        ann = annotate(devprof.step_label(kind))
                     try:
-                        toks = sess.decode_step()
+                        with ann:
+                            toks = sess.decode_step()
                     except Exception as e:  # noqa: BLE001
                         # The SHARED step died: every occupant degrades
                         # (the cache state is suspect) and the session

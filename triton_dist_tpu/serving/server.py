@@ -141,9 +141,11 @@ class ModelServer:
     connection's prompts share ONE decode batch, so a short request
     admitted while a long generation is mid-decode completes without
     queueing behind it (docs/serving.md "Scheduler"). ``scheduler=False``
-    restores the serialized-lock path (one generation at a time;
-    ``use_mega`` engines fall back to it automatically — the mega
-    program decodes uniform-offset batches only).
+    restores the serialized-lock path (one generation at a time).
+    Every decode path is schedulable — the mega one-program step takes
+    per-row offsets and paged tables like the plain step (ISSUE 11) —
+    so ``use_mega`` / ``decode_path`` engines stream through the
+    shared batch like any other.
     """
 
     def __init__(self, engine, params, host: str = "127.0.0.1",
@@ -165,15 +167,14 @@ class ModelServer:
                 trace.enable()
                 flight.install_signal_handlers()
         if scheduler is None:
-            # Auto: on for engines a stream session can actually
-            # serve. Test doubles without a kv and mega engines keep
-            # the serialized path. Oversubscribed paged pools are NOT
-            # an exception anymore: block-granular admission (ISSUE 6)
-            # streams them fine — the scheduler just admits fewer rows
-            # at a time. ``scheduler=False`` stays as the explicit
+            # Auto: on for engines a stream session can actually serve
+            # (test doubles without a kv keep the serialized path).
+            # Oversubscribed paged pools stream via block-granular
+            # admission (ISSUE 6), and mega engines stream via the
+            # per-row mega step (ISSUE 11) — neither is a special case
+            # anymore. ``scheduler=False`` stays as the explicit
             # serialized-path override.
-            scheduler = (getattr(engine, "kv", None) is not None
-                         and not getattr(engine, "use_mega", False))
+            scheduler = getattr(engine, "kv", None) is not None
         self.scheduler = None
         if scheduler:
             from triton_dist_tpu.serving.scheduler import Scheduler
@@ -310,8 +311,8 @@ class ModelServer:
 
     def _serve_generate_serialized(self, req, prompts, gen_len, stop,
                                    t_req0) -> dict:
-        # The pre-scheduler path (scheduler=False / mega engines): a
-        # global lock serializes whole generations. The request clock
+        # The pre-scheduler path (scheduler=False): a global lock
+        # serializes whole generations. The request clock
         # starts BEFORE the lock: under load, queue wait is the
         # dominant latency component and server.request_ms must show
         # it (client-facing latency_ms keeps its original

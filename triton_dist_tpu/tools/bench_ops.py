@@ -326,6 +326,25 @@ def check_serving_wellformed(extras: dict) -> list[str]:
             or isinstance(extras.get(k), bool)]
 
 
+def check_mega_serving_wellformed(extras: dict) -> list[str]:
+    """Failure strings when the serving_mega part ran (its tokens/s
+    key exists) without publishing a well-formed
+    ``serving_mega_vs_plain`` ratio (ISSUE 11): the mega-in-scheduler
+    number is the composition evidence ROADMAP item 1 asks for, and a
+    run that silently dropped it would let the next chip window claim
+    the two subsystems compose without a machine-readable ratio.
+    Empty when the part did not run."""
+    if "serving_mega_tokens_per_s" not in extras:
+        return []
+    v = extras.get("serving_mega_vs_plain")
+    if not isinstance(v, (int, float)) or isinstance(v, bool) \
+            or float(v) <= 0.0:
+        return [f"serving_mega_vs_plain: missing/malformed ({v!r}) — "
+                f"the serving_mega part ran but published no "
+                f"mega-vs-plain scheduler ratio"]
+    return []
+
+
 def _extras_from_file(path: str) -> dict:
     """Extras dict from any bench artifact: a bench.py checkpoint
     ({"extras": ...}), a bench.py result line ({"metric", "extras"}),
@@ -384,6 +403,7 @@ def run_regress(baseline_path: str, from_file: str | None,
         floors = {k: v for k, v in floors.items() if k in sweep_keys}
     fails = check_regression(extras, floors)
     fails += check_serving_wellformed(extras)
+    fails += check_mega_serving_wellformed(extras)
     fails += check_overlap_measured_wellformed(extras)
     fails += check_measured_overlap_floors(
         extras, load_measured_overlap_floors(baseline_path, tier))
