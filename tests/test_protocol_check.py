@@ -1,0 +1,480 @@
+"""Quick-tier CI gate for the whole-zoo protocol checkers (ISSUE 12).
+
+Mirrors the mutation contract tests/test_tdt_check.py established for
+the ring pass, now across the zoo:
+
+- every new pass (a2a / p2p / flash-decode / protocol-coverage and
+  the extended vmem comm-buffer sweep) is green on the repo for
+  worlds 1..8 — with the a2a composed over call sequences 1..4 in
+  BOTH buffering regimes — and the whole suite runs with zero Mosaic
+  compiles (asserted by poisoning ``pallas_call``);
+- each seeded mutant — dropped wait, doubled signal, swapped parity
+  across calls, off-by-one merge contributor, unclaimed-semaphore
+  kernel — produces its distinct finding code with a file:line anchor
+  and a nonzero driver exit;
+- the checkers execute the kernels' OWN schedule helpers (a bug
+  injected there, not in the mirror, must surface);
+- the ``--changed`` / comma-``--pass`` / ``--md-summary`` driver
+  satellites behave.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from triton_dist_tpu.analysis import (
+    Finding, PASSES, exit_code, filter_suppressed, run_passes,
+    select_passes_for, watch_match)
+from triton_dist_tpu.analysis import a2a_model as am
+from triton_dist_tpu.analysis import flash_model as fm
+from triton_dist_tpu.analysis import lint_protocol as lp
+from triton_dist_tpu.analysis import p2p_model as pm
+from triton_dist_tpu.analysis import protocol_model as core
+from triton_dist_tpu.analysis import vmem as avmem
+from triton_dist_tpu.tools import tdt_check
+
+NEW_PASSES = ("a2a-protocol", "p2p-protocol", "flash-decode-protocol",
+              "protocol-coverage")
+
+
+# ---------------------------------------------------------------------------
+# The repo is clean under the new passes — and no Mosaic compile ever
+# runs: the whole zoo is checked from Python.
+# ---------------------------------------------------------------------------
+
+def test_new_passes_registered_and_clean():
+    for name in NEW_PASSES:
+        assert name in PASSES, name
+    findings = run_passes(names=list(NEW_PASSES) + ["vmem-budget"])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_zero_mosaic_compiles(monkeypatch):
+    """The acceptance bar: the full pass suite never builds a kernel.
+    Poison ``pallas_call`` — any compile attempt fails loudly."""
+    from jax.experimental import pallas as pl
+
+    def boom(*a, **k):   # pragma: no cover - must never run
+        raise AssertionError("a static pass invoked pallas_call")
+
+    monkeypatch.setattr(pl, "pallas_call", boom)
+    assert run_passes() == []
+
+
+@pytest.mark.parametrize("world", range(1, 9))
+def test_a2a_every_counts_pattern_verifies(world):
+    for pat, counts in am.counts_patterns(world).items():
+        t = am.a2a_trace(world, counts, name=f"a2a[w{world} {pat}]")
+        assert core.check_trace(t) == [], t.name
+    t = am.a2a_trace(world, am.counts_patterns(world)["ragged"],
+                     fp8_sideband=True)
+    assert core.check_trace(t) == [], t.name
+
+
+@pytest.mark.parametrize("world", [1, 2, 4, 5, 8])
+@pytest.mark.parametrize("n_calls", [1, 2, 3, 4])
+@pytest.mark.parametrize("buffering", ["fresh", "parity"])
+def test_a2a_call_sequences_compose(world, n_calls, buffering):
+    """Cross-call composition 1..4 verifies under BOTH regimes: the
+    reference's call_count-parity re-expression AND the documented
+    TPU collapse (fresh per-pallas_call semaphores,
+    all_to_all.py:25-28)."""
+    t = am.a2a_call_sequence(world, n_calls, buffering=buffering)
+    assert core.check_trace(t) == [], t.name
+    assert am.check_call_parity(t, buffering) == [], t.name
+
+
+@pytest.mark.parametrize("world", range(1, 9))
+def test_p2p_pipelines_verify(world):
+    for deltas in pm.PIPELINES:
+        t = pm.pipeline_trace(world, deltas)
+        assert core.check_trace(t) == [], t.name
+
+
+@pytest.mark.parametrize("world", range(1, 9))
+def test_flash_combine_verifies(world):
+    t = fm.combine_trace(world)
+    assert core.check_trace(t) == [], t.name
+
+
+# ---------------------------------------------------------------------------
+# ...and each known-bad mutant is caught with the right class.
+# ---------------------------------------------------------------------------
+
+def _codes(trace):
+    return {v.code for v in core.check_trace(trace)}
+
+
+@pytest.mark.parametrize("world", [3, 4, 8])
+def test_a2a_mutant_dropped_wait(world):
+    t = am.a2a_trace(world, am.counts_patterns(world)["ragged"])
+    codes = _codes(core.drop_first_wait(t, sem_kind="a2a"))
+    assert "a2a.race" in codes, codes
+    assert "a2a.signal_wait_imbalance" in codes
+
+
+@pytest.mark.parametrize("world", [2, 5])
+def test_a2a_mutant_doubled_signal(world):
+    t = am.a2a_trace(world, am.counts_patterns(world)["full"])
+    codes = _codes(core.double_signal(t, sem_kind="a2a"))
+    assert codes == {"a2a.signal_wait_imbalance"}, codes
+
+
+@pytest.mark.parametrize("world,call", [(4, 1), (8, 3)])
+def test_a2a_mutant_swapped_parity_across_calls(world, call):
+    """The double-buffer bug class: one call signals the OTHER
+    buffer's slots. Distinct code, fires structurally even before the
+    counting verdicts."""
+    seq = am.a2a_call_sequence(world, 4, buffering="parity")
+    mut = am.swap_call_parity(seq, call=call)
+    parity = {v.code for v in am.check_call_parity(mut)}
+    assert parity == {"a2a.call_parity"}, parity
+    # the counting verdicts ALSO notice (receivers hang on the slot
+    # that was never signalled)
+    assert "a2a.deadlock" in _codes(mut)
+    # ...and the unmutated sequence carries no parity violation
+    assert am.check_call_parity(seq) == []
+
+
+def test_a2a_mutant_fp8_sideband_dropped_wait():
+    t = am.a2a_trace(4, am.counts_patterns(4)["ragged"],
+                     fp8_sideband=True)
+    codes = _codes(core.drop_first_wait(t, sem_kind="scale"))
+    assert "a2a.race" in codes and "a2a.signal_wait_imbalance" in codes
+
+
+def test_a2a_runs_real_schedule_code(monkeypatch):
+    """The checker executes a2a_wait_src itself: a bug injected THERE
+    (not in the mirror) must surface."""
+    from triton_dist_tpu.ops import all_to_all as a2a_ops
+    orig = a2a_ops.a2a_wait_src
+
+    def broken(me, i, world):
+        return orig(me, i + 1 if world > 2 else i, world)
+
+    monkeypatch.setattr(a2a_ops, "a2a_wait_src", broken)
+    for cache in (am._wait_order, am._send_order, am._live):
+        cache.cache_clear()
+    try:
+        t = am.a2a_trace(4, am.counts_patterns(4)["full"])
+        assert core.check_trace(t) != []
+    finally:
+        for cache in (am._wait_order, am._send_order, am._live):
+            cache.cache_clear()
+
+
+@pytest.mark.parametrize("world", [3, 5, 8])
+def test_p2p_mutant_swapped_delta(world):
+    t = pm.pipeline_trace(world, (1, -1))
+    codes = _codes(pm.swap_delta(t, rank=0, stage=0))
+    assert "p2p.signal_wait_imbalance" in codes, codes
+    assert "p2p.deadlock" in codes
+
+
+def test_p2p_mutant_dropped_wait():
+    t = pm.pipeline_trace(4, (1,))
+    codes = _codes(core.drop_first_wait(t, sem_kind="p2p"))
+    assert "p2p.race" in codes and "p2p.signal_wait_imbalance" in codes
+
+
+def test_p2p_runs_real_partner_code(monkeypatch):
+    from triton_dist_tpu.ops import p2p as p2p_ops
+    orig = p2p_ops.shift_partners
+
+    def broken(me, delta, world):
+        dst, src = orig(me, delta, world)
+        return dst, dst   # wrong source partner
+
+    monkeypatch.setattr(p2p_ops, "shift_partners", broken)
+    pm._partners.cache_clear()
+    try:
+        t = pm.pipeline_trace(4, (1,))
+        assert core.check_trace(t) != []
+    finally:
+        pm._partners.cache_clear()
+
+
+@pytest.mark.parametrize("world", [3, 4, 8])
+def test_flash_mutant_off_by_one_merge(world):
+    """The silent-skew class: one contributor merged twice, another
+    never — coverage exactly, no hang, no imbalance."""
+    codes = _codes(fm.shift_merge_contributor(fm.combine_trace(world)))
+    assert codes == {"flash.coverage"}, codes
+
+
+def test_flash_mutant_dropped_wait_and_doubled_signal():
+    t = fm.combine_trace(4)
+    codes = _codes(core.drop_first_wait(t, sem_kind="fd"))
+    assert "flash.race" in codes
+    assert "flash.signal_wait_imbalance" in codes
+    codes = _codes(core.double_signal(t, sem_kind="fd"))
+    assert codes == {"flash.signal_wait_imbalance"}, codes
+
+
+def test_mutants_exit_nonzero_with_anchor():
+    """Acceptance shape: every zoo mutant → nonzero exit + file:line
+    anchored at the kernel the trace mirrors."""
+    cases = [
+        (core.drop_first_wait(
+            am.a2a_trace(4, am.counts_patterns(4)["full"]),
+            sem_kind="a2a"), "all_to_all.py"),
+        (am.swap_call_parity(
+            am.a2a_call_sequence(4, 2, buffering="parity"), call=1),
+         "all_to_all.py"),
+        (pm.swap_delta(pm.pipeline_trace(4, (1,))), "p2p.py"),
+        (fm.shift_merge_contributor(fm.combine_trace(4)),
+         "flash_decode.py"),
+    ]
+    for trace, src in cases:
+        viols = core.check_trace(trace)
+        if trace.code_prefix == "a2a":
+            viols = viols + am.check_call_parity(trace)
+        findings = [Finding(code=v.code, message=v.detail,
+                            file=trace.anchor[0], line=trace.anchor[1])
+                    for v in viols]
+        assert exit_code(findings) != 0, trace.name
+        assert findings[0].file and findings[0].file.endswith(src)
+        assert findings[0].line and findings[0].line > 0
+
+
+def test_moe_rs_footprint_helpers_agree_with_entry():
+    """The static vet prices the kernel's REAL tiling: the resolve
+    helper reproduces the entry's clamp (budget-shrunk h_blk, floor
+    128) and the footprint at the resolved block fits the budget
+    whenever a >=128 block can."""
+    from triton_dist_tpu.ops.moe_reduce_rs import (
+        moe_rs_fused_footprint, moe_rs_resolve_h_blk)
+    h_blk = moe_rs_resolve_h_blk(4096, 512, 128, 4096, 2048, 2,
+                                 12 * 2**20)
+    assert h_blk == 256     # 512 over budget; 256 lands exactly on it
+    assert moe_rs_fused_footprint(128, 4096, h_blk, 2048, 2) \
+        <= 12 * 2**20
+    assert moe_rs_fused_footprint(128, 4096, 512, 2048, 2) \
+        > 12 * 2**20
+    # divisibility clamp: block_h that doesn't divide h halves first
+    assert moe_rs_resolve_h_blk(384, 512, 128, 64, 64, 2,
+                                12 * 2**20) == 128
+
+
+def test_comm_buffer_sweep_clean_and_over_budget_mutant():
+    assert avmem.sweep_comm_buffers() == []
+    # an oversized slab config is refused statically, anchored at the
+    # op's own config site (AllToAllContext), no compile
+    f = avmem.vet_candidate("all_to_all",
+                            {"capacity": 512, "h": 7168},
+                            rows=0, itemsize=2, world=8)
+    assert f is not None and f.code == "vmem.over_budget"
+    assert f.file.endswith("all_to_all.py") and f.line > 0
+    assert exit_code([f]) != 0
+    # and an over-cap MoE-RS scratch (huge selection tiles)
+    f = avmem.vet_candidate(
+        "moe_reduce_rs",
+        {"h": 4096, "i_loc": 4096, "block_m": 1024, "block_h": 512,
+         "vmem_budget": 12 * 2**20},
+        rows=8192, itemsize=2, world=1)
+    assert f is not None and f.code == "vmem.over_budget"
+    assert f.file.endswith("moe_reduce_rs.py") and f.line > 0
+
+
+# ---------------------------------------------------------------------------
+# protocol-coverage meta-lint
+# ---------------------------------------------------------------------------
+
+def _ops_dir(tmp_path, body):
+    d = tmp_path / "ops"
+    d.mkdir()
+    (d / "__init__.py").write_text("")
+    (d / "new_comm.py").write_text(textwrap.dedent(body))
+    return d
+
+
+SEM_KERNEL = """
+    from jax.experimental.pallas import tpu as pltpu
+    import triton_dist_tpu.language as dl
+
+    def _kernel(x_ref, o_ref, send_sem, recv_sem):
+        dl.remote_copy(x_ref, o_ref, 1, send_sem, recv_sem).start()
+
+    SCRATCH = [pltpu.SemaphoreType.DMA((2,))]
+"""
+
+
+def test_unclaimed_semaphore_kernel_fires(tmp_path):
+    d = _ops_dir(tmp_path, SEM_KERNEL)
+    findings = lp.collect_findings(ops_dir=d, claims={}, backlog={},
+                                   passes=PASSES)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.code == "protocol.unclaimed_semaphore"
+    assert f.file.endswith("new_comm.py") and f.line > 0
+    assert "remote_copy" in f.message
+    assert exit_code(findings) != 0
+
+
+def test_claiming_a_registered_pass_clears(tmp_path):
+    d = _ops_dir(tmp_path, SEM_KERNEL)
+    assert lp.collect_findings(
+        ops_dir=d, claims={"new_comm.py": "a2a-protocol"}, backlog={},
+        passes=PASSES) == []
+    # a backlog entry also silences — explicit, rationale'd debt
+    assert lp.collect_findings(
+        ops_dir=d, claims={}, backlog={"new_comm.py": "pending"},
+        passes=PASSES) == []
+
+
+def test_claim_naming_unregistered_pass_fires(tmp_path):
+    d = _ops_dir(tmp_path, SEM_KERNEL)
+    findings = lp.collect_findings(
+        ops_dir=d, claims={"new_comm.py": "no-such-pass"}, backlog={},
+        passes=PASSES)
+    assert [f.code for f in findings] == ["protocol.unknown_pass"]
+
+
+def test_stale_claim_fires_both_shapes(tmp_path):
+    d = tmp_path / "ops"
+    d.mkdir()
+    (d / "__init__.py").write_text("")
+    (d / "pure_math.py").write_text("def f(x):\n    return x + 1\n")
+    findings = lp.collect_findings(
+        ops_dir=d,
+        claims={"pure_math.py": "a2a-protocol",
+                "deleted_module.py": "a2a-protocol"},
+        backlog={}, passes=PASSES)
+    assert sorted(f.code for f in findings) == \
+        ["protocol.stale_claim", "protocol.stale_claim"]
+
+
+def test_docstring_mentions_do_not_count(tmp_path):
+    d = _ops_dir(tmp_path, '''
+    """This module merely DOCUMENTS pltpu.semaphore_signal and
+    make_async_remote_copy usage elsewhere."""
+    def f():
+        return 0
+    ''')
+    assert lp.collect_findings(ops_dir=d, claims={}, backlog={},
+                               passes=PASSES) == []
+
+
+def test_unclaimed_finding_pragma_suppression(tmp_path):
+    body = SEM_KERNEL.replace(
+        "from jax.experimental.pallas import tpu as pltpu",
+        "from jax.experimental.pallas import tpu as pltpu"
+        "  # tdt: ignore[protocol.unclaimed_semaphore]")
+    d = _ops_dir(tmp_path, body)
+    findings = lp.collect_findings(ops_dir=d, claims={}, backlog={},
+                                   passes=PASSES)
+    # the finding anchors at the first primitive usage line, which is
+    # the remote_copy call — a pragma elsewhere must NOT suppress
+    assert len(filter_suppressed(findings)) == 1
+    src = (d / "new_comm.py").read_text().splitlines()
+    anchored = findings[0].line
+    patched = "\n".join(
+        line + "  # tdt: ignore[protocol.unclaimed_semaphore]"
+        if i + 1 == anchored else line
+        for i, line in enumerate(src))
+    (d / "new_comm.py").write_text(patched + "\n")
+    findings = lp.collect_findings(ops_dir=d, claims={}, backlog={},
+                                   passes=PASSES)
+    assert filter_suppressed(findings) == []
+
+
+def test_repo_claims_are_wellformed():
+    """Every CLAIMS entry names a registered pass; claim and backlog
+    sets are disjoint; the three new kernels are claimed by the three
+    new passes."""
+    assert set(lp.CLAIMS) & set(lp.BACKLOG) == set()
+    for mod, pass_name in lp.CLAIMS.items():
+        assert pass_name in PASSES, (mod, pass_name)
+    assert lp.CLAIMS["all_to_all.py"] == "a2a-protocol"
+    assert lp.CLAIMS["p2p.py"] == "p2p-protocol"
+    assert lp.CLAIMS["flash_decode.py"] == "flash-decode-protocol"
+
+
+# ---------------------------------------------------------------------------
+# Driver satellites: --pass comma lists, --changed, --md-summary
+# ---------------------------------------------------------------------------
+
+def test_driver_pass_comma_list(capsys):
+    rc = tdt_check.main(
+        ["--pass", "p2p-protocol,flash-decode-protocol",
+         "--pass", "protocol-coverage", "--json"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert json.loads(out[out.index("{"):])["errors"] == 0
+
+
+def test_watch_match_shapes():
+    assert watch_match("triton_dist_tpu/ops/p2p.py",
+                       "triton_dist_tpu/ops/p2p.py")
+    assert watch_match("triton_dist_tpu/ops/new_kernel.py",
+                       "triton_dist_tpu/ops/")
+    assert not watch_match("docs/perf.md", "triton_dist_tpu/ops/")
+    assert watch_match("docs/perf.md", "docs/*.md")
+
+
+def test_select_passes_for_changed_files():
+    names = select_passes_for(["triton_dist_tpu/ops/p2p.py"])
+    assert "p2p-protocol" in names
+    assert "protocol-coverage" in names      # watches all of ops/
+    assert "a2a-protocol" not in names
+    assert "ring-protocol" not in names
+    # the shared core re-triggers every protocol pass
+    names = select_passes_for(
+        ["triton_dist_tpu/analysis/protocol_model.py"])
+    for n in ("ring-protocol", "a2a-protocol", "p2p-protocol",
+              "flash-decode-protocol"):
+        assert n in names
+    assert select_passes_for([]) == []
+    assert select_passes_for(["README.md"]) == []
+
+
+def test_driver_changed_scopes_to_diff(monkeypatch, capsys):
+    monkeypatch.setattr(tdt_check, "changed_files",
+                        lambda root=None: ["triton_dist_tpu/ops/p2p.py"])
+    rc = tdt_check.main(["--changed"])
+    assert rc == 0
+    cap = capsys.readouterr()
+    # status prose goes to STDERR so `--changed --json` output stays
+    # machine-parseable
+    assert "p2p-protocol" not in cap.err.split("skipped:")[-1]
+    assert "ring-protocol" in cap.err.split("skipped:")[-1]
+    assert "skipped" not in cap.out
+    # nothing changed -> nothing to run, still exit 0 AND the output
+    # contract holds (valid JSON, summary still written)
+    monkeypatch.setattr(tdt_check, "changed_files",
+                        lambda root=None: [])
+    rc = tdt_check.main(["--changed", "--json"])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert "no watched files changed" in cap.err
+    assert json.loads(cap.out)["errors"] == 0
+
+
+def test_driver_changed_excludes_explicit_pass(capsys):
+    with pytest.raises(SystemExit):
+        tdt_check.main(["--changed", "--pass", "ring-protocol"])
+    capsys.readouterr()
+
+
+def test_driver_md_summary(tmp_path, capsys):
+    path = tmp_path / "summary.md"
+    rc = tdt_check.main(["--pass", "protocol-coverage",
+                         "--md-summary", str(path)])
+    capsys.readouterr()
+    assert rc == 0
+    text = path.read_text()
+    assert "## tdt-check" in text and "OK" in text
+    # a red run renders the finding-code table
+    f = Finding(code="a2a.call_parity", message="boom | pipe",
+                file="x.py", line=3)
+    md = tdt_check.render_md([f], n_passes=1)
+    assert "| `a2a.call_parity` | error | `x.py:3` |" in md
+    assert "\\|" in md
+
+
+def test_fallback_shim_deprecation_warning():
+    from triton_dist_tpu.tools import fallback_lint
+    with pytest.warns(DeprecationWarning,
+                      match="fallback-coverage"):
+        assert fallback_lint.missing_fallbacks() == []

@@ -6,17 +6,32 @@ registered under a stable name; ``run_passes`` runs a selection,
 applies inline ``# tdt: ignore[...]`` suppression pragmas, and hands
 the surviving findings to the ``tools/tdt_check.py`` driver (JSON or
 human output, nonzero exit on errors). The quick tier runs every pass
-over the repo (tests/test_tdt_check.py) and ``tpu_smoke.py`` runs
-them as a preflight, so a protocol or contract regression fails CI —
-not a smoke queue, and not a chip.
+over the repo (tests/test_tdt_check.py, tests/test_protocol_check.py)
+and ``tpu_smoke.py`` runs them as a preflight, so a protocol or
+contract regression fails CI — not a smoke queue, and not a chip.
 
 Built-in passes:
 
 - ``ring-protocol`` — model-checks the fused GEMM family's ring
   signal/wait protocols for worlds 1..8 x both ring directions
-  (:mod:`.ring_model`);
+  (:mod:`.ring_model`, on the shared :mod:`.protocol_model` core);
+- ``a2a-protocol`` — the EP all-to-all's slab/chunk push: per-(slab,
+  chunk) semaphore accounting over ragged/zero/one-hot counts, the
+  fp8 scale sideband, and cross-call composition proving the
+  double-buffer call-parity invariant for call sequences 1..4 —
+  including the documented TPU collapse case (:mod:`.a2a_model`);
+- ``p2p-protocol`` — the PP ``_shift_kernel`` hop protocol, composed
+  over mixed ±delta pipelines (:mod:`.p2p_model`);
+- ``flash-decode-protocol`` — the distributed flash-decode softmax-
+  state combine: each rank's (acc, l, m) partial merges exactly once
+  (:mod:`.flash_model`);
+- ``protocol-coverage`` — meta-lint: every semaphore/DMA-using module
+  under ``ops/`` is claimed by a registered verifier pass, so the
+  next comm kernel cannot land unverified (:mod:`.lint_protocol`);
 - ``vmem-budget`` — every autotune candidate the config tables can
-  emit fits the declared-footprint cap, statically (:mod:`.vmem`);
+  emit fits the declared-footprint cap, statically — now including
+  the all-to-all send/recv slabs and the fused MoE-RS scratch at
+  bench shapes for worlds 1..8 (:mod:`.vmem`);
 - ``metric-catalog`` — emitted metrics and docs/observability.md
   agree, both directions (:mod:`.lint_metrics`);
 - ``env-knobs`` — every ``TDT_*`` knob documented; integer knobs
@@ -31,11 +46,17 @@ Built-in passes:
   its ``device.step`` window, so ``obs.devprof``'s measured
   attribution never silently reads empty windows
   (:mod:`.lint_annotations`).
+
+Each pass declares the repo files it watches (``Pass.watches``,
+repo-relative globs; a trailing ``/`` matches the subtree) so the
+driver's ``--changed`` mode can run only the passes whose inputs a
+diff touched — the fast pre-commit loop.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import fnmatch
 from pathlib import Path
 
 from triton_dist_tpu.analysis.findings import (  # noqa: F401
@@ -43,8 +64,9 @@ from triton_dist_tpu.analysis.findings import (  # noqa: F401
     render_json)
 
 __all__ = ["Finding", "Pass", "PASSES", "register_pass", "repo_root",
-           "run_passes", "exit_code", "filter_suppressed",
-           "render_human", "render_json"]
+           "run_passes", "select_passes_for", "watch_match",
+           "exit_code", "filter_suppressed", "render_human",
+           "render_json"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,22 +74,50 @@ class Pass:
     name: str
     description: str
     fn: object     # (root: Path) -> list[Finding]
+    watches: tuple = ()   # repo-relative globs; () = always run
 
 
 PASSES: dict = {}
 
 
-def register_pass(name: str, description: str):
+def register_pass(name: str, description: str, watches: tuple = ()):
     """Decorator adding a pass to the registry (docs/analysis.md
     "Adding a pass"). Pass functions take the repo root and return
     findings; they must be side-effect-free and fast enough for the
-    quick tier."""
+    quick tier. ``watches`` lists the repo-relative paths/globs whose
+    change makes the pass worth re-running (``--changed``); an empty
+    tuple means the pass always runs."""
     def deco(fn):
         if name in PASSES:
             raise ValueError(f"pass {name!r} already registered")
-        PASSES[name] = Pass(name=name, description=description, fn=fn)
+        PASSES[name] = Pass(name=name, description=description, fn=fn,
+                            watches=tuple(watches))
         return fn
     return deco
+
+
+def watch_match(path: str, pattern: str) -> bool:
+    """One changed path against one watch pattern: a trailing ``/``
+    is a subtree prefix, anything else is an fnmatch glob on the
+    repo-relative posix path."""
+    path = path.replace("\\", "/")
+    if pattern.endswith("/"):
+        return path.startswith(pattern)
+    return fnmatch.fnmatch(path, pattern)
+
+
+def select_passes_for(changed_files) -> list:
+    """Pass names worth running for a set of changed repo-relative
+    paths: every pass with no declared watches, plus every pass one
+    of whose watch patterns matches a changed file. Deterministic
+    registry order."""
+    changed = list(changed_files)
+    names = []
+    for name, p in PASSES.items():
+        if not p.watches or any(watch_match(f, pat)
+                                for f in changed for pat in p.watches):
+            names.append(name)
+    return names
 
 
 def repo_root() -> Path:
@@ -102,25 +152,87 @@ def run_passes(root=None, names=None, apply_suppression=True) -> list:
 # Heavy imports (jax via ops/) stay inside the pass bodies so importing
 # the framework itself is cheap.
 
+_CORE = ("triton_dist_tpu/analysis/protocol_model.py",
+         "triton_dist_tpu/analysis/findings.py")
+
+
 @register_pass("ring-protocol",
                "model-check the fused-family ring schedules, worlds "
-               "1..8 x both ring_dirs")
+               "1..8 x both ring_dirs",
+               watches=_CORE + (
+                   "triton_dist_tpu/analysis/ring_model.py",
+                   "triton_dist_tpu/ops/common.py",
+                   "triton_dist_tpu/ops/allgather_gemm.py",
+                   "triton_dist_tpu/ops/gemm_reduce_scatter.py"))
 def _ring_pass(root):
     from triton_dist_tpu.analysis import ring_model
     return ring_model.verify_family()
 
 
+@register_pass("a2a-protocol",
+               "model-check the EP all-to-all slab/chunk protocol + "
+               "cross-call double-buffer parity, worlds 1..8 x call "
+               "sequences 1..4",
+               watches=_CORE + (
+                   "triton_dist_tpu/analysis/a2a_model.py",
+                   "triton_dist_tpu/ops/all_to_all.py"))
+def _a2a_pass(root):
+    from triton_dist_tpu.analysis import a2a_model
+    return a2a_model.verify_a2a()
+
+
+@register_pass("p2p-protocol",
+               "model-check the PP shift-hop protocol over mixed "
+               "±delta pipelines, worlds 1..8",
+               watches=_CORE + (
+                   "triton_dist_tpu/analysis/p2p_model.py",
+                   "triton_dist_tpu/ops/p2p.py"))
+def _p2p_pass(root):
+    from triton_dist_tpu.analysis import p2p_model
+    return p2p_model.verify_p2p()
+
+
+@register_pass("flash-decode-protocol",
+               "model-check the distributed flash-decode softmax-"
+               "state combine (exactly-once merge), worlds 1..8",
+               watches=_CORE + (
+                   "triton_dist_tpu/analysis/flash_model.py",
+                   "triton_dist_tpu/ops/flash_decode.py"))
+def _flash_pass(root):
+    from triton_dist_tpu.analysis import flash_model
+    return flash_model.verify_flash_decode()
+
+
+@register_pass("protocol-coverage",
+               "every semaphore/DMA-using ops/ module is claimed by "
+               "a registered verifier pass",
+               watches=("triton_dist_tpu/ops/",
+                        "triton_dist_tpu/analysis/lint_protocol.py",
+                        "triton_dist_tpu/analysis/__init__.py"))
+def _protocol_coverage_pass(root):
+    from triton_dist_tpu.analysis import lint_protocol
+    return lint_protocol.run(root)
+
+
 @register_pass("vmem-budget",
-               "every autotune candidate fits HARD_FOOTPRINT_CAP "
-               "statically (no compile)")
+               "every autotune candidate + comm-buffer footprint "
+               "fits HARD_FOOTPRINT_CAP statically (no compile)",
+               watches=("triton_dist_tpu/analysis/vmem.py",
+                        "triton_dist_tpu/tools/perf_model.py",
+                        "triton_dist_tpu/ops/common.py",
+                        "triton_dist_tpu/ops/allgather_gemm.py",
+                        "triton_dist_tpu/ops/gemm_reduce_scatter.py",
+                        "triton_dist_tpu/ops/all_to_all.py",
+                        "triton_dist_tpu/ops/moe_reduce_rs.py"))
 def _vmem_pass(root):
     from triton_dist_tpu.analysis import vmem
-    return vmem.sweep_candidate_tables()
+    return vmem.sweep_candidate_tables() + vmem.sweep_comm_buffers()
 
 
 @register_pass("metric-catalog",
                "emitted metrics and the docs/observability.md catalog "
-               "agree, both directions")
+               "agree, both directions",
+               watches=("triton_dist_tpu/", "docs/observability.md"))
 def _metrics_pass(root):
     from triton_dist_tpu.analysis import lint_metrics
     return lint_metrics.run(root)
@@ -128,14 +240,16 @@ def _metrics_pass(root):
 
 @register_pass("env-knobs",
                "every TDT_* knob documented; integer knobs via "
-               "obs.registry.env_int")
+               "obs.registry.env_int",
+               watches=("triton_dist_tpu/", "docs/"))
 def _env_pass(root):
     from triton_dist_tpu.analysis import lint_env
     return lint_env.run(root)
 
 
 @register_pass("trace-balance",
-               "host-side trace.begin/end emitters are balanced")
+               "host-side trace.begin/end emitters are balanced",
+               watches=("triton_dist_tpu/",))
 def _trace_pass(root):
     from triton_dist_tpu.analysis import lint_trace
     return lint_trace.run(root)
@@ -143,7 +257,10 @@ def _trace_pass(root):
 
 @register_pass("fallback-coverage",
                "every public op entry has a registered XLA escape "
-               "hatch")
+               "hatch",
+               watches=("triton_dist_tpu/ops/",
+                        "triton_dist_tpu/resilience/",
+                        "triton_dist_tpu/analysis/lint_fallback.py"))
 def _fallback_pass(root):
     from triton_dist_tpu.analysis import lint_fallback
     return lint_fallback.collect_findings()
@@ -151,7 +268,11 @@ def _fallback_pass(root):
 
 @register_pass("annotation-coverage",
                "every @resilient invocation runs under a device.<op>.* "
-               "profiler label; the pump sampler keeps device.step")
+               "profiler label; the pump sampler keeps device.step",
+               watches=("triton_dist_tpu/resilience/router.py",
+                        "triton_dist_tpu/obs/devprof.py",
+                        "triton_dist_tpu/serving/scheduler.py",
+                        "triton_dist_tpu/analysis/lint_annotations.py"))
 def _annotation_pass(root):
     from triton_dist_tpu.analysis import lint_annotations
     return lint_annotations.run(root)

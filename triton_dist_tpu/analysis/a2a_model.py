@@ -1,0 +1,283 @@
+"""Static model checker for the EP all-to-all's slab/chunk protocol.
+
+``ops/all_to_all.py``'s ``_a2a_kernel`` is the repo's port of the
+reference's headline low-latency AllToAll (137µs vs DeepEP's 182µs,
+SURVEY §6) — the kernel SURVEY's "hard parts" calls the riskiest port
+because the reference's safety argument is a **call-count-parity
+double-buffer protocol** (low_latency_all_to_all.py:140-143: symmetric
+buffers persist across calls, so call ``k`` and call ``k+1`` must land
+in different buffer/signal slots). The TPU re-expression collapses
+that protocol (all_to_all.py:25-28: each ``pallas_call`` owns its
+buffers and its DMA semaphores start and finish at zero) — a design
+decision this checker turns from a docstring claim into a proof
+obligation.
+
+Single-call model (:func:`a2a_trace`): per rank, the slab/chunk push is
+mirrored into protocol events by executing the kernel's OWN schedule
+helpers (``a2a_send_peer`` / ``a2a_wait_src`` / ``a2a_live_chunks``)
+with concrete ranks — per-(slab, chunk) semaphore slots, live-chunk
+gating from a counts matrix, the full-mesh entry barrier, and the
+send-side drain. Verified per counts pattern (full, ragged,
+all-zero, one-hot) for worlds 1..8: every live chunk lands exactly
+once with a prior wait (``a2a.race`` / ``a2a.coverage``), every
+semaphore balances on both sides (``a2a.signal_wait_imbalance``), and
+the greedy maximal execution completes (``a2a.deadlock``). The
+fp8 path's scale side channel — the analog of the reference's
+separate ``putmem_signal`` scale channel — carries its own signal
+accounting (``fp8_sideband=True``).
+
+Cross-call composition (:func:`a2a_call_sequence`): consecutive calls
+concatenate per rank (``protocol_model.concat_traces``) with semaphore
+slots assigned by the buffering regime —
+
+- ``"fresh"`` — slot = call index: the TPU collapse case. Fresh
+  per-``pallas_call`` semaphores mean no slot is ever reused, so
+  proving each call's protocol plus slot disjointness proves the
+  sequence.
+- ``"parity"`` — slot = call % 2: the reference's ``call_count``
+  parity re-expression. Slot reuse at distance 2 is legal only
+  because every call fully drains (send-side waits) before its rank
+  proceeds, which the composed balance/deadlock verdicts check.
+
+Both regimes are verified for sequences of length 1..4, and a
+structural invariant — every event's slot equals its call's expected
+slot — is checked independently of execution
+(:func:`check_call_parity`, code ``a2a.call_parity``): the
+swapped-parity mutant (a call signalling the other buffer's slots,
+the classic double-buffer bug) is caught even where the counting
+verdicts alone would merely deadlock.
+
+Model scope: slot assignment, signal/wait accounting and ordering.
+Cross-call write-after-read hazards on *persistent* symmetric buffers
+are exactly what the TPU design removes (fresh buffers per call);
+for the parity regime the model checks slot discipline, not HBM
+buffer lifetimes — docs/analysis.md "What the protocol models check —
+and what they can't".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+from triton_dist_tpu.analysis.protocol_model import (
+    Ev, Trace, Violation, anchor_of, barrier_evs, check_trace,
+    concat_traces, copy_trace, violations_to_findings)
+
+__all__ = [
+    "a2a_trace", "a2a_call_sequence", "check_call_parity",
+    "counts_patterns", "verify_a2a", "swap_call_parity",
+]
+
+#: Headline-ish model shape: the reference's LL config is 128
+#: tokens/rank; chunk 32 exercises multi-chunk slabs (the fp8 wire's
+#: 1-byte alignment class) without blowing up trace sizes.
+CAPACITY = 128
+CHUNK = 32
+
+
+@functools.lru_cache(maxsize=None)
+def _live(count: int, chunk: int) -> int:
+    """Live chunks for one slab — executes the kernel's own
+    ``a2a_live_chunks`` with concrete values."""
+    from triton_dist_tpu.ops.all_to_all import a2a_live_chunks
+    return int(a2a_live_chunks(count, chunk))
+
+
+@functools.lru_cache(maxsize=None)
+def _send_order(me: int, world: int) -> tuple:
+    from triton_dist_tpu.ops.all_to_all import a2a_send_peer
+    return tuple(int(a2a_send_peer(me, i, world))
+                 for i in range(1, world))
+
+
+@functools.lru_cache(maxsize=None)
+def _wait_order(me: int, world: int) -> tuple:
+    from triton_dist_tpu.ops.all_to_all import a2a_wait_src
+    return tuple(int(a2a_wait_src(me, i, world))
+                 for i in range(1, world))
+
+
+def counts_patterns(world: int, capacity: int = CAPACITY,
+                    chunk: int = CHUNK) -> dict:
+    """Representative send-count matrices (counts[src][dst] = live
+    rows src→dst): full slabs, ragged counts spanning 0..capacity
+    with chunk-unaligned values, all-zero (a2a of an empty batch), and
+    one-hot routing (every token to one expert rank)."""
+    full = [[capacity] * world for _ in range(world)]
+    ragged = [[((3 * s + 5 * d + 1) * (chunk + 3)) % (capacity + 1)
+               for d in range(world)] for s in range(world)]
+    zero = [[0] * world for _ in range(world)]
+    onehot = [[capacity if d == (s + 1) % world else 0
+               for d in range(world)] for s in range(world)]
+    return {"full": full, "ragged": ragged, "zero": zero,
+            "onehot": onehot}
+
+
+def a2a_trace(world: int, counts, chunk: int = CHUNK, call: int = None,
+              slot: int = 0, fp8_sideband: bool = False,
+              name: str = None) -> Trace:
+    """Event trace of one ``_a2a_kernel`` dispatch.
+
+    Mirrors the kernel phase-for-phase: self-slab VMEM copy (no DMA),
+    entry ``barrier_all``, live-chunk push in ``a2a_send_peer`` order
+    with per-(slab, chunk) semaphore slots, ``a2a_wait_src``-ordered
+    arrival waits, send-side drain; recv-slab consumption (the
+    caller's read of the kernel output) is guarded per chunk by its
+    delivery semaphore. ``slot`` namespaces the semaphores for
+    cross-call composition; ``fp8_sideband`` adds the scale channel's
+    one-message-per-pair signal accounting."""
+    epoch = 0 if call is None else call
+    events: dict = {}
+    expected: dict = {}
+    for me in range(world):
+        ev: list = []
+        if world > 1:
+            ev.extend(barrier_evs(me, world, ("a2a", epoch)))
+            for p in _send_order(me, world):
+                for c in range(_live(counts[me][p], chunk)):
+                    ev.append(Ev("signal", me,
+                                 sem=("a2a", me, p, c, slot),
+                                 dst=p, call=call))
+                if fp8_sideband:
+                    ev.append(Ev("signal", me,
+                                 sem=("scale", me, p, slot),
+                                 dst=p, call=call))
+            for j in _wait_order(me, world):
+                for c in range(_live(counts[j][me], chunk)):
+                    ev.append(Ev("wait_recv", me,
+                                 sem=("a2a", j, me, c, slot),
+                                 call=call))
+                if fp8_sideband:
+                    ev.append(Ev("wait_recv", me,
+                                 sem=("scale", j, me, slot),
+                                 call=call))
+            for p in _send_order(me, world):
+                for c in range(_live(counts[me][p], chunk)):
+                    ev.append(Ev("wait_send", me,
+                                 sem=("a2a", me, p, c, slot),
+                                 call=call))
+                if fp8_sideband:
+                    ev.append(Ev("wait_send", me,
+                                 sem=("scale", me, p, slot),
+                                 call=call))
+        # Kernel-exit handoff: the caller reads every live recv chunk.
+        want: dict = {}
+        for j in range(world):
+            for c in range(_live(counts[j][me], chunk)):
+                guard = None if j == me else ("a2a", j, me, c, slot)
+                ev.append(Ev("consume", me,
+                             key=("slab", epoch, j, c), guard=guard,
+                             call=call))
+                want[("slab", epoch, j, c)] = 1
+            if fp8_sideband and j != me:
+                ev.append(Ev("consume", me, key=("scales", epoch, j),
+                             guard=("scale", j, me, slot), call=call))
+                want[("scales", epoch, j)] = 1
+        events[me] = ev
+        expected[me] = want
+    from triton_dist_tpu.ops import all_to_all
+    return Trace(
+        name=name or f"a2a[w{world} c{chunk}"
+                     f"{' fp8' if fp8_sideband else ''}]",
+        world=world, dirs=1, events=events, expected=expected,
+        anchor=anchor_of(all_to_all._a2a_kernel), code_prefix="a2a")
+
+
+def a2a_call_sequence(world: int, n_calls: int, counts_seq=None,
+                      buffering: str = "fresh", chunk: int = CHUNK,
+                      fp8_sideband: bool = False) -> Trace:
+    """Composed trace of ``n_calls`` consecutive dispatches under one
+    buffering regime: ``"fresh"`` (slot = call — the TPU per-
+    ``pallas_call`` collapse, all_to_all.py:25-28) or ``"parity"``
+    (slot = call % 2 — the reference's call_count re-expression)."""
+    if buffering not in ("fresh", "parity"):
+        raise ValueError(f"unknown buffering {buffering!r}")
+    if counts_seq is None:
+        pats = list(counts_patterns(world, chunk=chunk).values())
+        counts_seq = [pats[k % len(pats)] for k in range(n_calls)]
+    assert len(counts_seq) == n_calls
+    traces = []
+    for k in range(n_calls):
+        slot = k if buffering == "fresh" else k % 2
+        traces.append(a2a_trace(world, counts_seq[k], chunk=chunk,
+                                call=k, slot=slot,
+                                fp8_sideband=fp8_sideband))
+    return concat_traces(
+        traces, f"a2a_seq[w{world} x{n_calls} {buffering}"
+                f"{' fp8' if fp8_sideband else ''}]")
+
+
+def check_call_parity(trace: Trace, buffering: str = "parity") -> list:
+    """Structural double-buffer invariant, independent of execution:
+    every call-stamped a2a/scale semaphore event must use its call's
+    slot — ``call % 2`` under the parity regime, ``call`` under fresh
+    per-call buffers. A call signalling the *other* buffer's slots is
+    the classic double-buffer bug (the reference guards it with
+    ``signal_wait_until(EQ, call_count)``); here it is a distinct
+    finding class, ``a2a.call_parity``."""
+    v = []
+    for r, evs in trace.events.items():
+        for e in evs:
+            if e.call is None or e.sem is None or \
+                    e.sem[0] not in ("a2a", "scale"):
+                continue
+            slot = e.sem[-1]
+            want = e.call % 2 if buffering == "parity" else e.call
+            if slot != want:
+                v.append(Violation(
+                    "a2a.call_parity",
+                    f"{trace.name}: rank {r} {e.kind} on sem {e.sem} "
+                    f"uses buffer slot {slot} at call {e.call} "
+                    f"(expected slot {want} under {buffering} "
+                    f"buffering) — double-buffer parity violated"))
+    return v
+
+
+def verify_a2a(worlds=range(1, 9), seq_lens=(1, 2, 3, 4)) -> list:
+    """Model-check the a2a protocol: every counts pattern per world,
+    the fp8 scale sideband, and call sequences of length 1..4 under
+    both buffering regimes (the parity re-expression AND the
+    documented TPU collapse). Returns findings."""
+    findings = []
+    hint = ("the slab/chunk schedule this trace mirrors violates the "
+            "a2a protocol — see docs/analysis.md 'a2a-protocol'")
+
+    def emit(trace, extra=()):
+        findings.extend(violations_to_findings(
+            trace, "a2a-protocol", fix_hint=hint,
+            violations=check_trace(trace) + list(extra)))
+
+    for world in worlds:
+        for pat_name, counts in counts_patterns(world).items():
+            emit(a2a_trace(world, counts,
+                           name=f"a2a[w{world} {pat_name}]"))
+        emit(a2a_trace(world, counts_patterns(world)["ragged"],
+                       fp8_sideband=True,
+                       name=f"a2a[w{world} ragged fp8]"))
+        for n in seq_lens:
+            for buffering in ("fresh", "parity"):
+                t = a2a_call_sequence(world, n, buffering=buffering)
+                emit(t, extra=check_call_parity(t, buffering))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Mutators (tests/test_protocol_check.py): the generic dropped-wait /
+# doubled-signal mutants come from protocol_model (sem_kind="a2a"
+# skips barrier events); swapped call parity is a2a-specific.
+# ---------------------------------------------------------------------------
+
+def swap_call_parity(trace: Trace, call: int = 1) -> Trace:
+    """Swapped-parity mutant: one call's SIGNALS land in the other
+    double-buffer slot while its receivers wait on the right one —
+    the cross-call bug class the reference's ``call_count`` protocol
+    exists to prevent."""
+    t = copy_trace(trace)
+    for r, evs in t.events.items():
+        for i, e in enumerate(evs):
+            if e.kind == "signal" and e.call == call and \
+                    e.sem is not None and e.sem[0] in ("a2a", "scale"):
+                sem = e.sem[:-1] + (1 - e.sem[-1],)
+                evs[i] = dataclasses.replace(e, sem=sem)
+    return t

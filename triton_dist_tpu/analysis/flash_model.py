@@ -1,0 +1,123 @@
+"""Static model checker for the distributed flash-decode combine.
+
+``ops/flash_decode.py``'s ``_exchange_and_merge`` is the cross-rank
+softmax-state combine (SURVEY §2.5: split-KV decode where one
+request's KV spans chips): every rank pushes its (acc, l, m) partial
+into every peer's combine-buffer slot — three remote DMAs per peer,
+per-(source, buffer) semaphore slots — waits for all peers, then
+merges. The merge is only correct if **each rank's partial enters
+the softmax rescale exactly once per output row**: a dropped
+contributor silently skews the distribution (not a hang — the worst
+kind of protocol bug), a doubled one double-counts its weight.
+
+The model executes the kernel's own ``combine_peer`` /
+``combine_src`` orderings with concrete ranks and mirrors the
+barrier → send-all → wait-all → drain → merge program order. The
+merge is modeled as one guarded consume per (source rank, buffer)
+pair, so the coverage verdict *is* the exactly-once-merge proof
+(``flash.coverage``), alongside the usual balance / deadlock /
+arrival-ordering verdicts (``flash.signal_wait_imbalance``,
+``flash.deadlock``, ``flash.race``). Both distributed decode kernels
+(``_decode_kernel`` and ``_tiled_decode_kernel``) funnel through this
+one combine, so one trace shape covers the einsum, tiled and paged
+variants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+from triton_dist_tpu.analysis.protocol_model import (
+    Ev, Trace, anchor_of, barrier_evs, check_trace, copy_trace,
+    violations_to_findings)
+
+__all__ = [
+    "combine_trace", "verify_flash_decode", "shift_merge_contributor",
+]
+
+#: The three softmax-state buffers exchanged per peer (acc, l, m).
+N_BUFS = 3
+
+
+@functools.lru_cache(maxsize=None)
+def _peer_order(me: int, world: int) -> tuple:
+    from triton_dist_tpu.ops.flash_decode import combine_peer
+    return tuple(int(combine_peer(me, p, world))
+                 for p in range(1, world))
+
+
+@functools.lru_cache(maxsize=None)
+def _src_order(me: int, world: int) -> tuple:
+    from triton_dist_tpu.ops.flash_decode import combine_src
+    return tuple(int(combine_src(me, p, world))
+                 for p in range(1, world))
+
+
+def combine_trace(world: int) -> Trace:
+    """Event trace of one ``_exchange_and_merge``: per rank, barrier,
+    three signals per peer (per-(source, buffer) semaphore slots),
+    arrival waits in ``combine_src`` order, send-side drain, then the
+    merge consuming every (source, buffer) partial exactly once."""
+    events: dict = {}
+    expected: dict = {}
+    for me in range(world):
+        ev: list = []
+        if world > 1:
+            ev.extend(barrier_evs(me, world, "fd"))
+            for peer in _peer_order(me, world):
+                for i in range(N_BUFS):
+                    ev.append(Ev("signal", me, sem=("fd", me, peer, i),
+                                 dst=peer))
+            for src in _src_order(me, world):
+                for i in range(N_BUFS):
+                    ev.append(Ev("wait_recv", me,
+                                 sem=("fd", src, me, i)))
+            for peer in _peer_order(me, world):
+                for i in range(N_BUFS):
+                    ev.append(Ev("wait_send", me,
+                                 sem=("fd", me, peer, i)))
+        # _merge reads the full (world, ...) stacked buffers: every
+        # rank's partial, own slot included, once each.
+        for j in range(world):
+            for i in range(N_BUFS):
+                guard = None if j == me else ("fd", j, me, i)
+                ev.append(Ev("consume", me, key=("partial", j, i),
+                             guard=guard))
+        events[me] = ev
+        expected[me] = {("partial", j, i): 1
+                        for j in range(world) for i in range(N_BUFS)}
+    from triton_dist_tpu.ops import flash_decode
+    return Trace(name=f"flash_combine[w{world}]", world=world, dirs=1,
+                 events=events, expected=expected,
+                 anchor=anchor_of(flash_decode._exchange_and_merge),
+                 code_prefix="flash")
+
+
+def verify_flash_decode(worlds=range(1, 9)) -> list:
+    """Model-check the combine for every world size; returns
+    findings."""
+    findings = []
+    for world in worlds:
+        findings.extend(violations_to_findings(
+            combine_trace(world), "flash-decode-protocol",
+            fix_hint=("the combine this trace mirrors violates the "
+                      "exactly-once softmax-state merge — see "
+                      "docs/analysis.md 'flash-decode-protocol'")))
+    return findings
+
+
+def shift_merge_contributor(trace: Trace, rank: int = 0) -> Trace:
+    """Off-by-one merge-contributor mutant: the merge at ``rank``
+    reads one peer's slot twice and skips another's — the silent
+    distribution-skew bug class (no hang, wrong softmax)."""
+    t = copy_trace(trace)
+    evs = t.events[rank]
+    for i, e in enumerate(evs):
+        if e.kind == "consume" and e.key[1] != rank:
+            j = (e.key[1] + 1) % t.world
+            guard = None if j == rank else ("fd", j, rank, e.key[2])
+            evs[i] = dataclasses.replace(
+                e, key=("partial", j, e.key[2]), guard=guard)
+            break
+    return t

@@ -1,0 +1,180 @@
+"""``protocol-coverage`` meta-lint: no comm kernel lands unverified.
+
+The protocol passes (ring / a2a / p2p / flash-decode) each prove one
+kernel family's signal/wait discipline — but nothing used to prove
+the *map* stayed total: a new kernel using remote DMA semaphores
+would quietly ship with no verifier claiming it, which is exactly how
+the ring bugs reached a chip queue before PR 8. This lint closes the
+meta-hole: it ASTs every module under ``ops/`` for semaphore/DMA
+usage (``make_async_remote_copy``, ``SemaphoreType.DMA``,
+``pltpu.semaphore_*``, the ``dl.*`` wrappers) and fails when a module
+that uses them is claimed by no registered verifier pass — so the
+NEXT comm kernel (the ROADMAP's KV-block streaming, MoE a2a variants)
+cannot land unverified.
+
+Three finding classes, all error severity:
+
+- ``protocol.unclaimed_semaphore`` — a module uses protocol
+  primitives but appears in neither :data:`CLAIMS` nor
+  :data:`BACKLOG`; anchored at the first primitive usage.
+- ``protocol.unknown_pass`` — a claim names a pass the registry
+  doesn't have (a claim must be checkable, not a comment).
+- ``protocol.stale_claim`` — a claimed/backlogged module no longer
+  uses any primitive (the both-directions discipline the
+  metric-catalog lint established: dead rows are drift too).
+
+:data:`BACKLOG` enumerates the pre-zoo kernels that predate the
+protocol-model core — explicit, rationale'd debt, not a licence.
+Moving a module out of BACKLOG means writing its trace builder on
+``analysis/protocol_model.py``; adding to it is a reviewed diff the
+same way ``lint_fallback.DELEGATES`` is.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from triton_dist_tpu.analysis.findings import Finding
+
+__all__ = ["CLAIMS", "BACKLOG", "PRIMITIVES", "scan_module",
+           "collect_findings", "run"]
+
+#: Verified kernels: ops/ module basename -> the registered pass that
+#: model-checks its protocol (docs/analysis.md pass catalog).
+CLAIMS = {
+    "allgather_gemm.py": "ring-protocol",
+    "gemm_reduce_scatter.py": "ring-protocol",
+    "all_to_all.py": "a2a-protocol",
+    "p2p.py": "p2p-protocol",
+    "flash_decode.py": "flash-decode-protocol",
+}
+
+#: Pre-zoo kernels awaiting trace builders — each entry names what
+#: retires it. An entry here silences the lint for that module ONLY;
+#: new modules must claim a pass or extend this table in review.
+BACKLOG = {
+    "allgather.py": "standalone AG kernel family (ring + full-mesh "
+                    "push variants); fold into ag_ring_trace shapes "
+                    "next chip window (ROADMAP item 4)",
+    "allreduce.py": "one-shot/ring AR staging buffers; protocol is "
+                    "the gemm_rs trace's AG epilogue shape — needs "
+                    "its own counts oracle",
+    "reduce_scatter.py": "standalone RS ring; subsumed by "
+                         "gemm_rs_trace's reduction-chain model once "
+                         "the standalone schedule is mirrored",
+    "group_gemm.py": "AG-side ring of the grouped-GEMM producer; "
+                     "shares _make_ring structure (ring-protocol "
+                     "covers the schedule, not this consumer loop)",
+    "moe_reduce_rs.py": "fused MoE-RS ring (rs_copy/rs_step); "
+                        "mirrors the GEMM-RS chunk protocol — trace "
+                        "builder with expert-aligned coverage oracle "
+                        "pending (ROADMAP item 5 MoE serving)",
+    "sp_attention.py": "sequence-parallel KV ring; needs a trace "
+                       "with per-(slot, dir) double-buffer oracle",
+}
+
+#: Attribute names whose use marks a module as protocol-bearing.
+#: ``DMA`` only counts as ``SemaphoreType.DMA``; the rest count as
+#: ``pltpu.<name>`` / ``dl.<name>`` attributes or direct imports.
+PRIMITIVES = frozenset({
+    "make_async_remote_copy", "remote_copy", "semaphore_signal",
+    "semaphore_wait", "semaphore_read", "get_barrier_semaphore",
+    "barrier_all", "barrier_neighbors", "notify",
+})
+
+
+def scan_module(path: Path):
+    """(first_line, {primitive names used}) of semaphore/DMA usage in
+    one module — AST-based, so docstring prose never counts."""
+    tree = ast.parse(path.read_text(encoding="utf-8"), str(path))
+    used: dict = {}
+
+    def note(name: str, node):
+        used.setdefault(name, node.lineno)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            if node.attr in PRIMITIVES:
+                note(node.attr, node)
+            elif node.attr == "DMA" and \
+                    isinstance(node.value, ast.Attribute) and \
+                    node.value.attr == "SemaphoreType":
+                note("SemaphoreType.DMA", node)
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name in PRIMITIVES:
+                    note(alias.name, node)
+    if not used:
+        return None, frozenset()
+    return min(used.values()), frozenset(used)
+
+
+def collect_findings(ops_dir: Path = None, claims: dict = None,
+                     backlog: dict = None, passes=None) -> list:
+    """All protocol-coverage findings (empty == the kernel zoo map is
+    total). Every input is injectable for the seeded-drift tests."""
+    if ops_dir is None:
+        import triton_dist_tpu.ops
+        ops_dir = Path(triton_dist_tpu.ops.__file__).parent
+    claims = CLAIMS if claims is None else claims
+    backlog = BACKLOG if backlog is None else backlog
+    if passes is None:
+        from triton_dist_tpu.analysis import PASSES
+        passes = PASSES
+    findings = []
+    seen = set()
+    for path in sorted(ops_dir.glob("*.py")):
+        name = path.name
+        if name == "__init__.py":
+            continue
+        seen.add(name)
+        line, used = scan_module(path)
+        uses = bool(used)
+        if uses and name not in claims and name not in backlog:
+            findings.append(Finding(
+                code="protocol.unclaimed_semaphore",
+                message=f"{name} uses comm-protocol primitives "
+                        f"({', '.join(sorted(used))}) but no verifier "
+                        f"pass claims its protocol",
+                file=str(path), line=line,
+                pass_name="protocol-coverage",
+                fix_hint="build a trace model on analysis/"
+                         "protocol_model.py, register its pass, and "
+                         "claim the module in lint_protocol.CLAIMS "
+                         "(docs/analysis.md 'protocol-coverage')"))
+        elif uses and name in claims and claims[name] not in passes:
+            findings.append(Finding(
+                code="protocol.unknown_pass",
+                message=f"{name} claims verifier pass "
+                        f"{claims[name]!r}, which is not registered "
+                        f"— a claim must be checkable",
+                file=str(path), line=line,
+                pass_name="protocol-coverage",
+                fix_hint="register the pass in analysis/__init__.py "
+                         "or fix the CLAIMS entry"))
+        elif not uses and (name in claims or name in backlog):
+            findings.append(Finding(
+                code="protocol.stale_claim",
+                message=f"{name} is claimed"
+                        f"{' (backlog)' if name in backlog else ''} "
+                        f"but no longer uses any protocol primitive "
+                        f"— drop the stale entry",
+                file=str(path), line=1,
+                pass_name="protocol-coverage",
+                fix_hint="remove the module from lint_protocol."
+                         f"{'BACKLOG' if name in backlog else 'CLAIMS'}"))
+    for name in sorted((set(claims) | set(backlog)) - seen):
+        findings.append(Finding(
+            code="protocol.stale_claim",
+            message=f"{name} is claimed but does not exist under "
+                    f"{ops_dir}",
+            file=str(ops_dir / name), line=1,
+            pass_name="protocol-coverage",
+            fix_hint="remove the dangling claim"))
+    return findings
+
+
+def run(root) -> list:
+    del root
+    return collect_findings()

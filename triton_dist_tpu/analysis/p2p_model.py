@@ -1,0 +1,133 @@
+"""Static model checker for the PP ``_shift_kernel`` hop protocol.
+
+``ops/p2p.py``'s Pallas path is one remote DMA per rank per hop: push
+the local buffer to rank ``me+delta``, wait the incoming DMA's recv
+semaphore, drain the outgoing send semaphore — the reference's p2p
+set/wait signal pair collapsed into the DMA semaphore pair. The
+ROADMAP's disaggregated prefill/decode tier (item 2) makes this the
+transport for KV-block streaming, so its protocol gets the same
+static proof the rings and the a2a have: a signal/wait imbalance is a
+CI failure, not a fleet hang.
+
+The model executes the kernel's own :func:`~triton_dist_tpu.ops.p2p.
+shift_partners` with concrete ranks and mirrors the kernel's
+barrier → start → wait_recv → wait_send program order. A **pipeline**
+(:func:`pipeline_trace`) composes a sequence of hops with mixed
+±delta values — each stage a separate ``pallas_call`` with fresh
+semaphores, per-rank concatenation via ``concat_traces`` — and the
+composed verdicts prove a mixed-direction pipeline cannot deadlock
+(``p2p.deadlock``), double-deliver (``p2p.coverage``), read in-flight
+data (``p2p.race``) or leave a semaphore nonzero
+(``p2p.signal_wait_imbalance``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+from triton_dist_tpu.analysis.protocol_model import (
+    Ev, Trace, anchor_of, barrier_evs, check_trace, concat_traces,
+    copy_trace, violations_to_findings)
+
+__all__ = [
+    "shift_trace", "pipeline_trace", "verify_p2p", "swap_delta",
+    "PIPELINES",
+]
+
+#: Representative hop sequences: single hops both ways, a long-range
+#: hop, forward-backward bubbles, and a mixed ±delta pipeline — the
+#: shapes a 1F1B/interleaved PP schedule issues.
+PIPELINES = (
+    (1,), (-1,), (2,),
+    (1, -1), (-1, 1),
+    (1, 1, -1),
+    (1, -1, 2, -2),
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _partners(me: int, delta: int, world: int) -> tuple:
+    """(dst, src) from the kernel's own ``shift_partners``."""
+    from triton_dist_tpu.ops.p2p import shift_partners
+    dst, src = shift_partners(me, delta, world)
+    return int(dst), int(src)
+
+
+def shift_trace(world: int, delta: int, stage: int = 0) -> Trace:
+    """Event trace of one ``pp_shift`` hop (one ``pallas_call``:
+    fresh single DMA semaphore pair per rank, namespaced by
+    ``stage`` for composition). ``world == 1`` mirrors the host-side
+    early return (no kernel, identity)."""
+    events: dict = {}
+    expected: dict = {}
+    for me in range(world):
+        if world == 1:
+            events[me] = [Ev("consume", me, key=("stage", stage, me),
+                             call=stage)]
+            expected[me] = {("stage", stage, me): 1}
+            continue
+        dst, src = _partners(me, delta, world)
+        sem = ("p2p", stage)
+        ev = barrier_evs(me, world, ("p2p", stage))
+        ev.append(Ev("signal", me, sem=sem, dst=dst, call=stage))
+        ev.append(Ev("wait_recv", me, sem=sem, call=stage))
+        ev.append(Ev("consume", me, key=("stage", stage, src),
+                     guard=sem, call=stage))
+        ev.append(Ev("wait_send", me, sem=sem, call=stage))
+        events[me] = ev
+        # Coverage oracle from the CONTRACT (pp_shift docstring:
+        # stage i holds what stage i-delta had), independent of
+        # shift_partners — so a bug in the kernel's own partner math
+        # shows up as a coverage mismatch, not a matching mirror.
+        expected[me] = {("stage", stage, (me - delta) % world): 1}
+    from triton_dist_tpu.ops import p2p
+    return Trace(name=f"p2p[w{world} d{delta:+d} s{stage}]",
+                 world=world, dirs=1, events=events, expected=expected,
+                 anchor=anchor_of(p2p._shift_kernel),
+                 code_prefix="p2p")
+
+
+def pipeline_trace(world: int, deltas) -> Trace:
+    """Composed trace of a hop pipeline: stage ``k`` shifts by
+    ``deltas[k]``. Each stage's semaphores are stage-fresh (one
+    ``pallas_call`` each), so proving the composition reduces to
+    proving every stage balances and drains — which the composed
+    verdicts check rather than assume."""
+    traces = [shift_trace(world, d, stage=k)
+              for k, d in enumerate(deltas)]
+    return concat_traces(
+        traces,
+        f"p2p_pipe[w{world} " +
+        ",".join(f"{d:+d}" for d in deltas) + "]")
+
+
+def verify_p2p(worlds=range(1, 9), pipelines=PIPELINES) -> list:
+    """Model-check every hop pipeline shape per world; returns
+    findings."""
+    findings = []
+    for world in worlds:
+        for deltas in pipelines:
+            findings.extend(violations_to_findings(
+                pipeline_trace(world, deltas), "p2p-protocol",
+                fix_hint=("the shift schedule this trace mirrors "
+                          "violates the p2p hop protocol — see "
+                          "docs/analysis.md 'p2p-protocol'")))
+    return findings
+
+
+def swap_delta(trace: Trace, rank: int = 0, stage: int = 0) -> Trace:
+    """Wrong-direction mutant: one rank pushes its buffer the wrong
+    way at one stage — the rank it should have fed waits on a
+    delivery that never comes."""
+    t = copy_trace(trace)
+    evs = t.events[rank]
+    # The swapped send goes to the rank's *source* partner (whoever it
+    # receives from at this stage) instead of its destination.
+    wrong = next(e.key[2] for e in evs
+                 if e.kind == "consume" and e.call == stage)
+    for i, e in enumerate(evs):
+        if e.kind == "signal" and e.call == stage and \
+                e.sem is not None and e.sem[0] == "p2p":
+            evs[i] = dataclasses.replace(e, dst=wrong)
+    return t

@@ -1,0 +1,334 @@
+"""Reusable symbolic protocol-model core for the comm-kernel zoo.
+
+Extracted from the ring checker (``analysis/ring_model.py``, PR 8) so
+every signal/wait protocol in ``ops/`` — the fused-GEMM rings, the EP
+all-to-all's slab/chunk push, the PP ``_shift_kernel`` hops, the
+flash-decode softmax-state combine — shares one verified execution
+model instead of growing a private checker each (ISSUE 12; the
+``protocol-coverage`` meta-lint in :mod:`.lint_protocol` enforces that
+every semaphore-using kernel is claimed by *some* pass built on this
+core).
+
+The model: each kernel schedule is mirrored into per-rank **event
+traces** over four event kinds —
+
+- ``signal``: a remote-copy start (or remote ``semaphore_signal``)
+  whose recv side of ``sem`` fires at ``dst`` and whose send side
+  fires back at the source;
+- ``wait_recv`` / ``wait_send``: blocking decrements of the local
+  side of ``sem``;
+- ``consume``: a read of data tile ``key`` guarded by delivery
+  semaphore ``guard`` (``None`` = local data).
+
+Verdicts (:func:`check_trace`, codes prefixed by
+``Trace.code_prefix`` so each protocol family owns distinct finding
+classes):
+
+- ``<p>.deadlock`` — greedy maximal execution leaves a rank blocked.
+  Waits are the only blocking ops and signals are monotonic (each
+  (dst, sem) counter only grows), so the maximal execution is
+  *unique*: any rank blocked there is deadlocked under every
+  interleaving.
+- ``<p>.signal_wait_imbalance`` — signals vs waits per (rank, sem),
+  both recv and send sides (a surplus leaves a semaphore nonzero at
+  kernel exit; a deficit is a hang).
+- ``<p>.race`` — a consume of a remote tile with no prior wait on its
+  delivery semaphore in program order (the static analog of
+  ``TDT_DETECT_RACES``).
+- ``<p>.coverage`` — consume counts differ from the trace's expected
+  map (a tile landing twice, or never).
+
+Cross-call composition: traces compose by per-rank concatenation
+(:func:`concat_traces`), events optionally stamped with their call
+index (``Ev.call``) so protocol-specific invariants — e.g. the
+all-to-all double-buffer call-parity re-expression
+(:mod:`.a2a_model`) — can be checked across call sequences.
+``barrier_evs`` models ``dl.barrier_all`` (world signals + a
+world-count wait per rank) so composed traces carry the same
+inter-call ordering the kernels rely on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from collections import Counter
+
+from triton_dist_tpu.analysis.findings import Finding
+
+__all__ = [
+    "Ev", "Trace", "Violation", "check_trace", "concat_traces",
+    "barrier_evs", "anchor_of", "violations_to_findings",
+    "drop_first_wait", "double_signal", "copy_trace", "first_event",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Ev:
+    """One protocol event in a rank's program order.
+
+    ``signal``: a remote-copy start at ``rank`` whose recv semaphore
+    ``sem`` fires at ``dst`` (and whose send semaphore fires back at
+    ``rank``). ``wait_recv``/``wait_send``: blocking decrements of the
+    local side of ``sem``. ``consume``: a read of output-tile ``key``
+    guarded by delivery semaphore ``guard`` (``None`` = local data).
+    ``call`` stamps the event's call index in a composed multi-call
+    trace (``None`` for single-call traces).
+    """
+    kind: str
+    rank: int
+    sem: tuple | None = None
+    dst: int | None = None
+    key: tuple | None = None
+    guard: tuple | None = None
+    call: int | None = None
+
+
+@dataclasses.dataclass
+class Trace:
+    """Per-rank event lists for one kernel schedule, plus the coverage
+    oracle (``expected`` consume keys per rank; ``outputs`` are
+    symbolic reduction results as (rank, unit, {chunk: contributors})
+    tuples — see :func:`check_trace`). ``code_prefix`` namespaces the
+    violation codes (``ring.*``, ``a2a.*``, ``p2p.*``, ``flash.*``)."""
+    name: str
+    world: int
+    dirs: int
+    events: dict
+    expected: dict
+    outputs: list = dataclasses.field(default_factory=list)
+    anchor: tuple = (None, None)
+    code_prefix: str = "ring"
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    code: str       # <prefix>.deadlock / <prefix>.signal_wait_imbalance
+    #                 / <prefix>.race / <prefix>.coverage / ...
+    detail: str
+
+
+def anchor_of(obj) -> tuple:
+    """(file, line) of the kernel/helper a trace mirrors — the code a
+    finding asks you to change."""
+    try:
+        file = inspect.getsourcefile(obj)
+        _, line = inspect.getsourcelines(obj)
+        return file, line
+    except (OSError, TypeError):
+        return None, None
+
+
+def barrier_evs(me: int, world: int, tag) -> list:
+    """Events mirroring ``dl.barrier_all``: signal every rank
+    (including self, keeping the count uniform) on the barrier
+    semaphore, then wait for world-many signals. ``tag`` namespaces
+    the barrier instance (e.g. the call index in a composed trace —
+    each ``pallas_call``'s barrier epoch)."""
+    evs = [Ev("signal", me, sem=("bar", tag), dst=d)
+           for d in range(world)]
+    evs.extend([Ev("wait_recv", me, sem=("bar", tag))] * world)
+    return evs
+
+
+# ---------------------------------------------------------------------------
+# Checker
+# ---------------------------------------------------------------------------
+
+def check_trace(trace: Trace) -> list:
+    """All protocol violations in one trace (empty list == verified)."""
+    p = trace.code_prefix
+    v: list[Violation] = []
+    events = trace.events
+
+    # --- deadlock: greedy maximal execution -------------------------------
+    # Waits are the only blocking ops and signals are monotonic (each
+    # (dst, sem) counter only grows), so running every rank as far as
+    # it can, repeatedly, reaches THE unique maximal execution: any
+    # rank still blocked there is deadlocked under every schedule.
+    pos = {r: 0 for r in events}
+    sig_recv: Counter = Counter()   # (dst, sem) -> signals executed
+    sig_send: Counter = Counter()   # (src, sem)
+    got_recv: Counter = Counter()
+    got_send: Counter = Counter()
+    progress = True
+    while progress:
+        progress = False
+        for r, evs in events.items():
+            while pos[r] < len(evs):
+                e = evs[pos[r]]
+                if e.kind == "signal":
+                    sig_recv[(e.dst, e.sem)] += 1
+                    sig_send[(r, e.sem)] += 1
+                elif e.kind == "wait_recv":
+                    if got_recv[(r, e.sem)] >= sig_recv[(r, e.sem)]:
+                        break
+                    got_recv[(r, e.sem)] += 1
+                elif e.kind == "wait_send":
+                    if got_send[(r, e.sem)] >= sig_send[(r, e.sem)]:
+                        break
+                    got_send[(r, e.sem)] += 1
+                pos[r] += 1
+                progress = True
+    stuck = {r: events[r][pos[r]] for r in events
+             if pos[r] < len(events[r])}
+    if stuck:
+        blocked = ", ".join(
+            f"rank {r} blocked in {e.kind} on sem {e.sem}"
+            for r, e in sorted(stuck.items()))
+        v.append(Violation(
+            f"{p}.deadlock",
+            f"{trace.name}: wait-before-signal cycle — {blocked}"))
+
+    # --- signal/wait balance (full traces, independent of execution) ------
+    want_recv: Counter = Counter()
+    want_send: Counter = Counter()
+    have_recv: Counter = Counter()
+    have_send: Counter = Counter()
+    for r, evs in events.items():
+        for e in evs:
+            if e.kind == "signal":
+                have_recv[(e.dst, e.sem)] += 1
+                have_send[(r, e.sem)] += 1
+            elif e.kind == "wait_recv":
+                want_recv[(r, e.sem)] += 1
+            elif e.kind == "wait_send":
+                want_send[(r, e.sem)] += 1
+    for side, have, want in (("recv", have_recv, want_recv),
+                             ("send", have_send, want_send)):
+        for key in sorted(set(have) | set(want), key=repr):
+            if key[1] and key[1][0] == "bar" and side == "send":
+                continue   # barrier signals have no send-side wait
+            if have[key] != want[key]:
+                rank, sem = key
+                v.append(Violation(
+                    f"{p}.signal_wait_imbalance",
+                    f"{trace.name}: sem {sem} at rank {rank}: "
+                    f"{have[key]} signal(s) vs {want[key]} "
+                    f"wait_{side}(s)"))
+
+    # --- arrival ordering (the static analog of TDT_DETECT_RACES) --------
+    for r, evs in events.items():
+        waited: set = set()
+        for e in evs:
+            if e.kind == "wait_recv":
+                waited.add(e.sem)
+            elif e.kind == "consume" and e.guard is not None \
+                    and e.guard not in waited:
+                v.append(Violation(
+                    f"{p}.race",
+                    f"{trace.name}: rank {r} consumes {e.key} before "
+                    f"any wait on its delivery sem {e.guard} "
+                    f"(read of an in-flight chunk)"))
+
+    # --- chunk-coverage exactness -----------------------------------------
+    for r, evs in events.items():
+        seen = Counter(e.key for e in evs if e.kind == "consume")
+        want = trace.expected.get(r, {})
+        for key in sorted(set(seen) | set(want), key=repr):
+            if seen[key] != want.get(key, 0):
+                v.append(Violation(
+                    f"{p}.coverage",
+                    f"{trace.name}: rank {r} consumes tile {key} "
+                    f"{seen[key]}x (expected {want.get(key, 0)}x)"))
+    all_ranks = tuple(range(trace.world))
+    for rank, unit, value in trace.outputs:
+        if set(value) != {rank} or \
+                tuple(sorted(value.get(rank, ()))) != all_ranks:
+            v.append(Violation(
+                f"{p}.coverage",
+                f"{trace.name}: output chunk {rank} (col unit {unit}) "
+                f"reduces {value!r}, want every rank's partial of "
+                f"chunk {rank} exactly once"))
+    return v
+
+
+def concat_traces(traces: list, name: str) -> Trace:
+    """Compose consecutive calls into one trace by per-rank
+    concatenation in call order — the model of a host issuing the same
+    kernel repeatedly. Expected-consume maps merge by summation (a
+    chunk live in two calls must land twice); semaphore namespacing
+    across calls is the *builders'* job (fresh per-call tuples model
+    per-``pallas_call`` scratch semaphores; shared tuples model
+    persistent symmetric buffers, the reference's parity regime)."""
+    assert traces, "nothing to compose"
+    world = traces[0].world
+    events: dict = {r: [] for r in range(world)}
+    expected: dict = {r: Counter() for r in range(world)}
+    outputs: list = []
+    for t in traces:
+        assert t.world == world
+        for r in range(world):
+            events[r].extend(t.events.get(r, ()))
+            expected[r].update(t.expected.get(r, {}))
+        outputs.extend(t.outputs)
+    return Trace(name=name, world=world, dirs=traces[0].dirs,
+                 events=events,
+                 expected={r: dict(c) for r, c in expected.items()},
+                 outputs=outputs, anchor=traces[0].anchor,
+                 code_prefix=traces[0].code_prefix)
+
+
+def violations_to_findings(trace: Trace, pass_name: str,
+                           fix_hint: str = "",
+                           violations: list | None = None) -> list:
+    """Wrap a trace's violations as findings anchored at the kernel
+    the trace mirrors — the one construction every protocol pass
+    shares. ``violations`` defaults to :func:`check_trace`; passes
+    with extra structural verdicts (the a2a parity check) pass the
+    combined list in."""
+    if violations is None:
+        violations = check_trace(trace)
+    file, line = trace.anchor
+    return [Finding(code=v.code, message=v.detail, file=file, line=line,
+                    pass_name=pass_name, fix_hint=fix_hint)
+            for v in violations]
+
+
+# ---------------------------------------------------------------------------
+# Generic mutators (tests/test_tdt_check.py, tests/test_protocol_check
+# .py): known-bad schedule mutants. Each returns a NEW trace; a checker
+# that passes all of them is untested.
+# ---------------------------------------------------------------------------
+
+def copy_trace(trace: Trace) -> Trace:
+    return dataclasses.replace(
+        trace, events={r: list(evs) for r, evs in trace.events.items()},
+        expected={r: dict(x) for r, x in trace.expected.items()},
+        outputs=list(trace.outputs), name=trace.name + "+mut")
+
+
+def first_event(trace: Trace, kind: str, rank=None,
+                sem_kind: str | None = None) -> tuple:
+    """(rank, index) of the first event of ``kind`` (optionally
+    restricted to one rank, or to sems whose leading tag matches
+    ``sem_kind`` — so mutators can skip barrier events)."""
+    for r in sorted(trace.events):
+        if rank is not None and r != rank:
+            continue
+        for i, e in enumerate(trace.events[r]):
+            if e.kind != kind:
+                continue
+            if sem_kind is not None and \
+                    (e.sem is None or e.sem[0] != sem_kind):
+                continue
+            return r, i
+    raise ValueError(f"no {kind} event in {trace.name}")
+
+
+def drop_first_wait(trace: Trace, rank=None,
+                    sem_kind: str | None = None) -> Trace:
+    """Dropped-wait mutant: a chunk is read while still in flight."""
+    t = copy_trace(trace)
+    r, i = first_event(t, "wait_recv", rank, sem_kind)
+    del t.events[r][i]
+    return t
+
+
+def double_signal(trace: Trace, rank=None,
+                  sem_kind: str | None = None) -> Trace:
+    """Doubled-signal mutant: a semaphore is left nonzero at exit."""
+    t = copy_trace(trace)
+    r, i = first_event(t, "signal", rank, sem_kind)
+    t.events[r].insert(i, t.events[r][i])
+    return t

@@ -7,26 +7,17 @@ whose deadlock and race bugs only manifest on chip. This module checks
 them *before* any compile: it symbolically executes the schedule —
 calling the kernels' own ``ring_chunk_schedule`` / ``ring_hop_counts``
 with concrete (rank, step) values, then mirroring ``_make_ring``'s
-copy/wait/forward structure into an explicit per-rank event trace —
-and verifies, for every world size and both ``ring_dirs`` settings:
+copy/wait/forward structure into explicit per-rank event traces — and
+verifies, for every world size and both ``ring_dirs`` settings,
+signal/wait balance, chunk-coverage exactness, deadlock freedom and
+arrival ordering.
 
-- **signal/wait balance** per (src, dst, semaphore): every remote-copy
-  start is matched by exactly one ``wait_recv`` at the destination and
-  one ``wait_send`` at the source (a surplus leaves a semaphore
-  nonzero at kernel exit; a deficit is a hang);
-- **chunk-coverage exactness**: every shard is consumed exactly once
-  per output tile (and every GEMM-RS output chunk sums exactly one
-  partial from every rank);
-- **absence of wait-before-signal cycles**: a greedy maximal execution
-  of the traces (semaphore waits are the only blocking ops and signals
-  are monotonic, so the maximal execution is unique) — any rank left
-  blocked is a deadlock, reported with the blocked semaphores;
-- **arrival ordering** (the race the dynamic ``TDT_DETECT_RACES``
-  interpreter checks at runtime): no remote chunk is read without a
-  preceding wait on its delivery semaphore in program order.
-
-The interpret-mode race detector checks only the (world, config) pairs
-a CPU test happens to run; this checker enumerates worlds 1..8 x both
+The event-trace machinery itself lives in
+:mod:`.protocol_model` (shared with the a2a / p2p / flash-decode
+checkers since ISSUE 12); this module keeps the ring-specific trace
+builders, the ``ring.*`` finding codes, and the ring mutators. The
+interpret-mode race detector checks only the (world, config) pairs a
+CPU test happens to run; this checker enumerates worlds 1..8 x both
 directions x every kernel schedule shape in milliseconds, so autotune
 candidates no test ever executed are still vetted
 (docs/analysis.md "ring-protocol").
@@ -36,10 +27,11 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import inspect
-from collections import Counter
 
-from triton_dist_tpu.analysis.findings import Finding
+from triton_dist_tpu.analysis.protocol_model import (
+    Ev, Trace, Violation, anchor_of as _anchor_of, check_trace,
+    copy_trace as _copy, double_signal, drop_first_wait,
+    first_event as _first)
 
 __all__ = [
     "Ev", "Trace", "Violation", "ag_ring_trace", "gemm_rs_trace",
@@ -47,45 +39,6 @@ __all__ = [
     "drop_first_wait", "double_signal", "shift_consume",
     "swap_direction",
 ]
-
-
-@dataclasses.dataclass(frozen=True)
-class Ev:
-    """One protocol event in a rank's program order.
-
-    ``signal``: a remote-copy start at ``rank`` whose recv semaphore
-    ``sem`` fires at ``dst`` (and whose send semaphore fires back at
-    ``rank``). ``wait_recv``/``wait_send``: blocking decrements of the
-    local side of ``sem``. ``consume``: a read of output-tile ``key``
-    guarded by delivery semaphore ``guard`` (``None`` = local data).
-    """
-    kind: str
-    rank: int
-    sem: tuple | None = None
-    dst: int | None = None
-    key: tuple | None = None
-    guard: tuple | None = None
-
-
-@dataclasses.dataclass
-class Trace:
-    """Per-rank event lists for one kernel schedule, plus the coverage
-    oracle (``expected`` consume keys per rank; ``outputs`` are the
-    GEMM-RS reduction results as {chunk: contributor-tuple} maps)."""
-    name: str
-    world: int
-    dirs: int
-    events: dict
-    expected: dict
-    outputs: list = dataclasses.field(default_factory=list)
-    anchor: tuple = (None, None)
-
-
-@dataclasses.dataclass(frozen=True)
-class Violation:
-    code: str       # ring.deadlock / ring.signal_wait_imbalance /
-    #                 ring.race / ring.coverage
-    detail: str
 
 
 @functools.lru_cache(maxsize=None)
@@ -107,15 +60,6 @@ def _hops(world: int, dirs: int):
     from triton_dist_tpu.ops.common import ring_hop_counts
     n_fwd, n_bwd = ring_hop_counts(world, dirs)
     return int(n_fwd), int(n_bwd)
-
-
-def _anchor_of(obj) -> tuple:
-    try:
-        file = inspect.getsourcefile(obj)
-        _, line = inspect.getsourcelines(obj)
-        return file, line
-    except (OSError, TypeError):
-        return None, None
 
 
 def ag_ring_trace(world: int, dirs: int, m_tiles: int = 1,
@@ -312,115 +256,6 @@ def gemm_rs_trace(world: int, dirs: int,
                  anchor=_anchor_of(gemm_reduce_scatter._gemm_rs_kernel))
 
 
-# ---------------------------------------------------------------------------
-# Checker
-# ---------------------------------------------------------------------------
-
-def check_trace(trace: Trace) -> list:
-    """All protocol violations in one trace (empty list == verified)."""
-    v: list[Violation] = []
-    events = trace.events
-
-    # --- deadlock: greedy maximal execution -------------------------------
-    # Waits are the only blocking ops and signals are monotonic (each
-    # (dst, sem) counter only grows), so running every rank as far as
-    # it can, repeatedly, reaches THE unique maximal execution: any
-    # rank still blocked there is deadlocked under every schedule.
-    pos = {r: 0 for r in events}
-    sig_recv: Counter = Counter()   # (dst, sem) -> signals executed
-    sig_send: Counter = Counter()   # (src, sem)
-    got_recv: Counter = Counter()
-    got_send: Counter = Counter()
-    progress = True
-    while progress:
-        progress = False
-        for r, evs in events.items():
-            while pos[r] < len(evs):
-                e = evs[pos[r]]
-                if e.kind == "signal":
-                    sig_recv[(e.dst, e.sem)] += 1
-                    sig_send[(r, e.sem)] += 1
-                elif e.kind == "wait_recv":
-                    if got_recv[(r, e.sem)] >= sig_recv[(r, e.sem)]:
-                        break
-                    got_recv[(r, e.sem)] += 1
-                elif e.kind == "wait_send":
-                    if got_send[(r, e.sem)] >= sig_send[(r, e.sem)]:
-                        break
-                    got_send[(r, e.sem)] += 1
-                pos[r] += 1
-                progress = True
-    stuck = {r: events[r][pos[r]] for r in events
-             if pos[r] < len(events[r])}
-    if stuck:
-        blocked = ", ".join(
-            f"rank {r} blocked in {e.kind} on sem {e.sem}"
-            for r, e in sorted(stuck.items()))
-        v.append(Violation(
-            "ring.deadlock",
-            f"{trace.name}: wait-before-signal cycle — {blocked}"))
-
-    # --- signal/wait balance (full traces, independent of execution) ------
-    want_recv: Counter = Counter()
-    want_send: Counter = Counter()
-    have_recv: Counter = Counter()
-    have_send: Counter = Counter()
-    for r, evs in events.items():
-        for e in evs:
-            if e.kind == "signal":
-                have_recv[(e.dst, e.sem)] += 1
-                have_send[(r, e.sem)] += 1
-            elif e.kind == "wait_recv":
-                want_recv[(r, e.sem)] += 1
-            elif e.kind == "wait_send":
-                want_send[(r, e.sem)] += 1
-    for side, have, want in (("recv", have_recv, want_recv),
-                             ("send", have_send, want_send)):
-        for key in sorted(set(have) | set(want), key=repr):
-            if have[key] != want[key]:
-                rank, sem = key
-                v.append(Violation(
-                    "ring.signal_wait_imbalance",
-                    f"{trace.name}: sem {sem} at rank {rank}: "
-                    f"{have[key]} signal(s) vs {want[key]} "
-                    f"wait_{side}(s)"))
-
-    # --- arrival ordering (the static analog of TDT_DETECT_RACES) --------
-    for r, evs in events.items():
-        waited: set = set()
-        for e in evs:
-            if e.kind == "wait_recv":
-                waited.add(e.sem)
-            elif e.kind == "consume" and e.guard is not None \
-                    and e.guard not in waited:
-                v.append(Violation(
-                    "ring.race",
-                    f"{trace.name}: rank {r} consumes {e.key} before "
-                    f"any wait on its delivery sem {e.guard} "
-                    f"(read of an in-flight chunk)"))
-
-    # --- chunk-coverage exactness -----------------------------------------
-    for r, evs in events.items():
-        seen = Counter(e.key for e in evs if e.kind == "consume")
-        want = trace.expected.get(r, {})
-        for key in sorted(set(seen) | set(want), key=repr):
-            if seen[key] != want.get(key, 0):
-                v.append(Violation(
-                    "ring.coverage",
-                    f"{trace.name}: rank {r} consumes tile {key} "
-                    f"{seen[key]}x (expected {want.get(key, 0)}x)"))
-    all_ranks = tuple(range(trace.world))
-    for rank, unit, value in trace.outputs:
-        if set(value) != {rank} or \
-                tuple(sorted(value.get(rank, ()))) != all_ranks:
-            v.append(Violation(
-                "ring.coverage",
-                f"{trace.name}: output chunk {rank} (col unit {unit}) "
-                f"reduces {value!r}, want every rank's partial of "
-                f"chunk {rank} exactly once"))
-    return v
-
-
 def family_traces(world: int, dirs: int, m_tiles: int = 2,
                   n_blocks: int = 2) -> list:
     """Every fused-family schedule shape at one (world, dirs)."""
@@ -434,59 +269,24 @@ def family_traces(world: int, dirs: int, m_tiles: int = 2,
 
 def verify_family(worlds=range(1, 9), dirs_list=(1, 2)) -> list:
     """Model-check every fused-family ring schedule; returns Findings."""
+    from triton_dist_tpu.analysis.protocol_model import (
+        violations_to_findings)
     findings = []
     for world in worlds:
         for dirs in dirs_list:
             for trace in family_traces(world, dirs):
-                for viol in check_trace(trace):
-                    file, line = trace.anchor
-                    findings.append(Finding(
-                        code=viol.code, message=viol.detail,
-                        file=file, line=line,
-                        pass_name="ring-protocol",
-                        fix_hint=("the schedule this trace mirrors "
-                                  "violates the ring protocol — see "
-                                  "docs/analysis.md 'ring-protocol'")))
+                findings.extend(violations_to_findings(
+                    trace, "ring-protocol",
+                    fix_hint=("the schedule this trace mirrors "
+                              "violates the ring protocol — see "
+                              "docs/analysis.md 'ring-protocol'")))
     return findings
 
 
 # ---------------------------------------------------------------------------
-# Mutators (tests/test_tdt_check.py): known-bad schedule mutants. Each
-# returns a NEW trace; a checker that passes all of them is untested.
+# Ring-specific mutators (the generic dropped-wait / doubled-signal
+# mutators live in protocol_model and are re-exported above).
 # ---------------------------------------------------------------------------
-
-def _copy(trace: Trace) -> Trace:
-    return dataclasses.replace(
-        trace, events={r: list(evs) for r, evs in trace.events.items()},
-        expected={r: dict(x) for r, x in trace.expected.items()},
-        outputs=list(trace.outputs), name=trace.name + "+mut")
-
-
-def _first(trace: Trace, kind: str, rank=None) -> tuple:
-    for r in sorted(trace.events):
-        if rank is not None and r != rank:
-            continue
-        for i, e in enumerate(trace.events[r]):
-            if e.kind == kind:
-                return r, i
-    raise ValueError(f"no {kind} event in {trace.name}")
-
-
-def drop_first_wait(trace: Trace, rank=None) -> Trace:
-    """Dropped-wait mutant: a chunk is read while still in flight."""
-    t = _copy(trace)
-    r, i = _first(t, "wait_recv", rank)
-    del t.events[r][i]
-    return t
-
-
-def double_signal(trace: Trace, rank=None) -> Trace:
-    """Doubled-signal mutant: a semaphore is left nonzero at exit."""
-    t = _copy(trace)
-    r, i = _first(t, "signal", rank)
-    t.events[r].insert(i, t.events[r][i])
-    return t
-
 
 def shift_consume(trace: Trace, by: int = 1) -> Trace:
     """Off-by-one chunk-index mutant: one tile consumes the wrong

@@ -28,25 +28,41 @@ from __future__ import annotations
 
 from triton_dist_tpu.analysis.findings import Finding
 
-__all__ = ["vet_candidate", "sweep_candidate_tables"]
+__all__ = ["vet_candidate", "sweep_candidate_tables",
+           "sweep_comm_buffers"]
 
 #: Representative sweep shapes: the bench shape family (docs/perf.md)
 #: at bf16. (m, k, n) are GLOBAL dims; per-op local dims derive from
 #: the world size exactly as the op entries derive them.
 SWEEP_SHAPES = ((4096, 4096, 4096), (8192, 8192, 8192))
 
+#: Comm-buffer sweep shapes (ISSUE 12 satellite). all_to_all: the
+#: reference's headline LL config (128 tokens/rank) at the serving
+#: hidden size on the bf16 wire AND at hidden 7168 on the fp8/int8
+#: wire — the configuration the reference actually runs its headline
+#: at (SURVEY §6); a hidden-7168 *bf16* wire at world 8 would exceed
+#: the cap, which is exactly the class of refusal this sweep makes
+#: static. moe_reduce_rs: the bench shape (T=2048, topk=2, I=4096,
+#: H=4096, docs/perf.md) at the default tile config.
+A2A_SWEEP = ((128, 4096, 2), (128, 7168, 1))
+MOE_RS_SWEEP = ((2048, 2, 4096, 4096),)
+
 
 def _generator_anchor(op: str) -> tuple:
-    """(file, line) of the config generator that emits candidates for
-    ``op`` — the code a ``vmem.over_budget`` finding asks you to
-    change (a pass-wide anchor would let one suppression pragma mute
-    the whole finding class)."""
+    """(file, line) of the config generator (or context/config class)
+    that emits candidates for ``op`` — the code a ``vmem.over_budget``
+    finding asks you to change (a pass-wide anchor would let one
+    suppression pragma mute the whole finding class)."""
     import inspect
-    from triton_dist_tpu.ops import allgather_gemm, gemm_reduce_scatter
+    from triton_dist_tpu.ops import (all_to_all, allgather_gemm,
+                                     gemm_reduce_scatter,
+                                     moe_reduce_rs)
     gen = {"ag_gemm": allgather_gemm.ag_gemm_configs,
            "ag_swiglu": allgather_gemm.ag_swiglu_configs,
            "gemm_rs": gemm_reduce_scatter.gemm_rs_configs,
-           "gemm_ar": gemm_reduce_scatter.gemm_rs_configs}.get(op)
+           "gemm_ar": gemm_reduce_scatter.gemm_rs_configs,
+           "all_to_all": all_to_all.AllToAllContext,
+           "moe_reduce_rs": moe_reduce_rs.MoEReduceRSContext}.get(op)
     if gen is None:
         return None, None
     try:
@@ -113,4 +129,35 @@ def sweep_candidate_tables(worlds=range(1, 9)) -> list:
                                   world=world)
                 if f:
                     findings.append(f)
+    return findings
+
+
+def sweep_comm_buffers(worlds=range(1, 9), a2a_shapes=None,
+                       moe_shapes=None) -> list:
+    """Findings for comm-kernel buffer footprints beyond the fused
+    GEMM family (ISSUE 12 satellite): the all-to-all's whole-in-VMEM
+    send/recv slabs (per-(slab, chunk) semaphore arrays are not VMEM)
+    and the fused MoE-RS scratch, at bench shapes for worlds 1..8.
+    Anchored at each op's own config site (``AllToAllContext`` /
+    ``MoEReduceRSContext``) so one pragma cannot mute the class."""
+    from triton_dist_tpu.ops.common import DEFAULT_VMEM_BUDGET
+    findings = []
+    for world in worlds:
+        for capacity, h, item in (a2a_shapes or A2A_SWEEP):
+            f = vet_candidate("all_to_all",
+                              {"capacity": capacity, "h": h},
+                              rows=0, itemsize=item, world=world)
+            if f:
+                findings.append(f)
+        for t, topk, inter, hid in (moe_shapes or MOE_RS_SWEEP):
+            if t % world:
+                continue
+            f = vet_candidate(
+                "moe_reduce_rs",
+                {"h": hid, "i_loc": max(inter // world, 1),
+                 "block_m": 128, "block_h": 512,
+                 "vmem_budget": DEFAULT_VMEM_BUDGET},
+                rows=t // world, itemsize=2, world=world)
+            if f:
+                findings.append(f)
     return findings

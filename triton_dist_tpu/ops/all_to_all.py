@@ -103,6 +103,44 @@ def create_all_to_all_context(mesh: Mesh | None = None, axis: str = "ep",
                            chunk_rows=chunk_rows, interpret=interpret)
 
 
+# ---------------------------------------------------------------------------
+# Schedule helpers — exposed for symbolic execution (the a2a-protocol
+# model checker, analysis/a2a_model.py, executes THESE with concrete
+# (rank, position) values, exactly as the ring checker executes
+# ``ring_chunk_schedule``). The kernel calls the same functions with
+# traced values, so checker and kernel cannot drift apart.
+# ---------------------------------------------------------------------------
+
+def a2a_send_peer(me, i, world: int):
+    """Peer targeted at send position ``i`` (1..world-1): rank-rotated
+    right so no two senders hammer one receiver in lockstep (the
+    reference staggers per-peer putmem the same way)."""
+    return lax.rem(me + i, world)
+
+
+def a2a_wait_src(me, i, world: int):
+    """Source waited on at wait position ``i`` (1..world-1): the
+    left-rotation mirror of :func:`a2a_send_peer` — rank me waits
+    first on the peer that targeted it first."""
+    return lax.rem(me - i + world, world)
+
+
+def a2a_live_chunks(count, chunk: int):
+    """Chunks actually transmitted for a slab with ``count`` live rows
+    (``cdiv``; trailing dead rows of a slab never ride the wire)."""
+    return lax.div(count + (chunk - 1), chunk)
+
+
+def a2a_footprint(world: int, capacity: int, h: int,
+                  itemsize: int = 2) -> int:
+    """Declared VMEM bytes of one ``fast_all_to_all`` dispatch: the
+    (world, capacity, H) send slab input + same-shape recv output both
+    live whole in VMEM (counts are SMEM; the per-(slab, chunk) DMA
+    semaphore arrays are not VMEM). Consumed by the static
+    ``vmem-budget`` sweep (analysis/vmem.py)."""
+    return 2 * world * capacity * h * itemsize
+
+
 def _xla_a2a(mesh: Mesh, axis: str, arr: jax.Array) -> jax.Array:
     """Slab-transposing XLA all-to-all on the leading dim — the one
     sideband exchange pattern (counts, scales, expert ids) written once
@@ -148,8 +186,8 @@ def _a2a_kernel(send_counts_ref, recv_counts_ref, send_ref, recv_ref,
             p, send_sem.at[p, c], recv_sem.at[me, c], axis=axis)
 
     def send_to(i, _):
-        p = lax.rem(me + i, world)
-        live = cdiv_dyn(send_counts_ref[p], chunk)
+        p = a2a_send_peer(me, i, world)
+        live = a2a_live_chunks(send_counts_ref[p], chunk)
 
         def one(c, _):
             @pl.when(c < live)
@@ -159,14 +197,11 @@ def _a2a_kernel(send_counts_ref, recv_counts_ref, send_ref, recv_ref,
         lax.fori_loop(0, n_chunks, one, None)
         return _
 
-    def cdiv_dyn(a, b):
-        return lax.div(a + (b - 1), b)
-
     lax.fori_loop(1, world, send_to, None)
 
     def wait_from(i, _):
-        j = lax.rem(me - i + world, world)
-        live = cdiv_dyn(recv_counts_ref[j], chunk)
+        j = a2a_wait_src(me, i, world)
+        live = a2a_live_chunks(recv_counts_ref[j], chunk)
 
         def one(c, _):
             @pl.when(c < live)
@@ -184,8 +219,8 @@ def _a2a_kernel(send_counts_ref, recv_counts_ref, send_ref, recv_ref,
     lax.fori_loop(1, world, wait_from, None)
 
     def drain(i, _):
-        p = lax.rem(me + i, world)
-        live = cdiv_dyn(send_counts_ref[p], chunk)
+        p = a2a_send_peer(me, i, world)
+        live = a2a_live_chunks(send_counts_ref[p], chunk)
 
         def one(c, _):
             @pl.when(c < live)

@@ -200,6 +200,21 @@ def _merge(a, l, m):
     return num / jnp.maximum(den, 1e-20)[..., None]
 
 
+def combine_peer(me, p, world: int):
+    """Peer targeted at combine send position ``p`` (1..world-1).
+    Exposed for symbolic execution — the flash-decode-protocol model
+    checker (analysis/flash_model.py) executes this with concrete
+    ranks; ``_exchange_and_merge`` calls it with traced values so the
+    checker and the kernel cannot drift apart."""
+    return lax.rem(me + p, world)
+
+
+def combine_src(me, p, world: int):
+    """Source waited on at combine wait position ``p`` (1..world-1) —
+    the left-rotation mirror of :func:`combine_peer`."""
+    return lax.rem(me - p + world, world)
+
+
 def _exchange_and_merge(abuf, lbuf, mbuf, send_sem, recv_sem, o_ref, *,
                         axis: str, world: int):
     """Full-mesh push of this rank's (a, l, m) partial into every peer's
@@ -212,7 +227,7 @@ def _exchange_and_merge(abuf, lbuf, mbuf, send_sem, recv_sem, o_ref, *,
         dl.barrier_all(axis)
 
         def copies(p):
-            peer = lax.rem(me + p, world)
+            peer = combine_peer(me, p, world)
             return [dl.remote_copy(ref.at[me], ref.at[me], peer,
                                    send_sem.at[peer, i], recv_sem.at[me, i],
                                    axis=axis)
@@ -225,7 +240,7 @@ def _exchange_and_merge(abuf, lbuf, mbuf, send_sem, recv_sem, o_ref, *,
         lax.fori_loop(1, world, send, None)
 
         def wait(p, _):
-            src = lax.rem(me - p + world, world)
+            src = combine_src(me, p, world)
             for i, ref in enumerate((abuf, lbuf, mbuf)):
                 dl.remote_copy(ref.at[src], ref.at[src], me,
                                send_sem.at[src, i], recv_sem.at[src, i],

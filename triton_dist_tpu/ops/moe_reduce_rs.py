@@ -227,6 +227,37 @@ def _moe_rs_fused_kernel(act_hbm, w_hbm, sel_hbm, te_ref, o_hbm, send_hbm,
     lax.fori_loop(0, world - 1, drain, None)
 
 
+def moe_rs_fused_footprint(m_blk: int, i_loc: int, h_blk: int,
+                           rows: int, itemsize: int) -> int:
+    """Declared VMEM bytes of the fused kernel's scratch at one tile
+    config: double-buffered (m_blk, I_loc) pair tiles + (I_loc, h_blk)
+    down-proj panels, f32 selection tiles and accumulator, and the
+    travelling-partial / output stages. This is the exact expression
+    the kernel entry clamps ``h_blk`` against and the static
+    ``vmem-budget`` sweep (analysis/vmem.py) vets — one formula, two
+    consumers, so they cannot drift."""
+    return ((2 * m_blk * i_loc + 2 * i_loc * h_blk) * itemsize
+            + 4 * (2 * rows * m_blk + rows * h_blk)
+            + 2 * rows * h_blk * itemsize)
+
+
+def moe_rs_resolve_h_blk(h: int, block_h: int, m_blk: int, i_loc: int,
+                         rows: int, itemsize: int, budget: int) -> int:
+    """The h-block the fused kernel will actually run: ``block_h``
+    halved until it divides ``h``, then halved (floor 128) until the
+    declared footprint fits ``budget`` — mirrored by the static sweep
+    so the vet prices the kernel's real tiling, not the requested
+    one."""
+    h_blk = block_h
+    while h_blk > h or h % h_blk:
+        h_blk //= 2
+    h_blk = max(h_blk, 1)
+    while h_blk > 128 and moe_rs_fused_footprint(
+            m_blk, i_loc, h_blk, rows, itemsize) > budget:
+        h_blk //= 2
+    return h_blk
+
+
 @dataclasses.dataclass
 class MoEReduceRSContext:
     """Analog of ``create_moe_rs_context`` (moe_reduce_rs.py): mesh/axis +
@@ -381,16 +412,9 @@ def _moe_rs_fused(act, w_down, expert_ids, weights, ctx):
     def body(a_shard, wd, ids, wts):
         i_loc = a_shard.shape[1]
         h = wd.shape[-1]
-        h_blk = ctx.block_h
-        while h_blk > h or h % h_blk:
-            h_blk //= 2
-        h_blk = max(h_blk, 1)
         item = a_shard.dtype.itemsize
-        while h_blk > 128 and (
-                (2 * m_blk * i_loc + 2 * i_loc * h_blk) * item
-                + 4 * (2 * rows * m_blk + rows * h_blk)
-                + 2 * rows * h_blk * item) > ctx.vmem_budget:
-            h_blk //= 2
+        h_blk = moe_rs_resolve_h_blk(h, ctx.block_h, m_blk, i_loc,
+                                     rows, item, ctx.vmem_budget)
 
         # Per token-chunk alignment (identical on every device: ids and
         # weights are replicated; only the I-slice of act differs).

@@ -55,12 +55,23 @@ def create_p2p_context(mesh: Mesh | None = None, axis: str = "pp",
     return P2PContext(mesh=mesh, axis=axis, interpret=interpret)
 
 
+def shift_partners(me, delta: int, world: int):
+    """(dst, src) of one pipeline hop: push to ``me+delta``, receive
+    from ``me-delta``. Exposed for symbolic execution — the
+    p2p-protocol model checker (analysis/p2p_model.py) executes this
+    with concrete ranks, exactly as the ring checker executes
+    ``ring_chunk_schedule``; the kernel calls it with traced values so
+    the two cannot drift apart."""
+    span = (abs(delta) // world + 1) * world    # keep lax.rem args >= 0
+    return (lax.rem(me + delta + span, world),
+            lax.rem(me - delta + span, world))
+
+
 def _shift_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis: str,
                   world: int, delta: int):
     """Push local buffer to rank (me+delta); receive from (me-delta)."""
     me = lax.axis_index(axis)
-    dst = lax.rem(me + delta + world, world)
-    src = lax.rem(me - delta + world, world)
+    dst, src = shift_partners(me, delta, world)
     dl.barrier_all(axis)
     dl.remote_copy(x_ref.at[:], o_ref.at[:], dst, send_sem, recv_sem,
                    axis=axis).start()

@@ -15,6 +15,7 @@ did; prefer the pass API (findings with anchors) in new code.
 from __future__ import annotations
 
 import sys
+import warnings
 
 from triton_dist_tpu.analysis.lint_fallback import (  # noqa: F401
     DELEGATES, EXCLUDED_MODULES, collect_findings)
@@ -22,8 +23,18 @@ from triton_dist_tpu.analysis.lint_fallback import (  # noqa: F401
 __all__ = ["DELEGATES", "EXCLUDED_MODULES", "missing_fallbacks", "main"]
 
 
+def _deprecation():
+    warnings.warn(
+        "tools.fallback_lint is a deprecation shim: the check lives "
+        "in the static-analysis framework — run `tdt-check --pass "
+        "fallback-coverage` (python -m triton_dist_tpu.tools."
+        "tdt_check) for file:line-anchored findings",
+        DeprecationWarning, stacklevel=3)
+
+
 def missing_fallbacks() -> list:
     """Entries violating the contract (empty list == clean)."""
+    _deprecation()
     return [f.message for f in collect_findings()]
 
 

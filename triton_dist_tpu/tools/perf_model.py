@@ -398,6 +398,23 @@ def declared_footprint(op: str, cfg: dict, *, rows: int,
             return _hbm_nb_footprint(bm, bn, k_loc, itemsize)
         return (2 * bm * bk + 2 * bk * n) * itemsize \
             + bm * n * (4 + 3 * itemsize)
+    if op == "all_to_all":
+        # send slab input + recv output, both whole in VMEM — the
+        # op's own formula (ops/all_to_all.py a2a_footprint).
+        from triton_dist_tpu.ops.all_to_all import a2a_footprint
+        return a2a_footprint(world, cfg["capacity"], cfg["h"], itemsize)
+    if op == "moe_reduce_rs":
+        # The fused kernel's scratch at the h-block it will actually
+        # run: delegate BOTH the clamp and the formula to the kernel's
+        # own helpers so the vet prices the real tiling.
+        from triton_dist_tpu.ops.moe_reduce_rs import (
+            moe_rs_fused_footprint, moe_rs_resolve_h_blk)
+        h_blk = moe_rs_resolve_h_blk(
+            cfg["h"], cfg.get("block_h", 512), cfg.get("block_m", 128),
+            cfg["i_loc"], rows, itemsize, cfg["vmem_budget"])
+        return moe_rs_fused_footprint(
+            cfg.get("block_m", 128), cfg["i_loc"], h_blk, rows,
+            itemsize)
     raise ValueError(f"no footprint model for op {op!r}")
 
 

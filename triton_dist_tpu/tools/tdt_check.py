@@ -6,7 +6,16 @@ Usage::
     python -m triton_dist_tpu.tools.tdt_check --list
     python -m triton_dist_tpu.tools.tdt_check --json
     python -m triton_dist_tpu.tools.tdt_check --pass ring-protocol \
-        --pass vmem-budget
+        --pass a2a-protocol,p2p-protocol
+    python -m triton_dist_tpu.tools.tdt_check --changed   # diff-scoped
+
+``--pass`` repeats and accepts comma-separated lists. ``--changed``
+asks git for the working-tree diff (staged + unstaged + untracked)
+and runs only the passes whose declared watch files changed
+(``analysis.Pass.watches``) — the fast pre-commit loop; passes with
+no declared watches always run. ``--md-summary PATH`` appends a
+markdown findings table (the GitHub Actions step-summary renderer —
+CI passes ``$GITHUB_STEP_SUMMARY``).
 
 Exits nonzero when any error-severity finding survives suppression
 (``# tdt: ignore[...]`` pragmas, docs/analysis.md). The quick tier
@@ -19,12 +28,14 @@ queue.
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 
 from triton_dist_tpu.analysis import (
-    PASSES, exit_code, render_human, render_json, run_passes)
+    PASSES, exit_code, render_human, render_json, repo_root,
+    run_passes, select_passes_for)
 
-__all__ = ["main", "preflight"]
+__all__ = ["main", "preflight", "changed_files", "render_md"]
 
 
 def preflight(names=None, out=None) -> int:
@@ -39,21 +50,78 @@ def preflight(names=None, out=None) -> int:
     return exit_code(findings)
 
 
+def changed_files(root=None) -> list:
+    """Repo-relative paths the working tree changed vs HEAD: staged,
+    unstaged, and untracked (one ``git status --porcelain`` walk;
+    renames contribute both sides)."""
+    root = str(root or repo_root())
+    out = subprocess.run(
+        ["git", "status", "--porcelain"], cwd=root,
+        capture_output=True, text=True, check=True).stdout
+    paths = []
+    for line in out.splitlines():
+        if len(line) < 4:
+            continue
+        body = line[3:]
+        for part in body.split(" -> "):
+            part = part.strip().strip('"')
+            if part:
+                paths.append(part)
+    return paths
+
+
+def render_md(findings, n_passes: int | None = None) -> str:
+    """Markdown findings table for CI step summaries."""
+    n_err = sum(1 for f in findings if f.severity == "error")
+    suffix = f" across {n_passes} passes" if n_passes is not None \
+        else ""
+    lines = ["## tdt-check", ""]
+    if not findings:
+        lines.append(f"**OK** — no findings{suffix}")
+    else:
+        lines.append(f"**{n_err} error(s), "
+                     f"{len(findings) - n_err} warning(s)**{suffix}")
+        lines += ["", "| code | severity | anchor | message |",
+                  "|---|---|---|---|"]
+        for f in findings:
+            msg = f.message.replace("|", "\\|")
+            lines.append(f"| `{f.code}` | {f.severity} | "
+                         f"`{f.anchor}` | {msg} |")
+    return "\n".join(lines) + "\n"
+
+
+def _expand_passes(raw) -> list | None:
+    if not raw:
+        return None
+    names = []
+    for item in raw:
+        names.extend(n.strip() for n in item.split(",") if n.strip())
+    return names or None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tdt_check",
-        description="static ring-protocol verifier + repo contract "
+        description="static protocol verifiers + repo contract "
                     "lints (docs/analysis.md)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable findings on stdout")
     ap.add_argument("--list", action="store_true",
                     help="list registered passes and exit")
     ap.add_argument("--pass", dest="passes", action="append",
-                    metavar="NAME",
-                    help="run only this pass (repeatable)")
+                    metavar="NAME[,NAME...]",
+                    help="run only these passes (repeatable and/or "
+                         "comma-separated)")
+    ap.add_argument("--changed", action="store_true",
+                    help="run only passes whose watched files the "
+                         "git working tree changed (fast pre-commit "
+                         "loop)")
     ap.add_argument("--root", default=None,
                     help="repo root (default: derived from the "
                          "installed package)")
+    ap.add_argument("--md-summary", metavar="PATH", default=None,
+                    help="append a markdown findings table to PATH "
+                         "(CI: pass $GITHUB_STEP_SUMMARY)")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -61,12 +129,34 @@ def main(argv=None) -> int:
             print(f"{p.name}: {p.description}")
         return 0
 
-    findings = run_passes(root=args.root, names=args.passes)
+    names = _expand_passes(args.passes)
+    if args.changed:
+        if names is not None:
+            ap.error("--changed and --pass are mutually exclusive")
+        changed = changed_files(args.root)
+        names = select_passes_for(changed)
+        skipped = sorted(set(PASSES) - set(names))
+        # Status prose goes to stderr so `--changed --json > f.json`
+        # stays machine-parseable; an empty selection falls through to
+        # the normal render path (empty findings JSON / summary), it
+        # does not short-circuit the output contract.
+        print(f"tdt-check --changed: {len(changed)} changed file(s) "
+              f"-> running {len(names)}/{len(PASSES)} passes"
+              + (f" (skipped: {', '.join(skipped)})" if skipped
+                 else ""), file=sys.stderr)
+        if not names:
+            print("tdt-check --changed: no watched files changed",
+                  file=sys.stderr)
+
+    findings = run_passes(root=args.root, names=names)
+    n_passes = len(PASSES) if names is None else len(names)
     if args.json:
         print(render_json(findings))
     else:
-        print(render_human(
-            findings, n_passes=len(args.passes or PASSES)))
+        print(render_human(findings, n_passes=n_passes))
+    if args.md_summary:
+        with open(args.md_summary, "a", encoding="utf-8") as f:
+            f.write(render_md(findings, n_passes=n_passes))
     return exit_code(findings)
 
 
