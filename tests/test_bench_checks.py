@@ -339,6 +339,32 @@ def test_mega_serving_wellformed_gate():
     assert check_mega_serving_wellformed(gone)
 
 
+def test_spec_serving_wellformed_gate():
+    """ISSUE 13 satellite: once the serving_spec part ran, its
+    serving_spec_vs_plain ratio AND a [0, 1] accept rate must exist —
+    a run silently dropping either would let a drafter regression
+    hide behind a stale floor pass; a run that never measured
+    serving_spec passes untouched."""
+    from triton_dist_tpu.tools.bench_ops import (
+        check_spec_serving_wellformed)
+    assert check_spec_serving_wellformed({}) == []      # part didn't run
+    ok = {"serving_spec_tokens_per_s": 100.0,
+          "serving_spec_vs_plain": 1.62,
+          "serving_spec_accept_rate": 0.44}
+    assert check_spec_serving_wellformed(ok) == []
+    for bad_val in (None, "fast", True, 0.0, -1.0):
+        bad = dict(ok, serving_spec_vs_plain=bad_val)
+        fails = check_spec_serving_wellformed(bad)
+        assert fails and "serving_spec_vs_plain" in fails[0], bad_val
+    for bad_rate in (None, "hi", True, -0.1, 1.5):
+        bad = dict(ok, serving_spec_accept_rate=bad_rate)
+        fails = check_spec_serving_wellformed(bad)
+        assert fails and "serving_spec_accept_rate" in fails[0], \
+            bad_rate
+    gone = {"serving_spec_tokens_per_s": 100.0}
+    assert len(check_spec_serving_wellformed(gone)) == 2
+
+
 def test_bench_parts_typo_fails_before_checkpoint(tmp_path, monkeypatch):
     """A typo'd TDT_BENCH_PARTS must SystemExit before the checkpoint
     clear — prior evidence survives (review r5a-2)."""

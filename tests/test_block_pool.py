@@ -72,17 +72,21 @@ def _check_invariants(m, write_pos):
 
 def test_block_pool_randomized_interleavings(mesh8):
     """Randomized admit(fork-shared prefixes)/decode/retire
-    interleavings never double-free or leak (the satellite's property
-    test). Prompts draw from a few shared families so admissions fork
-    off cached prefixes; the pool is tight enough that the free stacks
-    run dry and LRU eviction engages."""
+    interleavings — plus ISSUE 13's speculative ops: k-token COMMITS
+    (multi-block ensure_position growth in one call) and rejected-tail
+    ROLLBACKS (rollback_position restoring consumed commitments) —
+    never double-free or leak (the satellite's property test). Prompts
+    draw from a few shared families so admissions fork off cached
+    prefixes; the pool is tight enough that the free stacks run dry
+    and LRU eviction engages."""
     m = _mgr(mesh8, batch=4, page=4, ppsd=4, slots=10)
     m.stream_setup(prefix_cache=True)
     rng = np.random.default_rng(11)
     families = [list(rng.integers(1, 64, size=16)) for _ in range(3)]
     live: dict = {}          # row -> {pos, end}
     for step in range(600):
-        op = rng.choice(["admit", "decode", "retire"])
+        op = rng.choice(["admit", "decode", "retire", "spec_commit",
+                         "spec_rollback"])
         free = [b for b in range(m.batch) if b not in live]
         if op == "admit" and free:
             b = int(rng.choice(free))
@@ -103,6 +107,30 @@ def test_block_pool_randomized_interleavings(mesh8):
             if st["pos"] < st["end"]:
                 m.ensure_position(b, st["pos"])
                 st["pos"] += 1
+        elif op == "spec_commit" and live:
+            # A speculative burst: ensure positions for up to k drafts
+            # in ONE call (multi-block growth), accept a prefix, roll
+            # the rejected tail back — commitment bookkeeping must
+            # survive any interleaving with admissions/retirements.
+            b = int(rng.choice(list(live)))
+            st = live[b]
+            room = st["end"] - st["pos"]
+            if room <= 0:
+                continue
+            k = int(rng.integers(1, min(room, 9) + 1))
+            m.ensure_position(b, st["pos"] + k - 1)
+            accepted = int(rng.integers(0, k + 1))
+            if accepted < k:
+                m.rollback_position(b, st["pos"] + accepted - 1
+                                    if st["pos"] + accepted > 0 else 0)
+            st["pos"] += accepted
+        elif op == "spec_rollback" and live:
+            # Degenerate rewind: everything past the current committed
+            # position rolls back (a fully-rejected burst).
+            b = int(rng.choice(list(live)))
+            st = live[b]
+            if st["pos"] > 0:
+                m.rollback_position(b, st["pos"] - 1)
         elif op == "retire" and live:
             b = int(rng.choice(list(live)))
             m.release_row(b)
@@ -213,6 +241,48 @@ def test_commitment_blocks_starvation(mesh8):
     assert int(m._committed[0]) == 0
     m.release_row(0)
     assert m.can_admit(4, 4)
+
+
+def test_spec_multiblock_growth_and_rollback_restores_commitment(mesh8):
+    """ISSUE 13: one ensure_position call may cross several page
+    boundaries (a k-token burst), consuming the row's commitment per
+    allocated block; rolling the rejected tail back frees the blocks
+    AND restores exactly the consumed commitments — so a later
+    admission still cannot starve the row's remaining budget, and a
+    rollback can never mint commitment growth never consumed."""
+    m = _mgr(mesh8, batch=2, page=4, ppsd=8, slots=8)
+    m.stream_setup(prefix_cache=False)
+    m.admit_row(0, [1, 2, 3, 4], gen_budget=17)   # 1 block + 4 committed
+    assert int(m._committed[0]) == 4
+    # Burst crosses 3 page boundaries at once: positions 4..15.
+    assert m.ensure_position(0, 15)
+    assert int(m._row_blocks[0]) == 4
+    assert int(m._committed[0]) == 1              # 3 consumed
+    _check_invariants(m, [16])
+    # Reject back to position 6 (keep blocks 0..1): 2 blocks return,
+    # their commitments restored.
+    assert m.rollback_position(0, 6)
+    assert int(m._row_blocks[0]) == 2
+    assert int(m._committed[0]) == 3
+    assert (m._table[:, 0, 2:] == m._sentinel[:, None]).all()
+    _check_invariants(m, [7])
+    # No-op rollback: nothing past the kept position.
+    assert not m.rollback_position(0, 7)
+    # The row can still grow to its full budget after the rewind.
+    for pos in range(7, 20):
+        m.ensure_position(0, pos)
+    assert int(m._committed[0]) == 0
+    # Growth PAST the commitment (no budget left) must not let a
+    # rollback mint new commitment: grow one uncommitted block, roll
+    # it back, committed stays 0.
+    m.ensure_position(0, 20)
+    assert int(m._committed[0]) == 0
+    m.rollback_position(0, 19)
+    assert int(m._committed[0]) == 0
+    m.release_row(0)
+    a = m.block_audit()
+    assert a["active"] == 0 and a["committed"] == 0
+    assert a["free"] + a["evictable"] == a["total"]
 
 
 def test_fits_pool_and_never_admissible(mesh8):

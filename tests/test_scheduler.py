@@ -998,6 +998,154 @@ def test_scheduler_auto_decode_path_serves(tiny):
         obs.disable()
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 13: speculative decoding in the shared batch.
+# ---------------------------------------------------------------------------
+
+def _spec_cfg(k=4, **kw):
+    from triton_dist_tpu.models.spec import SpecConfig
+    return SpecConfig(k=k, **kw)
+
+
+def test_scheduler_spec_matches_plain_ragged_overbatch(tiny):
+    """Tentpole acceptance (dense family): spec-on greedy outputs are
+    bit-identical to spec-off across ragged mixed-length prompts AND
+    mid-decode admission/retirement — 7 prompts through a 2-row
+    window, so rows retire and re-admit while others burst; the
+    repetitive prompt exercises real multi-token accepts."""
+    model, params = tiny
+    prompts = [[1, 2, 3], [9, 8], [4, 5, 6, 7], [11], [23, 29],
+               [7, 7, 7], [5, 6, 5, 6, 5, 6, 5]]
+    outs = {}
+    for tag, spec in (("on", _spec_cfg()), ("off", None)):
+        eng = Engine(model, batch=2, max_seq=64, prefill_mode="xla_ar",
+                     decode_mode="gemm_ar", spec=spec)
+        sched = Scheduler(eng, params).start()
+        try:
+            reqs = [sched.submit(p, 9) for p in prompts]
+            outs[tag] = [r.result(timeout=180) for r in reqs]
+        finally:
+            sched.stop()
+    assert outs["on"] == outs["off"]
+    for p, row in zip(prompts, outs["on"]):
+        assert row == _solo(model, params, p, 9), p
+
+
+def test_scheduler_spec_paged_prefix_matches_plain(paged_tiny):
+    """Tentpole acceptance (paged family): spec bursts against the
+    paged pool's table lanes — prefix-cache WARM hits included — are
+    bit-identical to spec-off and to the solo golden; the autouse leak
+    audit re-checks both pools after teardown (multi-token commits +
+    rejected-tail rollbacks must strand nothing)."""
+    model, params = paged_tiny
+    pre = list(range(1, 9))                 # 8 tokens = 2 full pages
+    prompts = [pre + [20],                  # cold (indexes the preamble)
+               pre + [30, 31],              # warm full-prefix hit, ragged
+               pre[:4] + [40, 41],          # partial overlap
+               [50, 51, 52],                # no overlap
+               pre + [60]]                  # another warm hit
+    outs = {}
+    hits = {}
+    for tag, spec in (("on", _spec_cfg()), ("off", None)):
+        eng = Engine(model, batch=2, max_seq=64, prefill_mode="sp",
+                     decode_mode="sp", paged=True, page_size=4,
+                     prefix_cache=True, spec=spec)
+        _PAGED_ENGINES.append(eng)
+        sched = Scheduler(eng, params).start()
+        try:
+            reqs = [sched.submit(p, 6) for p in prompts]
+            outs[tag] = [r.result(timeout=180) for r in reqs]
+        finally:
+            sched.stop()
+        hits[tag] = eng.kv.prefix.stats()["hit_blocks"]
+    assert outs["on"] == outs["off"]
+    assert hits["on"] >= 4, hits            # the warm hits really hit
+    for p, row in zip(prompts, outs["on"]):
+        assert row == _solo_paged_golden(model, params, p, 6), p
+
+
+def test_scheduler_spec_oversubscribed_pool(paged_tiny):
+    """Spec bursts stream an OVERSUBSCRIBED pool: multi-block commits
+    and rejected-tail rollbacks against a pool too small for every
+    row, block-granular admission waits, correct results (the leak
+    audit re-checks the pool after teardown)."""
+    model, params = paged_tiny
+    eng = Engine(model, batch=3, max_seq=64, prefill_mode="sp",
+                 decode_mode="sp", paged=True, page_size=4,
+                 kv_slots_per_dev=5, spec=_spec_cfg())
+    _PAGED_ENGINES.append(eng)
+    sched = Scheduler(eng, params).start()
+    try:
+        prompts = [[2 * i + 1, 2 * i + 2] for i in range(5)]
+        reqs = [sched.submit(p, 6) for p in prompts]
+        for p, r in zip(prompts, reqs):
+            assert r.result(timeout=180) == _solo_paged_golden(
+                model, params, p, 6), p
+    finally:
+        sched.stop()
+
+
+def test_scheduler_spec_stop_tokens_retire_mid_burst(tiny):
+    """A stop token landing MID-burst retires the row at that token
+    and discards the burst's tail — the per-request stop contract is
+    unchanged by variable tokens-per-step."""
+    model, params = tiny
+    probe = _solo(*tiny, [5, 6, 5, 6, 5, 6, 5], 9)
+    stop = (probe[3],)          # 4th generated token
+    prompts = [[5, 6, 5, 6, 5, 6, 5], [1, 2, 3], [9, 8]]
+    outs = {}
+    for tag, spec in (("on", _spec_cfg()), ("off", None)):
+        eng = Engine(model, batch=2, max_seq=64, prefill_mode="xla_ar",
+                     decode_mode="gemm_ar", spec=spec)
+        sched = Scheduler(eng, params).start()
+        try:
+            reqs = [sched.submit(p, 9, stop_tokens=stop)
+                    for p in prompts]
+            outs[tag] = [r.result(timeout=180) for r in reqs]
+        finally:
+            sched.stop()
+    assert outs["on"] == outs["off"]
+    for p, row in zip(prompts, outs["on"]):
+        assert row == _solo(model, params, p, 9, stop=stop), p
+
+
+def test_spec_metrics_and_waterfall_through_server(tiny):
+    """ISSUE 13 acceptance: serving.spec_accept_rate /
+    serving.spec_tokens_per_step are visible through
+    {"cmd": "metrics"}, the request waterfalls carry draft/verify
+    segments through "timing" and request_stats, top.py renders the
+    accept-rate gauge, and report.py's serving section carries the
+    spec rows."""
+    model, params = tiny
+    eng = Engine(model, batch=2, max_seq=64, prefill_mode="xla_ar",
+                 decode_mode="gemm_ar", spec=_spec_cfg())
+    srv = ModelServer(eng, params, port=0).start()
+    try:
+        c = ChatClient(srv.host, srv.port, timeout=180)
+        c.generate_ids([[5, 6, 5, 6, 5, 6, 5]], gen_len=9)  # warm
+        r = c.generate_ids([[5, 6, 5, 6, 5, 6, 5]], gen_len=9)
+        m = c.request({"cmd": "metrics"})["metrics"]
+        stats = c.request({"cmd": "request_stats", "last": 1})
+        c.close()
+        assert m["counters"]["serving.spec_steps"] >= 1
+        assert 0.0 <= m["gauges"]["serving.spec_accept_rate"] <= 1.0
+        assert m["gauges"]["serving.spec_tokens_per_step"] >= 1.0
+        assert "engine.spec_verify_ms" in m["histograms"]
+        (t,) = r["timing"]
+        assert t["spec"]["verify_ms"] >= 0.0
+        assert t["spec"]["draft_ms"] >= 0.0
+        assert stats["requests"][0]["spec"]["verify_ms"] >= 0.0
+        # segments still partition exactly (spec is sub-attribution)
+        assert sum(t["segments"].values()) == pytest.approx(
+            t["total_ms"], abs=0.01)
+        from triton_dist_tpu.tools.report import render_telemetry
+        from triton_dist_tpu.tools.top import render
+        assert "serving.spec_accept_rate" in render_telemetry(m)
+        assert "accept" in render(m)
+    finally:
+        srv.stop()
+
+
 def test_metrics_catalog_wellformed(tiny, monkeypatch):
     """CI satellite: every SLO/perfwatch metric in the documented
     catalog appears in a live {"cmd": "metrics"} snapshot after real

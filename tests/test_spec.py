@@ -1,0 +1,244 @@
+"""Speculative decoding units (models/spec.py + Engine spec surface,
+ISSUE 13).
+
+The scheduler-level parity matrix lives in tests/test_scheduler.py;
+this file pins the pieces in isolation: the n-gram drafter's lookup
+semantics, the greedy acceptance rule, SpecConfig validation (greedy-
+only, drafter requirements, the TDT_SPEC kill switch and TDT_SPEC_K
+override), the serve() refusal (no silent ignore), the small-model
+drafter's lockstep correctness (a target drafting for ITSELF must
+accept everything — any rejection is a draft-cache desync), and the
+chunked-prefill admission handing the drafter the right history.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.models import DenseLLM, Engine, ModelConfig
+from triton_dist_tpu.models.spec import (NGramDrafter, SpecConfig,
+                                         accept_greedy,
+                                         draft_model_from_preset)
+from triton_dist_tpu.serving import Scheduler
+
+
+@pytest.fixture()
+def tiny(mesh8, key):
+    cfg = ModelConfig(hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=8,
+                      num_key_value_heads=8, head_dim=4, vocab_size=64,
+                      max_position_embeddings=64, dtype=jnp.float32)
+    model = DenseLLM(cfg, mesh=mesh8, axis="tp", impl="xla")
+    return model, model.init(key)
+
+
+def _solo(model, params, prompt, gen_len):
+    eng = Engine(model, batch=1, max_seq=64, prefill_mode="xla_ar",
+                 decode_mode="gemm_ar")
+    out = np.asarray(eng.serve(params, jnp.asarray([prompt], jnp.int32),
+                               gen_len))[0].tolist()
+    return out[len(prompt):]
+
+
+# ---------------------------------------------------------------------------
+# Pure logic: acceptance + the n-gram drafter.
+# ---------------------------------------------------------------------------
+
+def test_accept_greedy_rule():
+    # full accept: every draft matches -> k accepted, k+1 emitted
+    a, em = accept_greedy([3, 4, 5], np.asarray([3, 4, 5, 9]))
+    assert (a, em) == (3, [3, 4, 5, 9])
+    # first mismatch stops: the target's own token is the bonus
+    a, em = accept_greedy([3, 7, 5], np.asarray([3, 4, 5, 9]))
+    assert (a, em) == (1, [3, 4])
+    # zero accept: exactly one token (the plain-step equivalent)
+    a, em = accept_greedy([7], np.asarray([3, 9]))
+    assert (a, em) == (0, [3])
+    # empty draft: pure bonus
+    a, em = accept_greedy([], np.asarray([3]))
+    assert (a, em) == (0, [3])
+
+
+def test_ngram_drafter_lookup_semantics():
+    d = NGramDrafter(4, ngram_n=3)
+    d.start_row(0, [1, 2, 3, 4, 1, 2, 3])
+    # trailing [1,2,3] occurred at 0 with continuation [4,1,2,3]
+    assert d.draft_batch([0], {0: 4}) == {0: [4, 1, 2, 3]}
+    # kmax clamps the proposal
+    assert d.draft_batch([0], {0: 2}) == {0: [4, 1]}
+    assert d.draft_batch([0], {0: 0}) == {0: []}
+    # most recent occurrence wins
+    d.observe(0, [9, 1, 2, 3])
+    assert d.draft_batch([0], {0: 3}) == {0: [9, 1, 2]}
+    # falls back through shorter n-grams; no match -> empty
+    d2 = NGramDrafter(4, ngram_n=3)
+    d2.start_row(1, [5, 6, 7])
+    assert d2.draft_batch([1], {1: 4}) == {1: []}
+    d2.observe(1, [6])          # trailing [6]: seen at 1 -> cont [7]
+    assert d2.draft_batch([1], {1: 4}) == {1: [7, 6]}
+    # retirement clears state; a fresh admission starts clean
+    d2.retire_row(1)
+    d2.start_row(1, [8, 9])
+    assert d2.draft_batch([1], {1: 4}) == {1: []}
+
+
+# ---------------------------------------------------------------------------
+# SpecConfig validation + env knobs.
+# ---------------------------------------------------------------------------
+
+def test_spec_config_validation(monkeypatch):
+    assert SpecConfig().k == 4                  # DEFAULT_K
+    monkeypatch.setenv("TDT_SPEC_K", "7")
+    assert SpecConfig().k == 7                  # env override
+    assert SpecConfig(k=2).k == 2               # explicit wins
+    with pytest.raises(ValueError, match="k must be"):
+        SpecConfig(k=0)
+    with pytest.raises(ValueError, match="drafter"):
+        SpecConfig(drafter="oracle")
+    with pytest.raises(ValueError, match="draft_model"):
+        SpecConfig(drafter="model")
+    with pytest.raises(ValueError, match="ngram_n"):
+        SpecConfig(ngram_n=0)
+
+
+def test_spec_requires_greedy(tiny):
+    model, _ = tiny
+    with pytest.raises(ValueError, match="greedy"):
+        Engine(model, batch=1, max_seq=64, prefill_mode="xla_ar",
+               decode_mode="gemm_ar", temperature=0.7,
+               spec=SpecConfig())
+
+
+def test_tdt_spec_kill_switch(tiny, monkeypatch):
+    """TDT_SPEC=0 disables speculation process-wide: the engine
+    behaves exactly as spec=None (no spec state, serve() works)."""
+    model, params = tiny
+    monkeypatch.setenv("TDT_SPEC", "0")
+    eng = Engine(model, batch=1, max_seq=64, prefill_mode="xla_ar",
+                 decode_mode="gemm_ar", spec=SpecConfig())
+    assert eng.spec is None
+    sess = eng.stream_session(params)
+    assert sess.spec is None
+    out = np.asarray(eng.serve(params, jnp.asarray([[1, 2]], jnp.int32),
+                               3))
+    assert out.shape == (1, 5)
+
+
+def test_serve_refuses_spec_engine(tiny):
+    """Satellite: serve() must not silently ignore a SpecConfig — it
+    refuses with a ValueError naming the restriction (the stream path
+    is the spec surface); serve_ragged rides the same refusal."""
+    model, params = tiny
+    eng = Engine(model, batch=1, max_seq=64, prefill_mode="xla_ar",
+                 decode_mode="gemm_ar", spec=SpecConfig())
+    with pytest.raises(ValueError, match="stream path"):
+        eng.serve(params, jnp.asarray([[1, 2]], jnp.int32), 4)
+    with pytest.raises(ValueError, match="stream path"):
+        eng.serve_ragged(params, [[1, 2], [3]], 4)
+    # ... while the stream path serves it fine.
+    res = eng.serve_stream(params, [[1, 2, 3]], 4)
+    assert res[0][3:] == _solo(model, params, [1, 2, 3], 4)
+
+
+def test_draft_model_from_preset(mesh8):
+    m = draft_model_from_preset("qwen3-0.6b", mesh=mesh8)
+    assert m.config.hidden_size == 1024
+    with pytest.raises(ValueError, match="unknown preset"):
+        draft_model_from_preset("qwen4-900b", mesh=mesh8)
+
+
+# ---------------------------------------------------------------------------
+# Model drafter: lockstep with the committed stream.
+# ---------------------------------------------------------------------------
+
+def test_model_drafter_self_draft_accepts_everything(tiny):
+    """The target model drafting for ITSELF must reach accept rate 1.0
+    — its drafts ARE the target's argmax, so any rejection means the
+    drafter's KV cache desynced from the committed stream (the
+    catch-up/scratch-rewind machinery is what this pins). Multi-token
+    commits then retire rows mid-schedule like any burst."""
+    from triton_dist_tpu import obs
+    model, params = tiny
+    spec = SpecConfig(k=3, drafter="model", draft_model=model,
+                      draft_params=params)
+    prompts = [[1, 2, 3], [9, 8], [4, 5, 6, 7]]
+    reg = obs.enable(obs.Registry())
+    try:
+        eng = Engine(model, batch=2, max_seq=64, prefill_mode="xla_ar",
+                     decode_mode="gemm_ar", spec=spec)
+        sched = Scheduler(eng, params).start()
+        try:
+            reqs = [sched.submit(p, 7) for p in prompts]
+            got = [r.result(timeout=180) for r in reqs]
+        finally:
+            sched.stop()
+        for p, row in zip(prompts, got):
+            assert row == _solo(model, params, p, 7), p
+        snap = reg.snapshot()
+        assert snap["gauges"]["serving.spec_accept_rate"] == 1.0
+        assert snap["gauges"]["serving.spec_tokens_per_step"] > 1.0
+    finally:
+        obs.disable()
+
+
+def test_model_drafter_distinct_model_stays_bit_identical(tiny, key):
+    """A DIFFERENT (wrong-by-construction) draft model exercises the
+    rejection path: outputs must still be bit-identical to spec-off —
+    a bad drafter can only cost speed, never correctness."""
+    model, params = tiny
+    bad_params = model.init(jax.random.split(key)[0])   # different net
+    spec = SpecConfig(k=3, drafter="model", draft_model=model,
+                      draft_params=bad_params)
+    eng = Engine(model, batch=2, max_seq=64, prefill_mode="xla_ar",
+                 decode_mode="gemm_ar", spec=spec)
+    prompts = [[1, 2, 3], [9, 8], [5, 6, 5, 6, 5]]
+    res = eng.serve_stream(params, prompts, 6)
+    for p, row in zip(prompts, res):
+        assert row[len(p):] == _solo(model, params, p, 6), p
+
+
+def test_spec_sp_nonpaged_family_bit_identical(mesh8, key):
+    """The sp engine family WITHOUT paged pools (seq-sharded
+    contiguous cache) bursts through forward_sp's per-row multi-token
+    scatter + per-position flash-decode branch — bit-identical to
+    spec-off via serve_stream."""
+    from jax.sharding import Mesh
+    devs = [d for d in mesh8.devices.flat]
+    mesh = Mesh(np.array(devs).reshape(1, 8), ("tp", "sp"))
+    cfg = ModelConfig(hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=4,
+                      num_key_value_heads=2, head_dim=16, vocab_size=64,
+                      max_position_embeddings=64, dtype=jnp.float32)
+    model = DenseLLM(cfg, mesh=mesh, axis="tp", sp_axis="sp",
+                     impl="xla", fwd_mode="sp")
+    params = model.init(key)
+    prompts = [[1, 2, 3], [5, 6, 5, 6, 5], [9, 8]]
+    outs = {}
+    for tag, spec in (("on", SpecConfig(k=3)), ("off", None)):
+        eng = Engine(model, batch=2, max_seq=64, prefill_mode="sp",
+                     decode_mode="sp", spec=spec)
+        outs[tag] = eng.serve_stream(params, prompts, 6)
+    assert outs["on"] == outs["off"]
+
+
+def test_spec_with_chunked_prefill_admission(tiny):
+    """Chunked admission (TDT_PREFILL_CHUNK path) + spec: the drafter
+    is seeded at prefill COMPLETION with the full prompt, and outputs
+    stay bit-identical."""
+    model, params = tiny
+    eng = Engine(model, batch=2, max_seq=64, prefill_mode="xla_ar",
+                 decode_mode="gemm_ar", spec=SpecConfig(k=4))
+    sched = Scheduler(eng, params, prefill_chunk=4).start()
+    try:
+        long_p = list(range(1, 15))          # 14 tokens -> 4 chunks
+        short_p = [5, 9]
+        r_long = sched.submit(long_p, 6)
+        r_short = sched.submit(short_p, 6)
+        assert r_long.result(timeout=180) == _solo(model, params,
+                                                   long_p, 6)
+        assert r_short.result(timeout=180) == _solo(model, params,
+                                                    short_p, 6)
+        assert eng._admit_chunk is not None  # the chunked path ran
+    finally:
+        sched.stop()

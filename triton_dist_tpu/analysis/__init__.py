@@ -232,7 +232,13 @@ def _vmem_pass(root):
 @register_pass("metric-catalog",
                "emitted metrics and the docs/observability.md catalog "
                "agree, both directions",
-               watches=("triton_dist_tpu/", "docs/observability.md"))
+               # The package-wide glob already covers serving/ and
+               # models/spec.py; the explicit entries pin the ISSUE-13
+               # contract (spec telemetry stays cataloged) against a
+               # future narrowing of the package glob.
+               watches=("triton_dist_tpu/", "docs/observability.md",
+                        "triton_dist_tpu/serving/",
+                        "triton_dist_tpu/models/spec.py"))
 def _metrics_pass(root):
     from triton_dist_tpu.analysis import lint_metrics
     return lint_metrics.run(root)
@@ -269,9 +275,15 @@ def _fallback_pass(root):
 @register_pass("annotation-coverage",
                "every @resilient invocation runs under a device.<op>.* "
                "profiler label; the pump sampler keeps device.step",
+               # serving/ as a subtree (not just scheduler.py): the
+               # pump's step labels now name three paths (mega/plain/
+               # spec — ISSUE 13), and a spec change that re-routes the
+               # decode verb must re-run this pass; models/spec.py
+               # rides along for the same reason.
                watches=("triton_dist_tpu/resilience/router.py",
                         "triton_dist_tpu/obs/devprof.py",
-                        "triton_dist_tpu/serving/scheduler.py",
+                        "triton_dist_tpu/serving/",
+                        "triton_dist_tpu/models/spec.py",
                         "triton_dist_tpu/analysis/lint_annotations.py"))
 def _annotation_pass(root):
     from triton_dist_tpu.analysis import lint_annotations

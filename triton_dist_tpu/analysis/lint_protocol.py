@@ -28,6 +28,14 @@ protocol-model core — explicit, rationale'd debt, not a licence.
 Moving a module out of BACKLOG means writing its trace builder on
 ``analysis/protocol_model.py``; adding to it is a reviewed diff the
 same way ``lint_fallback.DELEGATES`` is.
+
+:data:`PROTOCOL_FREE` extends the map past ``ops/``: modules that sit
+on comm-adjacent hot paths (the speculative-decoding machinery,
+ISSUE 13) but bear NO semaphores — declared explicitly, with a
+rationale, so the meta-lint says so rather than leaving it to
+omission. The lint VERIFIES the claim: a protocol-free module that
+grows a semaphore/DMA primitive fails with
+``protocol.unclaimed_semaphore`` until a verifier pass claims it.
 """
 
 from __future__ import annotations
@@ -37,8 +45,8 @@ from pathlib import Path
 
 from triton_dist_tpu.analysis.findings import Finding
 
-__all__ = ["CLAIMS", "BACKLOG", "PRIMITIVES", "scan_module",
-           "collect_findings", "run"]
+__all__ = ["CLAIMS", "BACKLOG", "PROTOCOL_FREE", "PRIMITIVES",
+           "scan_module", "collect_findings", "run"]
 
 #: Verified kernels: ops/ module basename -> the registered pass that
 #: model-checks its protocol (docs/analysis.md pass catalog).
@@ -72,6 +80,19 @@ BACKLOG = {
                         "pending (ROADMAP item 5 MoE serving)",
     "sp_attention.py": "sequence-parallel KV ring; needs a trace "
                        "with per-(slot, dir) double-buffer oracle",
+}
+
+#: Modules OUTSIDE ops/ declared protocol-free (package-relative path
+#: -> rationale). Each claim is checked, not trusted: the module is
+#: scanned like any ops/ kernel, and growing a primitive fires
+#: ``protocol.unclaimed_semaphore`` until a verifier pass claims it.
+PROTOCOL_FREE = {
+    "models/spec.py": "speculative decoding (ISSUE 13) is pure "
+                      "host-side orchestration — drafters + "
+                      "acceptance over jitted XLA forwards; the "
+                      "widened verify step carries no semaphores. If "
+                      "a fused multi-token verify kernel lands, it "
+                      "claims a protocol pass here.",
 }
 
 #: Attribute names whose use marks a module as protocol-bearing.
@@ -111,14 +132,21 @@ def scan_module(path: Path):
 
 
 def collect_findings(ops_dir: Path = None, claims: dict = None,
-                     backlog: dict = None, passes=None) -> list:
+                     backlog: dict = None, passes=None,
+                     protocol_free: dict = None) -> list:
     """All protocol-coverage findings (empty == the kernel zoo map is
     total). Every input is injectable for the seeded-drift tests."""
+    default_tree = ops_dir is None
     if ops_dir is None:
         import triton_dist_tpu.ops
         ops_dir = Path(triton_dist_tpu.ops.__file__).parent
     claims = CLAIMS if claims is None else claims
     backlog = BACKLOG if backlog is None else backlog
+    if protocol_free is None:
+        # Only the real package tree carries the real protocol-free
+        # map — injected ops_dirs (seeded-drift tests) opt in
+        # explicitly so their synthetic trees aren't scanned for it.
+        protocol_free = PROTOCOL_FREE if default_tree else {}
     if passes is None:
         from triton_dist_tpu.analysis import PASSES
         passes = PASSES
@@ -172,6 +200,33 @@ def collect_findings(ops_dir: Path = None, claims: dict = None,
             file=str(ops_dir / name), line=1,
             pass_name="protocol-coverage",
             fix_hint="remove the dangling claim"))
+    # Declared protocol-free modules outside ops/ (package-relative):
+    # verify the claim instead of trusting the prose.
+    pkg_dir = ops_dir.parent
+    for rel in sorted(protocol_free):
+        path = pkg_dir / rel
+        if not path.exists():
+            findings.append(Finding(
+                code="protocol.stale_claim",
+                message=f"{rel} is declared protocol-free but does "
+                        f"not exist under {pkg_dir}",
+                file=str(path), line=1,
+                pass_name="protocol-coverage",
+                fix_hint="remove the dangling PROTOCOL_FREE entry"))
+            continue
+        line, used = scan_module(path)
+        if used:
+            findings.append(Finding(
+                code="protocol.unclaimed_semaphore",
+                message=f"{rel} is declared protocol-free but uses "
+                        f"comm-protocol primitives "
+                        f"({', '.join(sorted(used))}) — the claim no "
+                        f"longer holds",
+                file=str(path), line=line,
+                pass_name="protocol-coverage",
+                fix_hint="build a trace model on analysis/"
+                         "protocol_model.py, register its pass, move "
+                         "the module from PROTOCOL_FREE to CLAIMS"))
     return findings
 
 

@@ -179,11 +179,14 @@ def _attention_core(q, k, v, cache_k, cache_v, offset, kv_start, *,
     causal mask). Fully-masked (pad) query rows get finite garbage (not
     NaN); their logits are never consumed.
 
-    ``offset`` may be a PER-ROW (B,) vector when S == 1 (continuous
-    batching: each row decodes at its own write position,
-    Engine.serve_stream). Scalar offset keeps the contiguous
-    dynamic_update_slice write; the vector path scatters one position
-    per row."""
+    ``offset`` may be a PER-ROW (B,) vector (continuous batching: each
+    row decodes at its own write position, Engine.serve_stream). Scalar
+    offset keeps the contiguous dynamic_update_slice write; the vector
+    path scatters per row — one position (S == 1, the stream decode
+    step) or a burst of S positions offset[b]+[0, S) (the speculative-
+    decoding verify window, Engine spec steps; out-of-range positions
+    are dropped by the scatter, which only frozen rows near max_seq
+    ever produce)."""
     b, s, hq, d = q.shape
     t = cache_k.shape[1]
     hkv = cache_k.shape[2]
@@ -192,10 +195,19 @@ def _attention_core(q, k, v, cache_k, cache_v, offset, kv_start, *,
         cache_v = lax.dynamic_update_slice(cache_v, v, (0, offset, 0, 0))
         off_b = jnp.broadcast_to(offset, (b,))
     else:
-        assert s == 1, "per-row offsets support single-token decode only"
         rows = jnp.arange(b)
-        cache_k = cache_k.at[rows, offset].set(k[:, 0])
-        cache_v = cache_v.at[rows, offset].set(v[:, 0])
+        if s == 1:
+            cache_k = cache_k.at[rows, offset].set(k[:, 0])
+            cache_v = cache_v.at[rows, offset].set(v[:, 0])
+        else:
+            # Burst write: row b's window lands at offset[b]+[0, S).
+            # Positions past T (frozen rows at stale offsets) drop out
+            # of the scatter; in-lane overshoot is overwritten before
+            # any causal mask exposes it (the stream-admission pad-slot
+            # safety argument, docs/serving.md "Speculative decoding").
+            pos = offset[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+            cache_k = cache_k.at[rows[:, None], pos].set(k)
+            cache_v = cache_v.at[rows[:, None], pos].set(v)
         off_b = offset
 
     # Contractions run in the cache dtype when q matches it (MXU-native

@@ -15,12 +15,13 @@ from triton_dist_tpu.models.dense import DenseLLM
 from triton_dist_tpu.models.qwen_moe import Qwen3MoE
 from triton_dist_tpu.models.kv_cache import KVCacheManager
 from triton_dist_tpu.models.engine import Engine, StreamSession, sample_token
+from triton_dist_tpu.models.spec import SpecConfig
 from triton_dist_tpu.models.train import make_train_step, cross_entropy_loss
 from triton_dist_tpu.models import presets
 
 __all__ = ["ModelConfig", "DenseLLM", "Qwen3MoE", "KVCacheManager",
            "Engine", "StreamSession", "sample_token", "AutoLLM", "make_train_step", "presets",
-           "cross_entropy_loss"]
+           "cross_entropy_loss", "SpecConfig"]
 
 
 def _load_safetensors_state(model_dir: str) -> dict:

@@ -152,8 +152,11 @@ class DenseLLM:
         b, s = input_ids.shape
         offset = jnp.asarray(offset, jnp.int32)
         # offset may be a (B,) vector (per-row decode positions —
-        # continuous batching, Engine.serve_stream); S must be 1 then
-        # (enforced by the attention core's scatter write).
+        # continuous batching, Engine.serve_stream — with S == 1, or
+        # the S == k+1 speculative-decoding verify window: the
+        # attention core scatters row b's K/V at offset[b]+[0, S) and
+        # masks each query position causally at its own absolute
+        # position).
         off2d = offset[:, None] if offset.ndim else offset
         position_ids = off2d + jnp.tile(
             jnp.arange(s, dtype=jnp.int32)[None], (b, 1))
@@ -234,19 +237,26 @@ class DenseLLM:
         # CACHE as the rotating KV — q positions offset+[0, S), live KV
         # limited to offset+S (sp_ag_attention q_offset/kv_len). A
         # traced offset conservatively selects the chunked path.
-        chunked = s > 1 and (isinstance(offset, jax.core.Tracer)
-                             or int(offset) != 0)
+        chunked = (s > 1 and getattr(offset, "ndim", 0) == 0
+                   and (isinstance(offset, jax.core.Tracer)
+                        or int(offset) != 0))
         offset = jnp.asarray(offset, jnp.int32)
         # (B,) per-row offsets supported for decode (continuous
         # batching, Engine.serve_stream — same contract as the dense tp
         # forward): per-row cache writes, masks, and rope positions.
-        assert offset.ndim == 0 or decode, "vector offset needs S == 1"
+        # With S > 1 a vector offset is the speculative-decoding verify
+        # window (Engine spec steps): row b's S tokens sit at absolute
+        # positions offset[b]+[0, S), each scoring against its own
+        # causal prefix — a burst of S decode steps in one program.
+        burst = offset.ndim == 1 and s > 1
         off2d = offset[:, None] if offset.ndim else offset
         pos = off2d + jnp.tile(jnp.arange(s, dtype=jnp.int32)[None],
                                (b, 1))
         tp = self.sp_ctx.head_axis  # single source of truth (ctor)
-        xsh = P() if decode else P(None, sp, None)
-        hsh = P() if decode else P(None, sp, tp, None)  # heads over tp
+        # Burst windows are decode-shaped work (S = k+1 small): keep
+        # activations replicated like the decode step, not S-sharded.
+        xsh = P() if decode or burst else P(None, sp, None)
+        hsh = P() if decode or burst else P(None, sp, tp, None)
 
         def constrain(t, spec):
             return jax.lax.with_sharding_constraint(
@@ -275,11 +285,20 @@ class DenseLLM:
             # (Training discards new_caches, so XLA dead-code-eliminates
             # this whole write chain — prefill attention reads the
             # just-projected k/v, not the cache.)
-            csh = P() if decode else P(None, sp, None, None)
+            csh = P() if decode or burst else P(None, sp, None, None)
             kc = constrain(k, csh).astype(ck.dtype)
             vc = constrain(v, csh).astype(cv.dtype)
             if block_table is None:
-                if offset.ndim:
+                if burst:
+                    # Per-row burst (spec verify window): row b's S
+                    # tokens scatter at offset[b]+[0, S); out-of-range
+                    # positions (frozen rows) drop out of the scatter.
+                    rows = jnp.arange(b)
+                    posb = offset[:, None] + jnp.arange(
+                        s, dtype=jnp.int32)[None]
+                    ck = ck.at[rows[:, None], posb].set(kc)
+                    cv = cv.at[rows[:, None], posb].set(vc)
+                elif offset.ndim:
                     # Per-row decode positions: scatter one position
                     # per row into its own lane.
                     rows = jnp.arange(b)
@@ -290,20 +309,43 @@ class DenseLLM:
                                                       (0, offset, 0, 0))
                     cv = jax.lax.dynamic_update_slice(cv, vc,
                                                       (0, offset, 0, 0))
-            elif decode:
-                # Single-position paged write — the address math lives
-                # in ONE place (PagedKVCacheManager.position_to_slot*).
+            elif decode or burst:
+                # Single-position (or per-row burst) paged write — the
+                # address math lives in ONE place
+                # (PagedKVCacheManager.position_to_slot*).
                 from triton_dist_tpu.models.kv_cache import (
                     PagedKVCacheManager)
                 spd = ck.shape[0] // self.mesh.shape[sp]
-                if offset.ndim:
+                if burst:
+                    # Spec verify window: position j of row b is
+                    # offset[b]+j. Positions past max_seq (frozen rows
+                    # at stale offsets, or a live row padded past its
+                    # own clamp by a wider batchmate) reroute to the
+                    # device-0 SENTINEL page instead of wrapping the
+                    # address math into a live block.
+                    t_total = ck.shape[1] * block_table.shape[2] \
+                        * self.mesh.shape[sp]
+                    for j in range(s):
+                        posj = offset + j
+                        ok = posj < t_total
+                        g, ip = \
+                            PagedKVCacheManager.position_to_slot_rows(
+                                block_table,
+                                jnp.minimum(posj, t_total - 1),
+                                ck.shape[1], spd)
+                        g = jnp.where(ok, g, spd - 1)
+                        ck = ck.at[g, ip].set(kc[:, j])
+                        cv = cv.at[g, ip].set(vc[:, j])
+                elif offset.ndim:
                     g, ip = PagedKVCacheManager.position_to_slot_rows(
                         block_table, offset, ck.shape[1], spd)
+                    ck = ck.at[g, ip].set(kc[:, 0])
+                    cv = cv.at[g, ip].set(vc[:, 0])
                 else:
                     g, ip = PagedKVCacheManager.position_to_slot(
                         block_table, offset, ck.shape[1], spd)
-                ck = ck.at[g, ip].set(kc[:, 0])
-                cv = cv.at[g, ip].set(vc[:, 0])
+                    ck = ck.at[g, ip].set(kc[:, 0])
+                    cv = cv.at[g, ip].set(vc[:, 0])
             elif chunked:
                 # Paged chunked prefill (prefix-cache suffix admission,
                 # ISSUE 6): scatter ONLY positions offset+[0, S) into
@@ -333,6 +375,26 @@ class DenseLLM:
                         q[:, 0], ck, cv, block_table, offset + 1,
                         self.fd_ctx, impl=self.fd_impl)
                 att = att[:, None]
+            elif burst:
+                # Spec verify window: query position j runs the SAME
+                # per-row flash decode the sequential stream step runs
+                # — kv_len = offset+j+1 masks every later window
+                # position, so logits are bit-identical to S sequential
+                # decode steps (the spec acceptance contract,
+                # docs/serving.md "Speculative decoding"). S = k+1 is
+                # small, so the unrolled loop stays one program.
+                atts = []
+                for j in range(s):
+                    if block_table is None:
+                        atts.append(gqa_fwd_batch_decode(
+                            q[:, j], ck, cv, offset + j + 1,
+                            self.fd_ctx, impl=self.fd_impl))
+                    else:
+                        atts.append(gqa_fwd_batch_decode_paged(
+                            q[:, j], ck, cv, block_table,
+                            offset + j + 1, self.fd_ctx,
+                            impl=self.fd_impl))
+                att = jnp.stack(atts, axis=1)
             elif chunked:
                 # Cache-aware chunk: attend over the updated cache
                 # (prefix [0, offset) + this chunk), ring or xla. With a

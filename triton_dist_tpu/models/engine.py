@@ -181,10 +181,31 @@ class Engine:
                  decode_path: str | None = None,
                  prefix_cache: bool | None = None,
                  kv_slots_per_dev: int | None = None,
-                 slo=None):
+                 slo=None, spec=None):
         self.model = model
         c = model.config
         self.paged = paged
+        # Speculative decoding (ISSUE 13, docs/serving.md "Speculative
+        # decoding"): a SpecConfig turns stream-session decode into
+        # variable-tokens-per-step bursts — a drafter proposes up to k
+        # tokens per row, one widened verify step scores them, the
+        # accepted prefix commits atomically. Greedy-only: the verify
+        # step's acceptance rule IS argmax equality, which is what
+        # makes spec-on output bit-identical to spec-off
+        # (tests/test_scheduler.py). TDT_SPEC=0 disables at runtime.
+        if spec is not None and spec.enabled:
+            if temperature > 0.0:
+                # ValueError, not assert: user-facing config checks
+                # survive ``python -O``.
+                raise ValueError(
+                    "SpecConfig requires greedy decoding "
+                    f"(temperature=0), got temperature={temperature} — "
+                    "stochastic speculative sampling needs rejection "
+                    "resampling, which this engine does not implement")
+            self.spec = spec
+        else:
+            self.spec = None
+        self._spec_step: dict = {}       # verify-window k → jitted step
         # Declarative serving SLO targets (obs.slo.SLOTarget list) the
         # scheduler's SLO tracker evaluates for this engine; None keeps
         # the env-overridable defaults (docs/observability.md "SLOs
@@ -383,6 +404,18 @@ class Engine:
         rectangle — static shapes); the loop exits early once every row
         has stopped.
         """
+        if self.spec is not None:
+            # Explicit refusal, not a silent ignore (the PR-10 config-
+            # check discipline): serve()'s rectangular decode loop has
+            # no draft/verify machinery — speculation serves through
+            # the stream path (StreamSession / serve_stream / the
+            # scheduler), which is where every client route already
+            # lands (ModelServer schedules by default).
+            raise ValueError(
+                "serve() does not run speculative decoding — "
+                "SpecConfig engines serve through the stream path "
+                "(StreamSession / serve_stream / the scheduler); "
+                "build the engine with spec=None for serve()")
         b, s = input_ids.shape
         if gen_len <= 0:
             return input_ids
@@ -553,6 +586,32 @@ class Engine:
 
 
     # -- continuous batching ----------------------------------------------
+    def _build_spec_verify_step(self, k: int):
+        """The widened verify step of speculative decoding (ISSUE 13):
+        ONE forward scores a k+1-token window per row — the last
+        committed token plus k draft tokens — at per-row positions
+        ``offsets[b]+[0, k]``, writing their K/V exactly where k+1
+        sequential stream steps would and returning the argmax at
+        every window position. Compiled once per k (the chunked-
+        prefill compile-cache pattern: k buckets are few and small).
+        Greedy by construction — acceptance compares these argmaxes
+        against the drafts, so emitted tokens are bit-identical to the
+        sequential path (models/spec.py). Frozen rows ride along like
+        the plain stream step: paged lanes point at the sentinel, and
+        contiguous-lane overshoot is dropped or overwritten before any
+        mask exposes it."""
+        model, mode = self.model, self.decode_mode
+
+        @jax.jit
+        def step(params, caches, tokens, offsets, table):
+            logits, caches = model.forward(
+                params, tokens, caches, offsets, mode=mode,
+                **({"block_table": table} if table is not None
+                   else {}))
+            return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                    caches)
+        return step
+
     def _build_stream_step(self):
         """One decode step with PER-ROW write offsets: each live row
         decodes at its own cache position (frozen rows re-emit their
@@ -817,10 +876,18 @@ class Engine:
 
         admit_free_rows()
         while any(rid is not None for rid in row_req):
-            toks = sess.decode_step()
+            # Variable tokens per row per iteration (ISSUE 13): the
+            # base paths burst exactly one token, a speculative verify
+            # step 1..k+1 — a row retiring mid-burst (stop token /
+            # budget) discards the burst's tail, so outputs match the
+            # sequential path exactly.
+            bursts = sess.decode_burst()
             for r in range(b):
-                if row_req[r] is not None:
-                    record(r, int(toks[r]))
+                for tok in bursts.get(r, ()):
+                    if row_req[r] is None:
+                        break
+                    if record(r, int(tok)):
+                        break
             admit_free_rows()
         assert all(r is not None for r in results), (
             "stream ended with unserved prompts — admission stalled "
@@ -910,6 +977,18 @@ class StreamSession:
         self._decode_kind: str | None = None  # decided path, unconsumed
         self._host_off = [0] * b     # host shadow of per-row offsets
         self._pending: dict[int, dict] = {}   # row → chunked-prefill state
+        # Speculative decoding (ISSUE 13): drafter + per-row budget
+        # clamps; decode_burst() runs draft → widened verify → atomic
+        # multi-token commit when this is set (docs/serving.md
+        # "Speculative decoding").
+        self.spec = None
+        if engine.spec is not None:
+            from triton_dist_tpu.models.spec import SpecState
+            self.spec = SpecState(engine.spec, b, engine.kv.max_seq)
+        #: Draft/verify wall time of the most recent decode_burst
+        #: (None for base-path steps) — the scheduler folds these into
+        #: each live request's attribution waterfall (obs.attrib).
+        self.last_burst_timing: dict | None = None
         #: Facts about the most recent completed admission (currently
         #: the prefix-cached token count) — the scheduler reads this
         #: right after prefill_into_row/prefill_step returns a first
@@ -973,7 +1052,8 @@ class StreamSession:
         if (chunk and not eng.paged and eng.prefill_mode != "sp"
                 and len(prompt) > chunk
                 and -(-len(prompt) // chunk) * chunk <= eng.kv.max_seq):
-            return self._start_chunked(row, prompt, int(chunk))
+            return self._start_chunked(row, prompt, int(chunk),
+                                       gen_budget=gen_budget)
         return self._admit_whole(row, prompt, gen_budget=gen_budget)
 
     def _bucket(self, n: int) -> int:
@@ -994,10 +1074,12 @@ class StreamSession:
         first, self.caches = eng._admit(
             self.params, self.caches, ids, jnp.int32(len(prompt)),
             jnp.int32(row), sub)
+        first = int(first)
         self.admit_info = {"cached": 0}
         self._mark_admitted(row, len(prompt))
         self.token = self.token.at[row].set(first)
-        return int(first)
+        self._spec_start(row, prompt, first, gen_budget)
+        return first
 
     def _admit_paged(self, row: int, prompt: list,
                      gen_budget: int | None, sub) -> int:
@@ -1063,6 +1145,7 @@ class StreamSession:
         self.admit_info = {"cached": cached}
         self._mark_admitted(row, L)
         self.token = self.token.at[row].set(first)
+        self._spec_start(row, prompt, first, gen_budget)
         return first
 
     def _note_prefix(self, row: int, prompt_len: int,
@@ -1090,7 +1173,8 @@ class StreamSession:
                            args={"row": row, "prompt_len": prompt_len,
                                  "cached_tokens": cached})
 
-    def _start_chunked(self, row: int, prompt: list, chunk: int):
+    def _start_chunked(self, row: int, prompt: list, chunk: int,
+                       gen_budget: int | None = None):
         eng = self.engine
         if eng._admit_chunk is None:
             eng._admit_chunk = eng._build_admit_chunk()
@@ -1101,7 +1185,7 @@ class StreamSession:
         eng.key, sub = jax.random.split(eng.key)
         self._pending[row] = {
             "ids": np.asarray([padded], np.int32), "len": len(prompt),
-            "chunk": chunk, "pos": 0, "key": sub,
+            "chunk": chunk, "pos": 0, "key": sub, "budget": gen_budget,
             "small": [(jnp.zeros((1, lb) + ck.shape[2:], ck.dtype),
                        jnp.zeros((1, lb) + cv.shape[2:], cv.dtype))
                       for ck, cv in self.caches]}
@@ -1125,10 +1209,13 @@ class StreamSession:
         first, self.caches = eng._admit_finish(  # in the final chunk
             self.caches, st["small"], logits, jnp.int32(idx),
             jnp.int32(row), st["key"])
+        first = int(first)
         self.admit_info = {"cached": 0}
         self._mark_admitted(row, st["len"])
         self.token = self.token.at[row].set(first)
-        return int(first)
+        self._spec_start(row, st["ids"][0, :st["len"]].tolist(), first,
+                         st.get("budget"))
+        return first
 
     def cancel_prefill(self, row: int) -> None:
         """Drop a mid-chunk admission (its scratch cache was never
@@ -1143,31 +1230,69 @@ class StreamSession:
         self._host_off[row] = prompt_len
         self.live[row] = True
 
+    def _spec_start(self, row: int, prompt, first: int,
+                    gen_budget) -> None:
+        """Seed the drafter for a freshly-admitted row (no-op without
+        spec). ``gen_budget`` bounds later bursts; both shipped
+        drivers pass it — without it only the max_seq room clamps, so
+        a tight paged pool could exhaust mid-burst."""
+        if self.spec is not None:
+            self.spec.start_row(row, prompt, first, gen_budget)
+
     # -- decode / retire ---------------------------------------------------
     def decode_kind(self) -> str:
-        """The decode path the NEXT :meth:`decode_step` will run
-        ("mega"/"plain"): the engine's static config, or the auto
-        policy's measured-gauge decision for the current batch. The
-        scheduler calls this right before opening a devprof iteration
-        window so the capture's ``device.step.<kind>`` label names the
-        path that actually drove it; the decision is cached and
-        consumed by the following decode_step. Stream decode steps are
-        samplable work, so these decisions may probe."""
-        self._decode_kind = self.engine.resolve_decode_path(
-            samplable=True)
+        """The decode path the NEXT :meth:`decode_step` /
+        :meth:`decode_burst` will run ("spec"/"mega"/"plain"): "spec"
+        when the engine carries a SpecConfig (the burst may still fall
+        back to the base path on a 0-draft iteration), otherwise the
+        engine's static config or the auto policy's measured-gauge
+        decision for the current batch. The scheduler calls this right
+        before opening a devprof iteration window so the capture's
+        ``device.step.<kind>`` label names the path that actually
+        drove it; the decision is cached and consumed by the following
+        step. Stream decode steps are samplable work, so these
+        decisions may probe."""
+        if self.spec is not None:
+            self._decode_kind = "spec"
+        else:
+            self._decode_kind = self.engine.resolve_decode_path(
+                samplable=True)
         return self._decode_kind
 
+    def decode_burst(self) -> dict:
+        """One shared decode ITERATION with variable tokens per row
+        (ISSUE 13): ``{row: [tok, ...]}`` for every live row — exactly
+        one token each on the base paths, 1..k+1 on a speculative
+        verify step. The scheduler's pump and ``serve_stream`` both
+        consume this verb; :meth:`decode_step` remains the
+        single-token base-path step."""
+        kind = self._decode_kind or self.decode_kind()
+        self._decode_kind = None
+        self.last_burst_timing = None
+        if kind != "spec":
+            toks = self._base_step(kind)
+            return {r: [int(toks[r])] for r in range(self.batch)
+                    if self.live[r]}
+        return self._spec_burst()
+
     def decode_step(self) -> np.ndarray:
-        """One shared decode step: every live row decodes at its own
-        cache position, frozen rows re-emit their token. Returns the
-        (batch,) token vector as numpy.
+        """One shared BASE decode step: every live row decodes at its
+        own cache position, frozen rows re-emit their token. Returns
+        the (batch,) token vector as numpy.
 
         Runs the plain stream step or the mega one-program step per
         :meth:`decode_kind` — both are greedily bit-identical, so the
-        auto policy may flip paths between steps of one request."""
-        eng = self.engine
-        kind = self._decode_kind or self.decode_kind()
+        auto policy may flip paths between steps of one request.
+        (Speculative engines burst through :meth:`decode_burst`; this
+        verb always runs the base path.)"""
+        kind = self._decode_kind
         self._decode_kind = None
+        if kind not in ("mega", "plain"):
+            kind = self.engine.resolve_decode_path(samplable=True)
+        return self._base_step(kind)
+
+    def _base_step(self, kind: str) -> np.ndarray:
+        eng = self.engine
         if kind == "mega":
             if eng._stream_step_mega is None:
                 eng._stream_step_mega = eng._build_stream_step_mega()
@@ -1199,6 +1324,132 @@ class StreamSession:
                 self._host_off[r] += 1
         return np.asarray(self.token)
 
+    def _spec_burst(self) -> dict:
+        """Draft → widened verify → atomic commit (ISSUE 13).
+
+        The drafter proposes up to k tokens per live row (clamped to
+        each row's remaining budget and max_seq room — models/spec.py
+        SpecState.plan); ONE widened step scores every window position;
+        the longest argmax-matching draft prefix plus the bonus token
+        commit per row. Paged pools grow blocks for every position a
+        row may KEEP before the step (multi-block ensure_position) and
+        rewind the rejected tail after it (rollback_position — blocks
+        freed, commitments restored, no leaks: tests/test_block_pool).
+        A 0-draft iteration composes with the base paths: the plain/
+        mega/auto machinery serves it unchanged."""
+        eng = self.engine
+        live_rows = [r for r in range(self.batch) if self.live[r]]
+        timed = obs.enabled() or _trace.enabled()
+        t0 = time.perf_counter() if timed else 0.0
+        with obs.span("engine.spec_draft"):
+            drafts = self.spec.plan(live_rows, self._host_off)
+        t1 = time.perf_counter() if timed else 0.0
+        k_step = max((len(d) for d in drafts.values()), default=0)
+        if k_step == 0:
+            # Nothing to verify: the base path serves this iteration
+            # (mega/plain/auto arbitration included) — spec composes
+            # with decode-path selection instead of replacing it.
+            # samplable=False: the scheduler already labeled this
+            # iteration's capture window device.step.spec (decode_kind
+            # is "spec" for spec engines), so an auto-policy probe here
+            # could never land in the device.step.mega/plain gauges the
+            # policy reads — the unmeasurable-probe case the
+            # samplable gate exists to prevent.
+            obs.counter("serving.spec_fallback_steps").inc()
+            kind = eng.resolve_decode_path(samplable=False)
+            toks = self._base_step(kind)
+            bursts = {r: [int(toks[r])] for r in live_rows}
+            for r in live_rows:
+                self.spec.observe(r, bursts[r])
+            return bursts
+        if eng.paged:
+            # Cover every position a row may keep BEFORE the step
+            # (writes happen in-program; an unallocated position lands
+            # on the sentinel and would LOSE an accepted token's K/V).
+            # Rows drafted narrower than k_step stay unallocated past
+            # their own clamp — their pad writes are sentinel-routed.
+            grew = False
+            for r in live_rows:
+                grew |= eng.kv.ensure_position(
+                    r, self._host_off[r] + len(drafts[r]))
+            if grew:
+                self.cur_table = eng.kv.block_table()
+        # Power-of-two k bucket (the admission-bucket pattern): jit
+        # compiles one verify program per bucket, not per distinct
+        # draft width — pad positions past a row's own drafts are
+        # never accepted and their writes are sentinel-routed/dropped.
+        k_w = 1
+        while k_w < k_step:
+            k_w *= 2
+        b = self.batch
+        toks_in = np.zeros((b, k_w + 1), np.int32)
+        toks_in[:, 0] = np.asarray(self.token)
+        for r in live_rows:
+            d = drafts[r]
+            toks_in[r, 1:1 + len(d)] = d
+        if k_w not in eng._spec_step:
+            eng._spec_step[k_w] = eng._build_spec_verify_step(k_w)
+        step_fn = eng._spec_step[k_w]
+        with obs.span("engine.spec_verify"):
+            nxt, self.caches = step_fn(self.params, self.caches,
+                                       jnp.asarray(toks_in),
+                                       self.offsets, self.cur_table)
+            if timed:
+                jax.block_until_ready(nxt)
+        nxt = np.asarray(nxt)
+        bursts: dict = {}
+        n_drafted = n_accepted = n_emitted = 0
+        rolled = False
+        from triton_dist_tpu.models.spec import accept_greedy
+        for r in live_rows:
+            a, emitted = accept_greedy(drafts[r], nxt[r])
+            if eng.paged and a < len(drafts[r]):
+                rolled |= eng.kv.rollback_position(
+                    r, self._host_off[r] + a)
+            self._host_off[r] += a + 1
+            bursts[r] = emitted
+            self.spec.observe(r, emitted)
+            n_drafted += len(drafts[r])
+            n_accepted += a
+            n_emitted += len(emitted)
+        if rolled:
+            self.cur_table = eng.kv.block_table()
+        # Commit the device-side state from the host shadows (frozen
+        # rows keep their stale offset/token like the base step).
+        self.offsets = jnp.asarray(self._host_off, jnp.int32)
+        tok_vec = np.asarray(self.token).copy()
+        for r in live_rows:
+            tok_vec[r] = bursts[r][-1]
+        self.token = jnp.asarray(tok_vec)
+        self._note_spec(n_drafted, n_accepted, n_emitted)
+        if timed:
+            t2 = time.perf_counter()
+            self.last_burst_timing = {
+                "draft_ms": round((t1 - t0) * 1e3, 3),
+                "verify_ms": round((t2 - t1) * 1e3, 3)}
+        return bursts
+
+    @staticmethod
+    def _note_spec(drafted: int, accepted: int, emitted: int) -> None:
+        """Speculation telemetry (docs/observability.md): cumulative
+        counters plus the two derived gauges the acceptance bar names
+        — accept rate (accepted/drafted) and emitted tokens per verify
+        step (the tokens/s multiplier speculation buys)."""
+        steps = obs.counter("serving.spec_steps")
+        steps.inc()
+        dc = obs.counter("serving.spec_draft_tokens")
+        dc.inc(drafted)
+        ac = obs.counter("serving.spec_accepted_tokens")
+        ac.inc(accepted)
+        ec = obs.counter("serving.spec_emitted_tokens")
+        ec.inc(emitted)
+        if dc.value > 0:
+            obs.gauge("serving.spec_accept_rate").set(
+                round(ac.value / dc.value, 4))
+        if steps.value > 0:
+            obs.gauge("serving.spec_tokens_per_step").set(
+                round(ec.value / steps.value, 4))
+
     def retire_row(self, row: int) -> None:
         """Free a finished row; the next admission may reuse its lane
         immediately. Paged engines release the row's blocks EAGERLY —
@@ -1207,6 +1458,8 @@ class StreamSession:
         the free stack, and the row's lanes point back at the sentinel
         so its frozen writes stay harmless."""
         self.live[row] = False
+        if self.spec is not None:
+            self.spec.retire_row(row)
         if self.engine.paged:
             self.engine.kv.release_row(row)
             self.cur_table = self.engine.kv.block_table()

@@ -135,6 +135,17 @@ class PagedKVCacheManager:
         self._row_blocks = np.zeros((batch,), np.int32)
         self._committed = np.zeros((w,), np.int64)
         self._row_commit = np.zeros((batch, w), np.int64)
+        # Admission-time geometry rollback_position needs to restore
+        # commitments EXACTLY: per (row, dev), the prompt-block count
+        # (_row_base) and the committed decode tail (_row_tail0, an
+        # immutable copy of the admission's _row_commit). A decode
+        # block consumed commitment iff its per-device decode ordinal
+        # sits below _row_tail0 — blocks allocate in order and the
+        # commitment decrements while positive, so the rule is exact
+        # and a rollback can never mint commitment a growth outside
+        # the admission budget never consumed.
+        self._row_base = np.zeros((batch, w), np.int64)
+        self._row_tail0 = np.zeros((batch, w), np.int64)
         self._evicted_total = 0
 
     def _init_allocator(self) -> None:
@@ -263,6 +274,8 @@ class PagedKVCacheManager:
         self._row_blocks[:] = 0
         self._committed[:] = 0
         self._row_commit[:] = 0
+        self._row_base[:] = 0
+        self._row_tail0[:] = 0
         self.offset = 0
         self._emit_gauges()
 
@@ -477,6 +490,8 @@ class PagedKVCacheManager:
         tail = self._blocks_per_dev(n_prompt, n_total)
         self._row_commit[b] = tail
         self._committed += tail
+        self._row_base[b] = self._blocks_per_dev(0, n_prompt)
+        self._row_tail0[b] = tail
         self._row_blocks[b] = n_prompt
         if self.prefix is not None:     # account only admissions that
             self.prefix.account(n_lookup, k)    # actually succeeded
@@ -486,22 +501,63 @@ class PagedKVCacheManager:
 
     def ensure_position(self, b: int, pos: int) -> bool:
         """Grow row ``b``'s allocation to cover write position ``pos``
-        (called before each decode step). Returns True when a new block
-        was allocated — the caller must refresh its device table."""
+        (called before each decode step). Returns True when new blocks
+        were allocated — the caller must refresh its device table.
+
+        Grows one block per step under plain decode; a SPECULATIVE
+        burst (ISSUE 13) writes up to k+1 positions per step and may
+        cross several page boundaries at once, so growth allocates
+        every block from the current edge through ``pos``'s block.
+        Each allocation consumes the row's decode commitment where one
+        exists; ``rollback_position`` restores exactly the commitments
+        growth consumed (the per-device decode-ordinal rule there)."""
         j = pos // self.page_size
         n = int(self._row_blocks[b])
         if j < n:
             return False
-        assert j == n, (f"row {b}: position {pos} skips past block {n} "
-                        "(decode advances one position at a time)")
-        r, lp = self._block_lane(j)
-        slot = self._pop_block(r)
-        self._ref[r, slot] = 1
-        self._table[r, b, lp] = slot
-        self._row_blocks[b] = n + 1
-        if self._row_commit[b, r] > 0:   # consume this row's commitment
-            self._row_commit[b, r] -= 1
-            self._committed[r] -= 1
+        for jj in range(n, j + 1):
+            r, lp = self._block_lane(jj)
+            slot = self._pop_block(r)
+            self._ref[r, slot] = 1
+            self._table[r, b, lp] = slot
+            self._row_blocks[b] = jj + 1
+            if self._row_commit[b, r] > 0:   # consume the commitment
+                self._row_commit[b, r] -= 1
+                self._committed[r] -= 1
+        self._table_dev = None
+        self._emit_gauges()
+        return True
+
+    def rollback_position(self, b: int, pos: int) -> bool:
+        """Shrink row ``b``'s allocation back to the blocks covering
+        write positions [0, ``pos``] — the rejected-tail rewind of a
+        speculative burst (ISSUE 13): blocks allocated for draft
+        positions past the accepted prefix return to the pool (deref —
+        a decode-tail block is always private, so this is a free), the
+        lanes point back at the sentinel, and the commitments those
+        allocations consumed are restored so a later admission still
+        cannot starve this row's remaining budget. Returns True when
+        blocks were freed — the caller must refresh its device table.
+        Stale K/V inside the KEPT tail block needs no rewind: positions
+        past the committed offset are never exposed by any mask before
+        the next step overwrites them."""
+        keep = int(pos) // self.page_size + 1
+        n = int(self._row_blocks[b])
+        if n <= keep:
+            return False
+        for jj in range(keep, n):
+            r, lp = self._block_lane(jj)
+            self._deref(r, int(self._table[r, b, lp]))
+            self._table[r, b, lp] = self._sentinel[r]
+            # This block consumed commitment iff its per-device decode
+            # ordinal sits below the admission tail (allocation order
+            # is monotone, so the rule is exact — a block grown PAST
+            # the budget restores nothing).
+            d = lp - int(self._row_base[b, r])
+            if 0 <= d < int(self._row_tail0[b, r]):
+                self._row_commit[b, r] += 1
+                self._committed[r] += 1
+        self._row_blocks[b] = keep
         self._table_dev = None
         self._emit_gauges()
         return True
@@ -517,6 +573,8 @@ class PagedKVCacheManager:
             self._deref(r, int(self._table[r, b, lp]))
         self._committed -= self._row_commit[b]
         self._row_commit[b] = 0
+        self._row_base[b] = 0
+        self._row_tail0[b] = 0
         self._row_blocks[b] = 0
         self._point_at_sentinel(b)
         self._table_dev = None

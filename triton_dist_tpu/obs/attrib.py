@@ -52,7 +52,8 @@ def ring_size() -> int:
 def build(*, rid: int, trace_id: str | None, t_submit: float,
           t_admit: float, t_first: float, t_done: float,
           prompt_tokens: int, tokens: int, cached_tokens: int = 0,
-          prefill_chunks: int = 0) -> dict:
+          prefill_chunks: int = 0, draft_ms: float = 0.0,
+          verify_ms: float = 0.0) -> dict:
     """Waterfall dict from one request's monotonic-clock milestones
     (``time.perf_counter`` readings). The three segments partition
     ``[t_submit, t_done]`` exactly:
@@ -62,12 +63,19 @@ def build(*, rid: int, trace_id: str | None, t_submit: float,
       every chunked-prefill slice, including pump iterations it shared
       with decode steps);
     - ``decode_ms`` — first token → retirement.
+
+    ``draft_ms``/``verify_ms`` (ISSUE 13): speculative-decoding
+    sub-attribution of the decode segment — the draft and widened-
+    verify wall time of every shared burst this request rode. They
+    ride under ``"spec"`` and are NOT part of the exact partition
+    (shared-step time is booked to every rider, like ``decode_ms``
+    itself); present only when the engine speculated.
     """
     queue_wait = (t_admit - t_submit) * 1e3
     prefill = (t_first - t_admit) * 1e3
     decode = (t_done - t_first) * 1e3
     tpot = decode / (tokens - 1) if tokens > 1 else None
-    return {
+    out = {
         "rid": rid,
         "trace_id": trace_id,
         "total_ms": round((t_done - t_submit) * 1e3, 3),
@@ -82,6 +90,10 @@ def build(*, rid: int, trace_id: str | None, t_submit: float,
         "tokens": int(tokens),
         "tpot_ms": round(tpot, 3) if tpot is not None else None,
     }
+    if draft_ms or verify_ms:
+        out["spec"] = {"draft_ms": round(draft_ms, 3),
+                       "verify_ms": round(verify_ms, 3)}
+    return out
 
 
 def push(record: dict) -> None:

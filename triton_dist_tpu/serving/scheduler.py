@@ -44,8 +44,17 @@ Design:
   step the session resolves — the plain jitted step or the mega
   one-program task-graph step (``Engine(use_mega=True)`` /
   ``decode_path="auto"``) — through the same
-  :meth:`StreamSession.decode_step` verb; greedy outputs are
+  :meth:`StreamSession.decode_burst` verb; greedy outputs are
   bit-identical either way (docs/serving.md "Decode-path selection").
+- **Variable tokens per step** (ISSUE 13). A row emits 0..k+1 tokens
+  per pump iteration: with ``Engine(spec=SpecConfig(...))`` each
+  iteration drafts up to k tokens per row, verifies them in one
+  widened step, and commits the accepted prefix atomically — a row
+  whose burst contains its stop token retires MID-burst (the tail is
+  discarded), and greedy outputs stay bit-identical to spec-off
+  (docs/serving.md "Speculative decoding"). Fairness is unchanged:
+  admission is still strictly FIFO per iteration, and a burst never
+  exceeds the row's remaining ``gen_len`` budget.
 - **Observability** (docs/observability.md): ``serving.queue_depth``
   and ``serving.batch_occupancy`` gauges, per-request
   ``serving.ttft_ms`` and ``serving.queue_wait_ms`` histograms,
@@ -103,7 +112,8 @@ class Request:
 
     __slots__ = ("prompt", "gen_len", "stop_set", "trace_id", "rid",
                  "t_submit", "t_admit", "t_first", "tokens", "error",
-                 "done", "cached", "chunks", "timing")
+                 "done", "cached", "chunks", "timing", "draft_ms",
+                 "verify_ms")
 
     def __init__(self, prompt, gen_len: int, stop_set, trace_id, rid):
         self.prompt = prompt
@@ -120,6 +130,8 @@ class Request:
         self.cached = 0            # prefix-cache-hit prompt tokens
         self.chunks = 0            # prefill slices dispatched
         self.timing: dict | None = None   # attribution waterfall
+        self.draft_ms = 0.0        # spec draft time this request rode
+        self.verify_ms = 0.0       # spec verify time this request rode
 
     def result(self, timeout: float | None = None) -> list[int]:
         """Block until the request finishes; returns the generated
@@ -395,7 +407,8 @@ class Scheduler:
                     t_first=req.t_first, t_done=t_done,
                     prompt_tokens=len(req.prompt),
                     tokens=len(req.tokens), cached_tokens=req.cached,
-                    prefill_chunks=req.chunks)
+                    prefill_chunks=req.chunks,
+                    draft_ms=req.draft_ms, verify_ms=req.verify_ms)
                 attrib.push(req.timing)
                 if self.slo is not None and req.timing["tpot_ms"] \
                         is not None:
@@ -529,7 +542,10 @@ class Scheduler:
                         ann = annotate(devprof.step_label(kind))
                     try:
                         with ann:
-                            toks = sess.decode_step()
+                            # Variable tokens per row per iteration
+                            # (ISSUE 13): one token on the base paths,
+                            # 1..k+1 from a speculative verify step.
+                            bursts = sess.decode_burst()
                     except Exception as e:  # noqa: BLE001
                         # The SHARED step died: every occupant degrades
                         # (the cache state is suspect) and the session
@@ -544,9 +560,20 @@ class Scheduler:
                         self._session = sess
                         occupancy.set(0)
                         continue
+                    bt = sess.last_burst_timing
                     for row, req in live:
-                        if rows.get(row) is req:   # not failed above
-                            record(row, req, int(toks[row]))
+                        if rows.get(row) is not req:   # failed above
+                            continue
+                        if bt is not None:
+                            # Draft/verify sub-attribution: shared step
+                            # time booked to every rider, like the
+                            # decode wall-clock itself (obs.attrib).
+                            req.draft_ms += bt["draft_ms"]
+                            req.verify_ms += bt["verify_ms"]
+                        for tok in bursts.get(row, ()):
+                            if rows.get(row) is not req:
+                                break   # retired mid-burst (stop/EOS)
+                            record(row, req, int(tok))
             occupancy.set(len(rows))
             if admits or live or prefilling:
                 # Iteration time = this pump turn's engine work (the

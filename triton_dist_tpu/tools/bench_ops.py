@@ -345,6 +345,33 @@ def check_mega_serving_wellformed(extras: dict) -> list[str]:
     return []
 
 
+def check_spec_serving_wellformed(extras: dict) -> list[str]:
+    """Failure strings when the serving_spec part ran (its tokens/s
+    key exists) without publishing a well-formed
+    ``serving_spec_vs_plain`` ratio and accept-rate evidence
+    (ISSUE 13): the spec-on-vs-off scheduler ratio is the acceptance
+    bar, and the accept rate is what explains it — a run that
+    silently dropped either would let a drafter regression hide
+    behind a stale floor pass. Empty when the part did not run."""
+    if "serving_spec_tokens_per_s" not in extras:
+        return []
+    fails = []
+    v = extras.get("serving_spec_vs_plain")
+    if not isinstance(v, (int, float)) or isinstance(v, bool) \
+            or float(v) <= 0.0:
+        fails.append(
+            f"serving_spec_vs_plain: missing/malformed ({v!r}) — the "
+            f"serving_spec part ran but published no spec-vs-plain "
+            f"scheduler ratio")
+    r = extras.get("serving_spec_accept_rate")
+    if not isinstance(r, (int, float)) or isinstance(r, bool) \
+            or not 0.0 <= float(r) <= 1.0:
+        fails.append(
+            f"serving_spec_accept_rate: missing/malformed ({r!r}) — "
+            f"want a rate in [0, 1]")
+    return fails
+
+
 def _extras_from_file(path: str) -> dict:
     """Extras dict from any bench artifact: a bench.py checkpoint
     ({"extras": ...}), a bench.py result line ({"metric", "extras"}),
@@ -404,6 +431,7 @@ def run_regress(baseline_path: str, from_file: str | None,
     fails = check_regression(extras, floors)
     fails += check_serving_wellformed(extras)
     fails += check_mega_serving_wellformed(extras)
+    fails += check_spec_serving_wellformed(extras)
     fails += check_overlap_measured_wellformed(extras)
     fails += check_measured_overlap_floors(
         extras, load_measured_overlap_floors(baseline_path, tier))

@@ -71,6 +71,14 @@ def render(snap: dict) -> str:
             continue
         slo_rows.append((m, f"p50 {_fmt(p50)} ms   p99 {_fmt(p99)} ms"
                             f"   n {_fmt(n)}"))
+    if "serving.spec_accept_rate" in g:
+        # Speculative decoding rides the same panel: accept rate and
+        # emitted tokens per verify step are the knobs that move TPOT
+        # (docs/serving.md "Speculative decoding").
+        slo_rows.append(
+            ("spec", f"accept {_fmt(g['serving.spec_accept_rate'])}   "
+                     f"tok/step "
+                     f"{_fmt(g.get('serving.spec_tokens_per_step'))}"))
     _rows(lines, "rolling latency (window)", slo_rows)
 
     burn_rows = []
