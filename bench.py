@@ -42,7 +42,10 @@ What it benches (BASELINE.md north star; reference e2e_dense.md:21-38):
   harness), serving_spec (n-gram speculative decoding on vs off through
   the SAME scheduler on a repetition-friendly workload — CPU-valid:
   both paths run the identical model, so the ratio prices tokens per
-  step), prefix (shared-preamble
+  step), serving_fleet (TWO in-process ModelServer replicas behind a
+  client-side round-robin fanout vs one replica of the same config —
+  the first measured multi-replica number, with fleet-merged
+  bucket-summed TTFT/TPOT percentiles, ISSUE 14), prefix (shared-preamble
   clients, prefix cache warm vs cold — also CPU-valid), sp_attn, train. On a single chip the collective parts
   collapse, so the numbers measure Mosaic-kernel vs XLA compute
   quality; on a real slice the same code measures overlap.
@@ -176,8 +179,8 @@ def _probe_backend_subprocess(timeout_s: float) -> bool:
 #: can only cost the tail.
 _PART_ORDER = ("ag_gemm", "gemm_rs", "gemm_ar", "flash_decode", "tp_mlp",
                "layer_8b", "layer_32b", "overlap", "moe_ag_gg", "mega",
-               "serving", "serving_mega", "serving_spec", "prefix",
-               "sp_attn", "train")
+               "serving", "serving_mega", "serving_spec",
+               "serving_fleet", "prefix", "sp_attn", "train")
 
 #: Sweep-heavy parts get longer deadlines: ag_gemm/gemm_rs autotune
 #: 6-8 candidates at ~25 s Mosaic compile each on a COLD cache (the
@@ -270,11 +273,17 @@ def _run_parts_in_children(extras: dict) -> None:
                 prev = extras.get("telemetry")
                 wf = {**((prev or {}).get("waterfalls") or {}),
                       **(tel.get("waterfalls") or {})}
+                # The fleet-merged snapshot (serving_fleet child) is
+                # metadata merge_snapshots drops, like the waterfalls.
+                fleet = (tel.get("fleet")
+                         or (prev or {}).get("fleet"))
                 try:
                     from triton_dist_tpu.obs import merge_snapshots
                     extras["telemetry"] = merge_snapshots([prev, tel])
                     if wf:
                         extras["telemetry"]["waterfalls"] = wf
+                    if fleet:
+                        extras["telemetry"]["fleet"] = fleet
                 except Exception:  # noqa: BLE001 — telemetry is extra
                     # Keep what already accumulated over prior parts;
                     # only seed from this child when there is nothing.
@@ -1361,6 +1370,151 @@ def _bench_serving_spec(mesh, n, on_tpu, extras):
     return results["spec"], extras.get("serving_spec_vs_plain")
 
 
+def _bench_serving_fleet(mesh, n, on_tpu, extras):
+    """The first measured multi-replica number (ISSUE 14): TWO
+    in-process ``ModelServer`` replicas — same model, same params,
+    same per-replica engine config, each with its OWN metrics
+    registry (``registry="private"``) — behind a client-side
+    round-robin fanout, vs ONE replica of the identical config on the
+    same request stream. ``serving_fleet_vs_single`` prices the
+    scale-out: two pumps decoding two shared batches against one.
+
+    The fleet-merged percentiles come from BUCKET-MERGED per-replica
+    histogram deltas (``obs.fleet.merge_fleet_snapshots`` over the
+    timed window's ``serving.ttft_ms`` / ``serving.tpot_ms`` deltas
+    — summed buckets through ``histogram_quantile``, never averaged
+    per-replica percentiles), and a post-window ``FleetView`` poll
+    records per-replica liveness: ``bench_ops --regress``'s
+    ``check_fleet_wellformed`` fails the run if either replica was
+    not live (a half-dead fleet's tokens/s is a single-replica
+    number). CPU-valid like the sibling serving parts (identical xla
+    model on both legs) but GIL-shared on a 1-core container, so the
+    BASELINE floor is deliberately generous."""
+    import jax
+    import jax.numpy as jnp
+    from triton_dist_tpu.models import DenseLLM, Engine, ModelConfig
+    from triton_dist_tpu.obs import merge_snapshots
+    from triton_dist_tpu.obs.fleet import (
+        PERCENTILE_HISTOGRAMS, FleetView, merged_percentiles)
+    from triton_dist_tpu.serving import ModelServer
+    from triton_dist_tpu.serving.client import fanout
+
+    if on_tpu:
+        cfg = ModelConfig(hidden_size=512, intermediate_size=1024,
+                          num_hidden_layers=2, num_attention_heads=8,
+                          num_key_value_heads=8, head_dim=64,
+                          vocab_size=2048, max_position_embeddings=512,
+                          dtype=jnp.bfloat16)
+        gen_short, gen_long = 16, 96
+    else:
+        cfg = ModelConfig(hidden_size=32, intermediate_size=64,
+                          num_hidden_layers=1, num_attention_heads=4,
+                          num_key_value_heads=4, head_dim=8,
+                          vocab_size=64, max_position_embeddings=256,
+                          dtype=jnp.float32)
+        gen_short, gen_long = 4, 24
+    model = DenseLLM(cfg, mesh=mesh, axis="tp", impl="xla")
+    params = model.init(jax.random.PRNGKey(0))
+    clients, batch = 8, 2       # per-replica rows; fleet = 2 replicas
+    prompt_lens = [3, 5, 8, 4, 6, 7, 5, 3]
+    gens = [gen_long, gen_short, gen_long, gen_short] * 2
+    reqs = [{"prompt_ids": [[(7 * i + j) % (cfg.vocab_size - 1) + 1
+                             for j in range(pl)]],
+             "gen_len": g}
+            for i, (pl, g) in enumerate(zip(prompt_lens, gens))]
+
+    def scrape(srv):
+        return _scrape_metrics(srv.host, srv.port)
+
+    def run(n_replicas):
+        engines = [Engine(model, batch=batch,
+                          max_seq=cfg.max_position_embeddings,
+                          prefill_mode="xla_ar", decode_mode="gemm_ar")
+                   for _ in range(n_replicas)]
+        srvs = [ModelServer(eng, params, port=0, registry="private",
+                            replica_id=f"bench-r{i}").start()
+                for i, eng in enumerate(engines)]
+        eps = [(s.host, s.port) for s in srvs]
+        try:
+            # Same harness shape as _served_workload_run, fleet-wide:
+            # warm every replica's compiles, reset every replica's
+            # rolling windows, then time one round-robin fanout.
+            fanout(endpoints=eps,
+                   requests=[dict(r, gen_len=2) for r in reqs])
+            for s in srvs:
+                if s.scheduler is not None and s.scheduler.slo \
+                        is not None:
+                    s.scheduler.slo.reset_windows()
+            warm = {s.replica_id: scrape(s) for s in srvs}
+            t0 = time.perf_counter()
+            outs = fanout(endpoints=eps, requests=reqs)
+            dt = time.perf_counter() - t0
+            toks = sum(len(o["tokens"][0]) for o in outs
+                       if "tokens" in o)
+            errors = [o for o in outs if "tokens" not in o]
+            snaps = {s.replica_id: scrape(s) for s in srvs}
+            # Liveness during the window, from the fleet view itself.
+            view = FleetView(eps)
+            rows = view.poll()
+            return ((toks / dt if dt > 0 else 0.0), errors, warm,
+                    snaps, rows, view.scrape_metrics(evaluate=True))
+        finally:
+            for s in srvs:
+                s.stop()
+
+    tps_single, err_1, _, _, _, _ = run(1)
+    tps_fleet, err_2, warm, snaps, rows, merged = run(2)
+    extras["serving_fleet_clients"] = clients
+    extras["serving_fleet_replica_rows"] = batch
+    extras["serving_fleet_tokens_per_s"] = round(tps_fleet, 2)
+    extras["serving_fleet_single_tokens_per_s"] = round(tps_single, 2)
+    if tps_single > 0:
+        extras["serving_fleet_vs_single"] = round(
+            tps_fleet / tps_single, 4)
+    extras["serving_fleet_replica_ids"] = sorted(snaps)
+    extras["serving_fleet_down_replicas"] = sum(
+        1 for r in rows if r["status"] != "live")
+    # The liveness evidence the gate actually needs: per-replica
+    # retired-row DELTAS over the timed window. A replica whose pump
+    # died mid-window still answers health/metrics from its handler
+    # threads (status "live"), but its delta is zero — and the error
+    # counts catch the requests that degraded client-side. Both are
+    # gated by check_fleet_wellformed: a half-dead fleet must not
+    # publish its tokens/s as a 2-replica number.
+    extras["serving_fleet_replica_retired"] = [
+        int((snaps[rid].get("counters", {}).get("serving.retired", 0))
+            - (warm[rid].get("counters", {}).get("serving.retired", 0)))
+        for rid in sorted(snaps)]
+    extras["serving_fleet_error_count"] = len(err_2)
+    extras["serving_fleet_single_error_count"] = len(err_1)
+    if err_1 or err_2:
+        extras["serving_fleet_errors"] = [str(e)[:120]
+                                          for e in (err_1 + err_2)[:4]]
+    # Fleet percentiles of the timed window: per-replica histogram
+    # deltas, bucket-merged, interpolated from the SUMMED buckets
+    # (the shared fleet-percentile home, obs.fleet.merged_percentiles).
+    merged_deltas = {}
+    for name, _ in PERCENTILE_HISTOGRAMS:
+        deltas = [d for d in
+                  (_hist_delta(warm[rid], snaps[rid], name)
+                   for rid in snaps) if d]
+        if deltas:
+            merged_deltas[name] = merge_snapshots(
+                [{"histograms": {name: d}}
+                 for d in deltas])["histograms"][name]
+    for label, p in merged_percentiles(merged_deltas).items():
+        for qtag in ("p50", "p99"):
+            v = p[qtag]
+            extras[f"serving_fleet_{label}_{qtag}_ms"] = (
+                round(v, 3) if v is not None else None)
+    if merged is not None:
+        # The merged snapshot itself rides under extras.telemetry
+        # (tools/report.py "fleet" section) — extras stays a flat
+        # scalar map for the regress gate, like the waterfalls.
+        extras["fleet_snapshot"] = merged
+    return tps_fleet, extras.get("serving_fleet_vs_single")
+
+
 def _bench_prefix(mesh, n, on_tpu, extras):
     """Cross-request prefix caching (ISSUE 6): 8 clients sharing one
     long system preamble against the paged block-granular scheduler,
@@ -2025,6 +2179,8 @@ def main():
              lambda: _bench_serving_mega(mesh, n, on_tpu, extras)),
             ("serving_spec",
              lambda: _bench_serving_spec(mesh, n, on_tpu, extras)),
+            ("serving_fleet",
+             lambda: _bench_serving_fleet(mesh, n, on_tpu, extras)),
             ("prefix",
              lambda: _bench_prefix(mesh, n, on_tpu, extras)),
             ("sp_attn",
@@ -2060,6 +2216,15 @@ def main():
                     wf_acc[k] = extras.pop(k)
             if wf_acc:
                 tel["waterfalls"] = dict(wf_acc)
+            if "fleet_snapshot" in extras:
+                # The serving_fleet part's merged snapshot rides the
+                # same way (report.py "fleet" section); extras stays
+                # a flat scalar map for the regress gate.
+                fleet_acc = extras.pop("fleet_snapshot")
+            else:
+                fleet_acc = (extras.get("telemetry") or {}).get("fleet")
+            if fleet_acc:
+                tel["fleet"] = fleet_acc
             if any(tel.values()):
                 extras["telemetry"] = tel
             _checkpoint_extras(extras, name)

@@ -365,6 +365,93 @@ def test_spec_serving_wellformed_gate():
     assert len(check_spec_serving_wellformed(gone)) == 2
 
 
+def test_fleet_wellformed_gate():
+    """ISSUE 14 satellite: once the serving_fleet part ran, its
+    fleet-vs-single ratio must exist and be positive, its per-replica
+    rows must name >= 2 distinct replicas, no replica may have been
+    down, every replica must have RETIRED rows in the timed window
+    (a dead-pump replica still answers health from handler threads),
+    and neither timed leg may have request errors — a fanout
+    half-landing on a dead replica would publish a fleet tokens/s
+    that is really a single-replica number. A run that never measured
+    serving_fleet passes untouched."""
+    from triton_dist_tpu.tools.bench_ops import check_fleet_wellformed
+    assert check_fleet_wellformed({}) == []             # part didn't run
+    ok = {"serving_fleet_tokens_per_s": 1200.0,
+          "serving_fleet_vs_single": 0.84,
+          "serving_fleet_replica_ids": ["r0", "r1"],
+          "serving_fleet_down_replicas": 0,
+          "serving_fleet_replica_retired": [8, 8],
+          "serving_fleet_error_count": 0,
+          "serving_fleet_single_error_count": 0}
+    assert check_fleet_wellformed(ok) == []
+    for bad_val in (None, "fast", True, 0.0, -1.0):
+        fails = check_fleet_wellformed(
+            dict(ok, serving_fleet_vs_single=bad_val))
+        assert fails and "serving_fleet_vs_single" in fails[0], bad_val
+    for bad_ids in (None, [], ["r0"], ["r0", "r0"], "r0,r1"):
+        fails = check_fleet_wellformed(
+            dict(ok, serving_fleet_replica_ids=bad_ids))
+        assert fails and "replica_ids" in fails[0], bad_ids
+    fails = check_fleet_wellformed(
+        dict(ok, serving_fleet_down_replicas=1))
+    assert fails and "down" in fails[0]
+    fails = check_fleet_wellformed(
+        dict(ok, serving_fleet_down_replicas=None))
+    assert fails and "down_replicas" in fails[0]
+    # The dead-pump case: replica r1 answered health (not down) but
+    # retired nothing in the window — must fail.
+    for bad_ret in (None, [8], [8, 0], [8, True], [8, "x"]):
+        fails = check_fleet_wellformed(
+            dict(ok, serving_fleet_replica_retired=bad_ret))
+        assert fails and "replica_retired" in fails[0], bad_ret
+    # Errored requests in either timed leg fail too.
+    for key in ("serving_fleet_error_count",
+                "serving_fleet_single_error_count"):
+        fails = check_fleet_wellformed(dict(ok, **{key: 2}))
+        assert fails and key in fails[0]
+        fails = check_fleet_wellformed(dict(ok, **{key: None}))
+        assert fails and key in fails[0]
+    gone = {"serving_fleet_tokens_per_s": 1200.0}
+    assert len(check_fleet_wellformed(gone)) == 6
+
+
+def test_regress_gates_fleet(tmp_path):
+    """serving_fleet rides the full --regress path: a well-formed run
+    above the cpu floor passes; a down replica or a below-floor ratio
+    fails."""
+    import pathlib
+    from triton_dist_tpu.tools.bench_ops import run_regress
+    base = {"metric": "x", "extras": {
+        "ag_gemm_vs_xla": 1.0, "gemm_rs_vs_xla": 1.0,
+        "flash_decode_vs_xla": 1.0, "serving_sched_vs_serial": 50.0,
+        "serving_prefix_ttft_vs_cold": 6.0,
+        "serving_mega_vs_plain": 1.0, "serving_spec_vs_plain": 1.6,
+        "serving_fleet_vs_single": 0.84,
+        "serving_fleet_tokens_per_s": 1200.0,
+        "serving_fleet_replica_ids": ["r0", "r1"],
+        "serving_fleet_down_replicas": 0,
+        "serving_fleet_replica_retired": [8, 8],
+        "serving_fleet_error_count": 0,
+        "serving_fleet_single_error_count": 0,
+        "baseline_anomaly": None}}
+    repo_baseline = str(pathlib.Path(__file__).resolve().parents[1]
+                        / "BASELINE.json")
+    p = tmp_path / "ok.json"
+    p.write_text(json.dumps(base))
+    assert run_regress(repo_baseline, str(p), "cpu") == 0
+    bad = json.loads(json.dumps(base))
+    bad["extras"]["serving_fleet_down_replicas"] = 1
+    p2 = tmp_path / "down.json"
+    p2.write_text(json.dumps(bad))
+    assert run_regress(repo_baseline, str(p2), "cpu") == 1
+    low = json.loads(json.dumps(base))
+    low["extras"]["serving_fleet_vs_single"] = 0.1
+    p3 = tmp_path / "low.json"
+    p3.write_text(json.dumps(low))
+    assert run_regress(repo_baseline, str(p3), "cpu") == 1
+
+
 def test_bench_parts_typo_fails_before_checkpoint(tmp_path, monkeypatch):
     """A typo'd TDT_BENCH_PARTS must SystemExit before the checkpoint
     clear — prior evidence survives (review r5a-2)."""

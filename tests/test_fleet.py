@@ -1,0 +1,594 @@
+"""Fleet observability plane (obs/fleet.py, ISSUE 14).
+
+Quick tier. Covered here:
+
+- snapshot merge math BY KIND: counters sum, additive gauges sum /
+  point-in-time gauges max, histograms merge bucket-wise — the fleet
+  p99 interpolates the SUMMED buckets and is property-checked against
+  a numpy percentile golden over the concatenated raw samples (and
+  shown to differ from naively aggregated per-replica percentiles);
+- staleness transitions live → stale → down → recovered with an
+  injected clock and scrape function, a mid-scrape death degrading
+  one replica while the other stays fresh — never an exception;
+- ``placement_score`` ranking: queue depth, occupancy headroom, burn/
+  breach and breaker penalties, the loaded-below-idle acceptance case;
+- the two-live-``ModelServer`` acceptance scenario: both replicas
+  healthy with correct fleet-summed counters and bucket-merged p99,
+  private per-replica registries (``obs.scoped_registry``), kill one
+  → stale → down while the other's signals stay fresh;
+- the cheap ``{"cmd": "health"}`` verb (schema, monotonic seq,
+  replica_id stamping into metrics snapshots and flight-dump
+  filenames);
+- fleet Prometheus exposition (``replica`` labels, fleet rollup);
+- ``tools/fleet_top.py`` pure ``render()`` + ``--once`` against live
+  servers; ``tools/report.py``'s fleet section;
+- ``obs.scoped_registry`` thread isolation.
+"""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu import obs
+from triton_dist_tpu.obs import fleet
+from triton_dist_tpu.obs.fleet import (
+    FleetView, merge_fleet_snapshots, placement_score,
+    render_prometheus_fleet, replica_health)
+from triton_dist_tpu.obs.registry import Histogram, Registry
+
+
+# ---------------------------------------------------------------------------
+# Merge math.
+# ---------------------------------------------------------------------------
+
+def _hist_snapshot(samples, buckets=(1.0, 2.0, 5.0, 10.0, 50.0)):
+    h = Histogram("serving.ttft_ms", threading.Lock(), buckets)
+    for s in samples:
+        h.observe(s)
+    return h.to_dict()
+
+
+def test_merged_p99_matches_numpy_golden_property():
+    """Property check over random per-replica sample sets, two
+    invariants per seed:
+
+    1. EXACT: the merged quantile equals the quantile of one
+       histogram built from the concatenated raw samples — merging
+       bucket arrays must be indistinguishable from having observed
+       the union on one replica (any per-replica-percentile
+       aggregation breaks this on skewed splits);
+    2. GOLDEN: on dense tails the merged p99 lands within two bucket
+       widths of ``np.percentile`` over the concatenated samples
+       (bucket interpolation + order-statistic convention are the
+       only slack)."""
+    buckets = tuple(float(b) for b in np.linspace(1, 200, 40))
+    width = buckets[1] - buckets[0]
+    rng = np.random.default_rng(7)
+    for trial in range(10):
+        a = rng.uniform(1, 60, size=rng.integers(300, 2000))
+        b = rng.uniform(1, 190, size=rng.integers(300, 2000))
+        snap_a = {"histograms": {"h": _hist_snapshot(a, buckets)}}
+        snap_b = {"histograms": {"h": _hist_snapshot(b, buckets)}}
+        merged = merge_fleet_snapshots({"ra": snap_a, "rb": snap_b})
+        h = merged["histograms"]["h"]
+        assert h["count"] == len(a) + len(b)
+        np.testing.assert_array_equal(
+            h["counts"],
+            np.asarray(snap_a["histograms"]["h"]["counts"])
+            + np.asarray(snap_b["histograms"]["h"]["counts"]))
+        union = _hist_snapshot(np.concatenate([a, b]), buckets)
+        for q in (0.5, 0.9, 0.99):
+            got = obs.histogram_quantile(h, q)
+            assert got == pytest.approx(
+                obs.histogram_quantile(union, q)), (trial, q)
+        got = obs.histogram_quantile(h, 0.99)
+        want = np.percentile(np.concatenate([a, b]), 99)
+        assert abs(got - want) <= 2 * width + 1e-9, (trial, got, want)
+
+
+def test_merged_p99_is_not_per_replica_aggregate():
+    """A skewed split where naive per-replica aggregation is wrong:
+    one replica holds the slow tail, the other the fast bulk. The
+    bucket-sum p99 tracks the combined distribution; the mean of
+    per-replica p99s does not."""
+    buckets = tuple(float(b) for b in np.linspace(1, 101, 51))
+    fast = np.full(990, 3.0)        # bulk, replica A
+    slow = np.full(10, 95.0)        # tail, replica B
+    snap_a = {"histograms": {"h": _hist_snapshot(fast, buckets)}}
+    snap_b = {"histograms": {"h": _hist_snapshot(slow, buckets)}}
+    merged = merge_fleet_snapshots({"a": snap_a, "b": snap_b})
+    got = obs.histogram_quantile(merged["histograms"]["h"], 0.99)
+    want = np.percentile(np.concatenate([fast, slow]), 99)
+    width = buckets[1] - buckets[0]
+    assert abs(got - want) <= width + 1e-9
+    p99_a = obs.histogram_quantile(snap_a["histograms"]["h"], 0.99)
+    p99_b = obs.histogram_quantile(snap_b["histograms"]["h"], 0.99)
+    mean_of_p99 = (p99_a + p99_b) / 2
+    assert abs(mean_of_p99 - want) > 5 * width   # the wrong arithmetic
+
+
+def test_merge_counters_sum_gauges_by_kind():
+    a = {"counters": {"serving.admitted": 3, "serving.retired": 2},
+         "gauges": {"serving.queue_depth": 4.0,
+                    "serving.batch_occupancy": 2.0,
+                    "serving.rolling.ttft_p99_ms": 80.0},
+         "histograms": {}}
+    b = {"counters": {"serving.admitted": 5},
+         "gauges": {"serving.queue_depth": 1.0,
+                    "serving.batch_occupancy": 3.0,
+                    "serving.rolling.ttft_p99_ms": 120.0},
+         "histograms": {}}
+    m = merge_fleet_snapshots({"r0": a, "r1": b})
+    assert m["counters"]["serving.admitted"] == 8
+    assert m["counters"]["serving.retired"] == 2
+    # Additive gauges SUM (fleet queue depth is a total)…
+    assert m["gauges"]["serving.queue_depth"] == 5.0
+    assert m["gauges"]["serving.batch_occupancy"] == 5.0
+    # …point-in-time gauges keep the max (merge_snapshots semantics).
+    assert m["gauges"]["serving.rolling.ttft_p99_ms"] == 120.0
+    # Per-replica values retained verbatim.
+    assert m["replicas"] == ["r0", "r1"]
+    assert m["per_replica"]["r0"]["gauges"][
+        "serving.queue_depth"] == 4.0
+    assert m["per_replica"]["r1"]["counters"]["serving.admitted"] == 5
+
+
+# ---------------------------------------------------------------------------
+# placement_score.
+# ---------------------------------------------------------------------------
+
+def _health(queue=0, occ=0, batch=4, burn=0.0, breached=False,
+            breakers=0):
+    return {"queue_depth": queue, "batch_occupancy": occ,
+            "batch": batch,
+            "slo": {"ttft_p99": {"burn": burn, "burn_slow": burn,
+                                 "breached": breached}},
+            "breakers": {"open": breakers, "not_closed": {}}}
+
+
+def test_placement_score_ranks_loaded_below_idle():
+    idle = _health(queue=0, occ=0)
+    loaded = _health(queue=6, occ=4)        # injected queue depth
+    assert placement_score(idle) > placement_score(loaded)
+
+
+def test_placement_score_penalties():
+    base = placement_score(_health())
+    assert placement_score(_health(occ=2)) < base          # headroom
+    assert placement_score(_health(burn=3.0)) < base       # burn > 1
+    assert placement_score(_health(burn=0.5)) == base      # sustainable
+    assert placement_score(_health(breached=True)) < \
+        placement_score(_health(burn=3.0))                 # breach worst
+    assert placement_score(_health(breakers=1)) < base
+    assert placement_score(None) == float("-inf")
+    assert placement_score({}) == float("-inf") or \
+        placement_score({}) <= placement_score(_health())
+
+
+# ---------------------------------------------------------------------------
+# Staleness transitions (injected clock + scrape).
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_staleness_live_stale_down_recovered():
+    clock = _FakeClock()
+    answers = {}        # endpoint -> response dict or error dict
+
+    def scrape(endpoints, req):
+        return [answers[ep] for ep in endpoints]
+
+    ok = {"health": {"replica_id": "r0", "seq": 1, "uptime_s": 1.0}}
+    dead = {"error": "connection refused", "type": "ConnectionError"}
+    view = FleetView(["127.0.0.1:1"], stale_s_=5.0, down_s_=20.0,
+                     clock=clock, scrape=scrape)
+    ep = view.endpoints[0]
+
+    answers[ep] = ok
+    (row,) = view.poll()
+    assert row["status"] == "live" and row["replica_id"] == "r0"
+    assert row["score"] is not None
+
+    # Scrape fails: immediately not-live, last-good health RETAINED
+    # with its age reported — never an exception.
+    clock.t += 2.0
+    answers[ep] = dead
+    (row,) = view.poll()
+    assert row["status"] == "stale"
+    assert row["health"]["replica_id"] == "r0"   # last good, kept
+    assert row["age_s"] == pytest.approx(2.0)
+    assert row["error"]
+
+    # Still failing past down_s: down, excluded from placement.
+    clock.t += 25.0
+    (row,) = view.poll()
+    assert row["status"] == "down"
+    assert row["score"] is None
+    assert view.placement() == []
+
+    # A later good scrape recovers it to live.
+    answers[ep] = {"health": {"replica_id": "r0", "seq": 9,
+                              "uptime_s": 30.0}}
+    (row,) = view.poll()
+    assert row["status"] == "live" and row["seq"] == 9
+
+    # A SUCCESSFUL but old scrape also degrades by age (no poll ran).
+    clock.t += 6.0
+    (row,) = view.replicas()
+    assert row["status"] == "stale"
+
+
+def test_one_replica_dies_other_stays_fresh():
+    clock = _FakeClock()
+    state = {"b_alive": True}
+
+    def scrape(endpoints, req):
+        out = []
+        for ep in endpoints:
+            if ep[1] == 2 and not state["b_alive"]:
+                out.append({"error": "timed out",
+                            "type": "TimeoutError"})
+            else:
+                out.append({"health": {
+                    "replica_id": f"r{ep[1]}", "seq": 1,
+                    "uptime_s": 1.0, "queue_depth": 0,
+                    "batch_occupancy": 0, "batch": 4}})
+        return out
+
+    view = FleetView(["127.0.0.1:1", "127.0.0.1:2"], stale_s_=5.0,
+                     down_s_=20.0, clock=clock, scrape=scrape)
+    rows = view.poll()
+    assert [r["status"] for r in rows] == ["live", "live"]
+    state["b_alive"] = False
+    clock.t += 3.0
+    rows = view.poll()
+    assert [r["status"] for r in rows] == ["live", "stale"]
+    assert rows[0]["age_s"] < 1.0              # fresh
+    clock.t += 30.0
+    rows = view.poll()
+    assert [r["status"] for r in rows] == ["live", "down"]
+    # Placement only offers the live replica.
+    assert [rid for rid, _ in view.placement()] == ["r1"]
+
+
+def test_duplicate_replica_ids_do_not_collapse_in_merge():
+    """Two replicas (mis)configured with one replica_id must not
+    alias in the metrics merge — their counters would silently
+    halve; the view disambiguates by endpoint instead."""
+    clock = _FakeClock()
+
+    def scrape(endpoints, req):
+        return [{"metrics": {"replica_id": "same",
+                             "counters": {"serving.admitted": 2},
+                             "gauges": {}, "histograms": {}}}
+                for _ in endpoints]
+
+    view = FleetView(["127.0.0.1:1", "127.0.0.1:2"], clock=clock,
+                     scrape=scrape)
+    merged = view.scrape_metrics()
+    assert merged["counters"]["serving.admitted"] == 4
+    assert len(merged["replicas"]) == 2
+
+
+def test_fleetview_validates_config():
+    with pytest.raises(ValueError):
+        FleetView([])
+    with pytest.raises(ValueError):
+        FleetView(["127.0.0.1:1", "127.0.0.1:1"])
+    with pytest.raises(ValueError):
+        FleetView(["127.0.0.1:1"], stale_s_=10.0, down_s_=5.0)
+    with pytest.raises(ValueError):
+        fleet.parse_endpoint("no-port")
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition with replica labels.
+# ---------------------------------------------------------------------------
+
+def test_render_prometheus_fleet_labels():
+    a = {"counters": {"serving.admitted": 3},
+         "gauges": {"serving.queue_depth": 2.0},
+         "histograms": {"serving.ttft_ms": _hist_snapshot([1.5, 3.0])}}
+    b = {"counters": {"serving.admitted": 4},
+         "gauges": {"serving.queue_depth": 1.0},
+         "histograms": {"serving.ttft_ms": _hist_snapshot([8.0])}}
+    text = render_prometheus_fleet({"h:1": a, "h:2": b})
+    assert 'tdt_serving_admitted_total{replica="fleet"} 7' in text
+    assert 'tdt_serving_admitted_total{replica="h:1"} 3' in text
+    assert 'tdt_serving_admitted_total{replica="h:2"} 4' in text
+    # Additive gauge rollup sums.
+    assert 'tdt_serving_queue_depth{replica="fleet"} 3' in text
+    # Histograms: fleet rollup only, cumulative buckets.
+    assert 'tdt_serving_ttft_ms_bucket{replica="fleet",le="+Inf"} 3' \
+        in text
+    assert '{replica="h:1",le=' not in text
+    # One TYPE line per metric (samples grouped per the format spec).
+    assert text.count("# TYPE tdt_serving_admitted_total counter") == 1
+
+
+# ---------------------------------------------------------------------------
+# replica_health + scoped registries (no server needed).
+# ---------------------------------------------------------------------------
+
+def test_replica_health_reads_registry_lock_free():
+    reg = Registry()
+    reg.gauge("serving.queue_depth").set(3)
+    reg.gauge("serving.batch_occupancy").set(2)
+    reg.gauge("serving.rolling.ttft_p99_ms").set(42.5)
+    reg.gauge("serving.slo_burn.ttft_p99").set(1.5)
+    reg.gauge("serving.slo_burn.ttft_p99_slow").set(1.2)
+    reg.gauge("serving.slo_breached.ttft_p99").set(1.0)
+    reg.gauge("resilience.gemm_rs.breaker_state").set(1)
+    reg.gauge("resilience.breakers_open").set(1)
+    reg.counter("serving.admitted").inc(5)
+    h = replica_health("rX", 3, 0.0, registry=reg,
+                       clock=lambda: 12.0)
+    assert h["replica_id"] == "rX" and h["seq"] == 3
+    assert h["uptime_s"] == pytest.approx(12.0)
+    assert h["queue_depth"] == 3 and h["batch_occupancy"] == 2
+    assert h["rolling"]["ttft_p99_ms"] == 42.5
+    assert h["slo"]["ttft_p99"] == {"burn": 1.5, "burn_slow": 1.2,
+                                    "breached": True}
+    assert h["breakers"]["open"] == 1
+    assert h["breakers"]["not_closed"] == {"gemm_rs": 1}
+    assert h["counters"]["serving.admitted"] == 5
+    # The loaded replica scores below an idle one built the same way.
+    idle = replica_health("rY", 1, 0.0, registry=Registry())
+    assert placement_score(idle) > placement_score(h)
+
+
+def test_scoped_registry_routes_per_thread():
+    reg_a, reg_b = Registry(), Registry()
+    ready = threading.Barrier(2)
+
+    def work(reg, n):
+        with obs.scoped_registry(reg):
+            ready.wait(5)
+            for _ in range(n):
+                obs.counter("t.x").inc()
+
+    ta = threading.Thread(target=work, args=(reg_a, 3))
+    tb = threading.Thread(target=work, args=(reg_b, 5))
+    ta.start(); tb.start(); ta.join(5); tb.join(5)
+    assert reg_a.snapshot()["counters"]["t.x"] == 3
+    assert reg_b.snapshot()["counters"]["t.x"] == 5
+    # The global registry saw nothing, and this thread is unscoped.
+    assert "t.x" not in obs.snapshot().get("counters", {})
+    # Nested scopes restore the outer one.
+    with obs.scoped_registry(reg_a):
+        with obs.scoped_registry(reg_b):
+            obs.counter("t.y").inc()
+        obs.counter("t.y").inc()
+    assert reg_a.snapshot()["counters"]["t.y"] == 1
+    assert reg_b.snapshot()["counters"]["t.y"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Live two-replica acceptance scenario.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def tiny(mesh8, key):
+    from triton_dist_tpu.models import DenseLLM, ModelConfig
+    cfg = ModelConfig(hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=8,
+                      num_key_value_heads=8, head_dim=4, vocab_size=64,
+                      max_position_embeddings=64, dtype=jnp.float32)
+    model = DenseLLM(cfg, mesh=mesh8, axis="tp", impl="xla")
+    return model, model.init(key)
+
+
+def _server(model, params, rid):
+    from triton_dist_tpu.models import Engine
+    from triton_dist_tpu.serving import ModelServer
+    eng = Engine(model, batch=2, max_seq=64, prefill_mode="xla_ar",
+                 decode_mode="gemm_ar")
+    return ModelServer(eng, params, port=0, registry="private",
+                       replica_id=rid).start()
+
+
+def test_two_live_replicas_fleet_view(tiny):
+    """The ISSUE 14 acceptance scenario: two live ModelServer replicas
+    in one process (private registries), FleetView reports both
+    healthy with correct fleet-summed counters and bucket-merged p99;
+    killing one flips it stale → down within the configured ages
+    while the other's signals stay fresh — no exception, no stale
+    value presented as current."""
+    from triton_dist_tpu.serving.client import fanout
+    model, params = tiny
+    s0 = _server(model, params, "rep-a")
+    s1 = _server(model, params, "rep-b")
+    eps = [(s0.host, s0.port), (s1.host, s1.port)]
+    try:
+        # Round-robin traffic: 4 requests → 2 per replica.
+        outs = fanout(endpoints=eps,
+                      requests=[{"prompt_ids": [[i + 1, i + 2]],
+                                 "gen_len": 3} for i in range(4)])
+        assert all("tokens" in o for o in outs), outs
+
+        clock = _FakeClock()
+        view = FleetView(eps, stale_s_=5.0, down_s_=20.0, clock=clock)
+        rows = view.poll()
+        assert [r["status"] for r in rows] == ["live", "live"]
+        assert sorted(r["replica_id"] for r in rows) == \
+            ["rep-a", "rep-b"]
+        for r in rows:
+            assert r["health"]["counters"]["serving.retired"] == 2
+            assert r["seq"] >= 1
+
+        merged = view.scrape_metrics(evaluate=True)
+        # Fleet-summed counters: each replica retired exactly 2 rows
+        # in its OWN registry — a shared registry would double-count.
+        assert merged["counters"]["serving.retired"] == 4
+        assert merged["counters"]["serving.admitted"] == 4
+        # Bucket-merged TTFT: fleet count is the sum of both replicas'
+        # and the p99 interpolates the summed buckets.
+        h = merged["histograms"]["serving.ttft_ms"]
+        assert h["count"] == 4
+        per = merged["per_replica"]
+        assert sorted(per) == ["rep-a", "rep-b"]
+        assert view.fleet_quantile("serving.ttft_ms", 0.99) is not None
+        # TPOT merges bucket-wise too (the cumulative sibling
+        # histogram the scheduler now feeds).
+        assert merged["histograms"]["serving.tpot_ms"]["count"] == 4
+
+        # Kill replica b: the next poll degrades it to stale (last
+        # good health retained, age reported), then to down past the
+        # configured age — while replica a stays fresh throughout.
+        s1.stop()
+        clock.t += 1.0
+        rows = view.poll()
+        assert rows[0]["status"] == "live"
+        assert rows[1]["status"] == "stale"
+        assert rows[1]["health"]["replica_id"] == "rep-b"
+        assert rows[1]["age_s"] >= 1.0
+        clock.t += 25.0
+        rows = view.poll()
+        assert rows[0]["status"] == "live"
+        assert rows[1]["status"] == "down"
+        # The down replica leaves placement AND the metrics merge.
+        assert [rid for rid, _ in view.placement()] == ["rep-a"]
+        merged = view.scrape_metrics()
+        assert merged["replicas"] == ["rep-a"]
+        assert merged["counters"]["serving.retired"] == 2
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+def test_health_verb_and_replica_stamping(tiny):
+    from triton_dist_tpu.serving import ChatClient
+    model, params = tiny
+    srv = _server(model, params, "stamp-me")
+    try:
+        c = ChatClient(srv.host, srv.port)
+        assert "tokens" in c.generate_ids([[1, 2, 3]], gen_len=2)
+        h1 = c.health()
+        h2 = c.health()
+        assert h1["replica_id"] == "stamp-me"
+        assert h2["seq"] > h1["seq"]            # monotonic
+        assert h1["uptime_s"] >= 0
+        assert h1["batch"] == 2 and h1["max_waiting"] >= 1
+        assert h1["decode_path"] in ("plain", "mega", "auto")
+        assert "rolling" in h1 and "slo" in h1 and "breakers" in h1
+        # Metrics snapshots carry the id (merged snapshots from
+        # same-host replicas can't alias).
+        m = c.request({"cmd": "metrics"})["metrics"]
+        assert m["replica_id"] == "stamp-me"
+        # The cumulative TPOT histogram exists for the fleet merge.
+        assert "serving.tpot_ms" in m["histograms"]
+        # Flight-dump filenames carry the replica id.
+        d = c.dump_trace()
+        assert "stamp-me" in d["dumped"]
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_health_verb_serialized_path(tiny):
+    """The health verb works on a scheduler-less server too (no SLO
+    tracker to read — the dict is just sparser)."""
+    from triton_dist_tpu.models import Engine
+    from triton_dist_tpu.serving import ChatClient, ModelServer
+    model, params = tiny
+    eng = Engine(model, batch=1, max_seq=64, prefill_mode="xla_ar",
+                 decode_mode="gemm_ar")
+    srv = ModelServer(eng, params, port=0, scheduler=False).start()
+    try:
+        c = ChatClient(srv.host, srv.port)
+        h = c.health()
+        assert h["replica_id"] == f"{srv.host}:{srv.port}"
+        assert h["seq"] == 1
+        c.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet_top + report rendering.
+# ---------------------------------------------------------------------------
+
+def _row(rid, status, age=0.1, queue=0, occ=0, score=0.5, err=None):
+    return {"endpoint": rid, "replica_id": rid, "status": status,
+            "age_s": age, "seq": 1, "error": err, "score": score,
+            "health": {"queue_depth": queue, "batch_occupancy": occ,
+                       "batch": 4,
+                       "rolling": {"ttft_p50_ms": 10.0,
+                                   "ttft_p99_ms": 40.0},
+                       "slo": {"ttft_p99": {"breached": queue > 4}}}}
+
+
+def test_fleet_top_render_pure():
+    from triton_dist_tpu.tools import fleet_top
+    merged = merge_fleet_snapshots(
+        {"r0": {"histograms":
+                {"serving.ttft_ms": _hist_snapshot([2.0, 9.0])},
+                "counters": {"serving.retired": 2}},
+         "r1": {"histograms":
+                {"serving.ttft_ms": _hist_snapshot([4.0])},
+                "counters": {"serving.retired": 1}}})
+    screen = fleet_top.render({
+        "replicas": [_row("h:1", "live", queue=6),
+                     _row("h:2", "stale", age=7.2),
+                     _row("h:3", "down", score=None,
+                          err="connection refused")],
+        "merged": merged})
+    assert "1 live / 1 stale / 1 down" in screen
+    assert "h:1" in screen and "stale" in screen and "down" in screen
+    assert "7.2" in screen                  # stale age visible
+    assert "bucket-merged, n 3" in screen   # fleet rollup line
+    assert "connection refused" in screen
+    # Pure render: no replicas → friendly empty screen.
+    assert "(no replicas)" in fleet_top.render({"replicas": []})
+
+
+def test_fleet_top_once_live(tiny, capsys):
+    from triton_dist_tpu.tools import fleet_top
+    model, params = tiny
+    s0 = _server(model, params, "ft-a")
+    s1 = _server(model, params, "ft-b")
+    try:
+        rc = fleet_top.main(
+            ["--endpoints",
+             f"{s0.host}:{s0.port},{s1.host}:{s1.port}", "--once"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "2 replica(s) (2 live" in out
+        assert "ft-a" in out and "ft-b" in out
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+def test_report_fleet_section():
+    from triton_dist_tpu.tools.report import render_fleet, \
+        render_telemetry
+    merged = merge_fleet_snapshots(
+        {"r0": {"gauges": {"serving.queue_depth": 2.0},
+                "counters": {"serving.admitted": 3,
+                             "serving.retired": 3},
+                "histograms": {"serving.ttft_ms":
+                               _hist_snapshot([1.5, 3.0])}},
+         "r1": {"gauges": {"serving.queue_depth": 0.0},
+                "counters": {"serving.admitted": 1,
+                             "serving.retired": 1},
+                "histograms": {"serving.ttft_ms":
+                               _hist_snapshot([9.0])}}})
+    md = render_fleet(merged)
+    assert "#### fleet" in md
+    assert "replicas: r0, r1" in md
+    assert "| r0 | 2 |" in md
+    assert "bucket-merged" in md
+    assert render_fleet(None) == ""
+    # Rides inside render_telemetry under the "fleet" key.
+    full = render_telemetry({"counters": {}, "gauges": {},
+                             "histograms": {}, "fleet": merged})
+    assert "#### fleet" in full

@@ -429,6 +429,21 @@ def test_select_passes_for_changed_files():
     assert select_passes_for(["README.md"]) == []
 
 
+def test_select_passes_fleet_watches():
+    """ISSUE 14 satellite: editing the fleet plane or its dashboard
+    re-runs BOTH the metric-catalog pass (new fleet.* /
+    serving.replica_* emissions must stay cataloged) and the
+    annotation-coverage pass (a fleet-plane edit that touched the
+    pump's read path must re-verify the device.step labels) under
+    ``--changed``."""
+    for path in ("triton_dist_tpu/obs/fleet.py",
+                 "triton_dist_tpu/tools/fleet_top.py"):
+        names = select_passes_for([path])
+        assert "metric-catalog" in names, path
+        assert "annotation-coverage" in names, path
+        assert "ring-protocol" not in names, path
+
+
 def test_driver_changed_scopes_to_diff(monkeypatch, capsys):
     monkeypatch.setattr(tdt_check, "changed_files",
                         lambda root=None: ["triton_dist_tpu/ops/p2p.py"])

@@ -234,11 +234,14 @@ def _vmem_pass(root):
                "agree, both directions",
                # The package-wide glob already covers serving/ and
                # models/spec.py; the explicit entries pin the ISSUE-13
-               # contract (spec telemetry stays cataloged) against a
-               # future narrowing of the package glob.
+               # contract (spec telemetry stays cataloged) — and the
+               # ISSUE-14 one (fleet/fleet_top telemetry likewise) —
+               # against a future narrowing of the package glob.
                watches=("triton_dist_tpu/", "docs/observability.md",
                         "triton_dist_tpu/serving/",
-                        "triton_dist_tpu/models/spec.py"))
+                        "triton_dist_tpu/models/spec.py",
+                        "triton_dist_tpu/obs/fleet.py",
+                        "triton_dist_tpu/tools/fleet_top.py"))
 def _metrics_pass(root):
     from triton_dist_tpu.analysis import lint_metrics
     return lint_metrics.run(root)
@@ -279,11 +282,16 @@ def _fallback_pass(root):
                # pump's step labels now name three paths (mega/plain/
                # spec — ISSUE 13), and a spec change that re-routes the
                # decode verb must re-run this pass; models/spec.py
-               # rides along for the same reason.
+               # rides along for the same reason. The fleet surfaces
+               # (ISSUE 14) ride too: a fleet-plane edit that touched
+               # the pump's read path must re-verify the device.step
+               # labels under --changed.
                watches=("triton_dist_tpu/resilience/router.py",
                         "triton_dist_tpu/obs/devprof.py",
                         "triton_dist_tpu/serving/",
                         "triton_dist_tpu/models/spec.py",
+                        "triton_dist_tpu/obs/fleet.py",
+                        "triton_dist_tpu/tools/fleet_top.py",
                         "triton_dist_tpu/analysis/lint_annotations.py"))
 def _annotation_pass(root):
     from triton_dist_tpu.analysis import lint_annotations

@@ -22,6 +22,15 @@ its static BASELINE floors, and ``obs.attrib`` keeps per-request
 latency waterfalls (queue → prefill → decode) the server returns
 inline and ``tools/top.py`` renders live.
 
+The fleet plane (ISSUE 14, ``obs.fleet``) lifts all of it across N
+replicas: per-replica ``ReplicaHealth`` snapshots behind the server's
+cheap ``{"cmd": "health"}`` verb, a ``FleetView`` aggregator that
+scrapes endpoints concurrently, tracks staleness (live → stale →
+down), and merges snapshots correctly by metric kind, plus the
+``placement_score`` the multi-replica router will consume
+(docs/observability.md "Fleet view"). Several replicas in one process
+keep distinct metrics via ``obs.scoped_registry``.
+
 Disabled by default at zero hot-path cost; flip metrics on with
 ``obs.enable()`` (the ModelServer does this at construction;
 ``TDT_TRACE=1`` makes that enable tracing too).
@@ -47,6 +56,7 @@ from triton_dist_tpu.obs.registry import (  # noqa: F401
     histogram,
     record_comm,
     reset,
+    scoped_registry,
     set_registry,
     snapshot,
     span,
@@ -58,7 +68,7 @@ from triton_dist_tpu.obs.exposition import (  # noqa: F401
     render_prometheus,
 )
 from triton_dist_tpu.obs import (  # noqa: F401
-    attrib, devprof, flight, perfwatch, slo, trace)
+    attrib, devprof, fleet, flight, perfwatch, slo, trace)
 from triton_dist_tpu.obs.slo import (  # noqa: F401
     SLOTarget,
     SLOTracker,

@@ -38,7 +38,8 @@ from triton_dist_tpu.obs import registry as _registry
 from triton_dist_tpu.obs import trace as _trace
 
 __all__ = ["dump", "flight_seconds", "install_signal_handlers",
-           "last_record", "maybe_dump", "reset", "trace_dir"]
+           "last_record", "maybe_dump", "replica_id", "reset",
+           "set_replica_id", "trace_dir"]
 
 DEFAULT_FLIGHT_SECONDS = 30.0
 
@@ -50,6 +51,23 @@ _LAST: dict | None = None           # {"path", "reason", "ts", "count"}
 _COUNT = 0
 _LAST_BY_REASON: dict[str, float] = {}
 _SIGTERM_INSTALLED = False
+_REPLICA_ID: str | None = None
+
+
+def set_replica_id(rid: str | None) -> None:
+    """Stamp a replica identity into every later dump: the filename
+    gains a ``_r<id>`` segment and the trace metadata a
+    ``replica_id`` key, so flight records from two same-host replicas
+    can never alias in a merged Perfetto view (ISSUE 14; the
+    ``ModelServer`` calls this at construction — in a multi-server
+    process the LAST server wins, which matches the shared tracer
+    those servers also share)."""
+    global _REPLICA_ID
+    _REPLICA_ID = str(rid) if rid else None
+
+
+def replica_id() -> str | None:
+    return _REPLICA_ID
 
 
 def flight_seconds() -> float:
@@ -88,16 +106,25 @@ def dump(reason: str, last_s: float | None = None) -> str | None:
         return None
     from triton_dist_tpu.tools import trace_export as _texp
     window = last_s if last_s is not None else flight_seconds()
+    meta = {"reason": reason, "window_s": window,
+            "unix_time": time.time()}
+    if _REPLICA_ID:
+        meta["replica_id"] = _REPLICA_ID
     chrome = _texp.to_chrome(_trace.collect(last_s=window),
-                             metadata={"reason": reason,
-                                       "window_s": window,
-                                       "unix_time": time.time()})
+                             metadata=meta)
     d = trace_dir()
     os.makedirs(d, exist_ok=True)
-    safe = "".join(c if c.isalnum() or c in "-_" else "_"
-                   for c in reason)[:64]
+
+    def _safe(s, n=64):
+        return "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in s)[:n]
+
+    safe = _safe(reason)
+    # The replica segment keeps two same-host replicas' dumps
+    # filename-distinct even at identical millisecond timestamps.
+    rep = f"_r{_safe(_REPLICA_ID, 48)}" if _REPLICA_ID else ""
     path = os.path.join(
-        d, f"flight_{safe}_h{_texp._host_index()}"
+        d, f"flight_{safe}{rep}_h{_texp._host_index()}"
            f"_{int(time.time() * 1e3)}_{os.getpid()}.trace.json")
     with open(path, "w") as f:
         json.dump(chrome, f)
@@ -169,8 +196,9 @@ def install_signal_handlers() -> bool:
 def reset() -> None:
     """Drop process-local recorder state (tests). The SIGTERM handler
     is left installed — it re-checks tracing at fire time."""
-    global _LAST, _COUNT
+    global _LAST, _COUNT, _REPLICA_ID
     with _LOCK:
         _LAST = None
         _COUNT = 0
         _LAST_BY_REASON.clear()
+        _REPLICA_ID = None

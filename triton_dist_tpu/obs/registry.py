@@ -39,7 +39,7 @@ __all__ = [
     "DEFAULT_MS_BUCKETS", "Counter", "Gauge", "Histogram", "Registry",
     "NullRegistry", "enable", "disable", "enabled", "env_int",
     "get_registry", "set_registry", "counter", "gauge", "histogram",
-    "snapshot", "reset", "span", "record_comm",
+    "scoped_registry", "snapshot", "reset", "span", "record_comm",
 ]
 
 def env_int(name: str, default: int, minimum: int | None = None) -> int:
@@ -284,6 +284,60 @@ class NullRegistry:
 _NULL_REGISTRY = NullRegistry()
 _REGISTRY = _NULL_REGISTRY
 
+#: Thread-scoped registry overrides (``scoped_registry``). ``_SCOPED``
+#: is a monotonic fast-path guard: until the FIRST scope is installed
+#: anywhere in the process, every emission resolves the registry with
+#: one module-global read — the zero-overhead-when-unused contract.
+#: Once a process runs replica-scoped servers (ISSUE 14) each emission
+#: additionally pays one ``threading.local`` attribute lookup.
+_TLS = threading.local()
+_SCOPED = False
+
+
+def _current():
+    if _SCOPED:
+        reg = getattr(_TLS, "registry", None)
+        if reg is not None:
+            return reg
+    return _REGISTRY
+
+
+class scoped_registry:
+    """Route THIS thread's module-level metric emissions
+    (``obs.counter``/``gauge``/``histogram``/``span``/``snapshot``)
+    into ``registry`` for the duration of the ``with`` block.
+
+    This is how several ``ModelServer`` replicas coexist in one
+    process without aliasing each other's serving metrics
+    (docs/observability.md "Fleet view"): each replica's handler
+    threads and scheduler pump wrap their work in its private
+    registry, so per-replica snapshots stay distinct and the fleet
+    merge's counter sums are correct. ``registry=None`` is a no-op
+    (the global registry keeps receiving), so call sites need no
+    branching. Re-entrant per thread (the previous scope is restored
+    on exit); scopes never leak across threads."""
+
+    __slots__ = ("_registry", "_prev", "_installed")
+
+    def __init__(self, registry):
+        self._registry = registry
+        self._installed = False
+
+    def __enter__(self):
+        global _SCOPED
+        if self._registry is not None:
+            self._prev = getattr(_TLS, "registry", None)
+            _TLS.registry = self._registry
+            _SCOPED = True
+            self._installed = True
+        return self._registry
+
+    def __exit__(self, *exc):
+        if self._installed:
+            _TLS.registry = self._prev
+            self._installed = False
+        return False
+
 
 def get_registry():
     return _REGISTRY
@@ -323,23 +377,23 @@ def enabled() -> bool:
 
 
 def counter(name: str):
-    return _REGISTRY.counter(name)
+    return _current().counter(name)
 
 
 def gauge(name: str):
-    return _REGISTRY.gauge(name)
+    return _current().gauge(name)
 
 
 def histogram(name: str, buckets=DEFAULT_MS_BUCKETS):
-    return _REGISTRY.histogram(name, buckets)
+    return _current().histogram(name, buckets)
 
 
 def snapshot() -> dict:
-    return _REGISTRY.snapshot()
+    return _current().snapshot()
 
 
 def reset() -> None:
-    _REGISTRY.reset()
+    _current().reset()
 
 
 # ---------------------------------------------------------------------------
@@ -383,7 +437,7 @@ def _enter_annotate(name: str):
         cm.__enter__()
         return cm
     except Exception as e:  # noqa: BLE001 — degrade, never break the span
-        _REGISTRY.counter("obs.span.annotate_unavailable").inc()
+        _current().counter("obs.span.annotate_unavailable").inc()
         if not _ANNOTATE_WARNED:
             _ANNOTATE_WARNED = True
             warnings.warn(
@@ -444,7 +498,7 @@ def span(name: str, buckets=DEFAULT_MS_BUCKETS, cat: str | None = None,
     clock read, no annotation) — the form the engine decode loop
     relies on for its zero-overhead-when-disabled contract. With only
     tracing on, the histogram side records into the no-op registry."""
-    reg = _REGISTRY
+    reg = _current()
     if reg is _NULL_REGISTRY and not _trace.enabled():
         return _NULL_SPAN
     return _Span(reg.histogram(name + "_ms", buckets), name, cat, args)
@@ -465,7 +519,7 @@ def record_comm(op: str, *arrays) -> None:
     an instant event (category ``op``) carrying the op name and byte
     count — the hook that puts every op entry a request touches onto
     that request's trace-ID track."""
-    reg = _REGISTRY
+    reg = _current()
     tracing = _trace.enabled()
     if reg is _NULL_REGISTRY and not tracing:
         return

@@ -8,6 +8,15 @@ client forever. :func:`fanout` is the small concurrent-client helper
 the serving bench and the scheduler load tests drive their traffic
 through: one connection + thread per request, responses in request
 order.
+
+Multi-endpoint mode (ISSUE 14): ``ChatClient(endpoints=[...])`` holds
+one lazy connection per replica and round-robins generation/control
+requests across them (per-endpoint timeouts — the same ``timeout=``
+machinery, applied per connection); ``health()`` speaks the server's
+cheap ``{"cmd": "health"}`` verb; ``fanout(endpoints=[...])``
+round-robins a request list across replicas — the client-side fanout
+behind ``bench.py``'s ``serving_fleet`` part and
+``obs.fleet.FleetView``'s concurrent scrapes.
 """
 
 from __future__ import annotations
@@ -21,33 +30,108 @@ import threading
 _UNSET = object()
 
 
+def _parse_endpoint(ep) -> tuple:
+    """``(host, port)`` from ``"host:port"`` / ``(host, port)`` —
+    one parser for every multi-endpoint surface (the fleet view's
+    ``obs.fleet.parse_endpoint``; obs never imports serving, so no
+    cycle)."""
+    from triton_dist_tpu.obs.fleet import parse_endpoint
+    return parse_endpoint(ep)
+
+
 class ChatClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 8777,
-                 tokenizer=None, timeout: float | None = None):
+                 tokenizer=None, timeout: float | None = None,
+                 endpoints=None):
         """``timeout``: seconds each protocol round trip may take
         (connect included) before ``TimeoutError``; ``None`` blocks
-        indefinitely (the historical behavior)."""
-        self.addr = (host, port)
+        indefinitely (the historical behavior). ``endpoints``: a list
+        of ``"host:port"`` / ``(host, port)`` replicas — requests
+        round-robin across them over one lazy persistent connection
+        each (``host``/``port`` are ignored then); the single-endpoint
+        form keeps its eager connect, so a refused connection still
+        fails at construction."""
         self.tokenizer = tokenizer
         self.timeout = timeout
-        self._sock = socket.create_connection(self.addr, timeout=timeout)
-        self._file = self._sock.makefile("rwb")
+        if endpoints:
+            self.endpoints = [_parse_endpoint(e) for e in endpoints]
+        else:
+            self.endpoints = [(host, int(port))]
+        self.addr = self.endpoints[0]
+        self._conns: dict = {}          # endpoint -> (sock, file)
+        self._rr = 0
+        self._lock = threading.Lock()   # rr index + conn/lock creation
+        self._ep_locks: dict = {}       # endpoint -> round-trip lock
+        if not endpoints:
+            self._conn(self.endpoints[0])   # eager: historical contract
 
-    def request(self, req: dict, timeout=_UNSET) -> dict:
+    def _conn(self, ep, connect_timeout=_UNSET):
+        """The endpoint's persistent connection, created lazily. The
+        blocking connect runs OUTSIDE the client-wide lock (the lock
+        only publishes the result) — a wedged replica must not stall
+        requests to the healthy ones — and honors the caller's
+        per-call timeout: in multi-endpoint mode first contact with a
+        replica happens inside request(), so the override has to
+        cover the connect, not just the round trip."""
+        with self._lock:
+            c = self._conns.get(ep)
+        if c is not None:
+            return c
+        to = self.timeout if connect_timeout is _UNSET else connect_timeout
+        s = socket.create_connection(ep, timeout=to)
+        s.settimeout(self.timeout)
+        with self._lock:
+            raced = self._conns.get(ep)
+            if raced is not None:
+                c = raced            # another thread won; drop ours
+            else:
+                c = self._conns[ep] = (s, s.makefile("rwb"))
+        if c[0] is not s:
+            try:
+                s.close()
+            except OSError:
+                pass
+        return c
+
+    def _ep_lock(self, ep):
+        with self._lock:
+            lk = self._ep_locks.get(ep)
+            if lk is None:
+                lk = self._ep_locks[ep] = threading.Lock()
+        return lk
+
+    def _next_endpoint(self) -> tuple:
+        with self._lock:
+            ep = self.endpoints[self._rr % len(self.endpoints)]
+            self._rr += 1
+        return ep
+
+    def request(self, req: dict, timeout=_UNSET, endpoint=None) -> dict:
         """One protocol round trip with an arbitrary request object
         (generation or control-plane, e.g. ``{"cmd": "metrics"}``).
         ``timeout`` overrides the client default for this call only
-        (``socket.timeout`` is a ``TimeoutError``; the connection is
-        left in an undefined protocol state after one — reconnect)."""
-        if timeout is not _UNSET:
-            self._sock.settimeout(timeout)
-        try:
-            self._file.write((json.dumps(req) + "\n").encode())
-            self._file.flush()
-            line = self._file.readline()
-        finally:
+        (``socket.timeout`` is a ``TimeoutError``; that endpoint's
+        connection is left in an undefined protocol state after one —
+        reconnect). ``endpoint`` pins the replica; otherwise
+        multi-endpoint clients round-robin. Thread-safe: each
+        endpoint's write→read round trip runs under a per-endpoint
+        lock, so concurrent callers sharing one client serialize per
+        connection instead of interleaving protocol bytes (use
+        :func:`fanout` for genuinely concurrent traffic — one fresh
+        connection per request)."""
+        ep = (_parse_endpoint(endpoint) if endpoint is not None
+              else self._next_endpoint())
+        with self._ep_lock(ep):
+            sock, file = self._conn(ep, connect_timeout=timeout)
             if timeout is not _UNSET:
-                self._sock.settimeout(self.timeout)
+                sock.settimeout(timeout)
+            try:
+                file.write((json.dumps(req) + "\n").encode())
+                file.flush()
+                line = file.readline()
+            finally:
+                if timeout is not _UNSET:
+                    sock.settimeout(self.timeout)
         if not line:
             raise ConnectionError("server closed the connection")
         return json.loads(line)
@@ -83,6 +167,19 @@ class ChatClient:
             req["last"] = last
         return self.request(req).get("requests", [])
 
+    def health(self, endpoint=None, timeout=_UNSET) -> dict:
+        """One replica's compact ``ReplicaHealth`` snapshot via the
+        cheap ``{"cmd": "health"}`` verb — lock-free server-side reads,
+        no SLO force-evaluation (docs/observability.md "Fleet view").
+        Round-robins like any request; pin a replica with
+        ``endpoint=``. Raises ``RuntimeError`` on an error reply (an
+        old server without the verb)."""
+        resp = self.request({"cmd": "health"}, timeout=timeout,
+                            endpoint=endpoint)
+        if "health" not in resp:
+            raise RuntimeError(resp.get("error", f"bad reply {resp!r}"))
+        return resp["health"]
+
     def chat(self, text: str, gen_len: int = 64) -> str:
         assert self.tokenizer is not None, "text chat needs a tokenizer"
         ids = self.tokenizer(text, return_tensors="np")["input_ids"]
@@ -92,24 +189,46 @@ class ChatClient:
         return self.tokenizer.decode(resp["tokens"][0])
 
     def close(self):
-        self._file.close()
-        self._sock.close()
+        with self._lock:
+            conns, self._conns = list(self._conns.values()), {}
+        for sock, file in conns:
+            try:
+                file.close()
+                sock.close()
+            except OSError:
+                pass
 
 
-def fanout(host: str, port: int, requests: list,
-           timeout: float | None = None) -> list:
+def fanout(host: str | None = None, port: int | None = None,
+           requests: list | None = None,
+           timeout: float | None = None, endpoints=None) -> list:
     """Issue ``requests`` (protocol dicts) CONCURRENTLY — one fresh
     connection and thread per request — and return the responses in
     request order. A request that fails client-side (timeout, refused
     connection) yields an ``{"error", "type"}`` dict in its slot, so
     the caller can count failures without unwinding the others. This
     is the concurrent-client helper behind bench.py's
-    ``serving_throughput`` probe and the scheduler load tests."""
+    ``serving_throughput`` probe and the scheduler load tests.
+
+    ``endpoints=[...]`` replaces ``host``/``port`` with a replica
+    list: request ``i`` goes to ``endpoints[i % len(endpoints)]`` —
+    the client-side round-robin the ``serving_fleet`` bench and
+    ``obs.fleet.FleetView`` ride (per-request timeout, so one wedged
+    replica cannot stall the other slots)."""
+    if endpoints:
+        eps = [_parse_endpoint(e) for e in endpoints]
+    else:
+        if host is None or port is None:
+            raise ValueError("fanout needs host+port or endpoints=")
+        eps = [(host, int(port))]
+    if requests is None:
+        raise ValueError("fanout needs requests")
     results: list = [None] * len(requests)
 
     def worker(i: int, payload: dict) -> None:
+        h, p = eps[i % len(eps)]
         try:
-            c = ChatClient(host, port, timeout=timeout)
+            c = ChatClient(h, p, timeout=timeout)
             try:
                 results[i] = c.request(payload)
             finally:

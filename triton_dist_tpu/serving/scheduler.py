@@ -156,9 +156,18 @@ class Scheduler:
 
     def __init__(self, engine, params, max_waiting: int | None = None,
                  prefill_chunk: int | None = None, slo_tracker=None,
-                 devprof_sampler=None):
+                 devprof_sampler=None, replica_id: str | None = None,
+                 registry=None):
         self.engine = engine
         self.params = params
+        # Fleet identity (ISSUE 14): stamped into this scheduler's
+        # admit/retire trace instants so two same-host replicas'
+        # merged Perfetto streams cannot alias, and — via
+        # ``registry`` + obs.scoped_registry on the pump thread —
+        # into a per-replica metrics registry when the server runs
+        # several replicas in one process.
+        self.replica_id = replica_id
+        self._registry = registry
         if max_waiting is None:
             max_waiting = obs.env_int("TDT_MAX_WAITING",
                                       DEFAULT_MAX_WAITING)
@@ -323,6 +332,14 @@ class Scheduler:
         return (trace.bind(req.trace_id) if req.trace_id
                 else contextlib.nullcontext())
 
+    def _targs(self, args: dict) -> dict:
+        """Stamp this scheduler's replica identity into a trace-event
+        args dict (ISSUE 14): two same-host replicas' admit/retire
+        streams stay distinguishable in a merged Perfetto view."""
+        if self.replica_id:
+            args["replica"] = self.replica_id
+        return args
+
     def _fail(self, req: Request, exc: BaseException) -> None:
         req.error = exc
         req.done.set()
@@ -336,6 +353,20 @@ class Scheduler:
         ``_running`` still True would otherwise hang every
         ``result()`` caller forever."""
         rows: dict[int, Request] = {}        # occupied rows (any state)
+        # The pump's emissions (and everything the engine work it
+        # drives emits on this thread — loop, failure accounting, and
+        # shutdown drain alike) land in the replica's own registry
+        # when one was given; scoped_registry(None) is a no-op (the
+        # process-global registry keeps receiving).
+        with obs.scoped_registry(self._registry):
+            exc = self._pump_guarded(rows)
+        if exc is not None:
+            # The waiters already carry the exception; re-raising from
+            # a daemon thread would only add unhandled-thread noise.
+            warnings.warn(f"scheduler pump died: {exc!r}",
+                          RuntimeWarning, stacklevel=2)
+
+    def _pump_guarded(self, rows: dict) -> BaseException | None:
         exc: BaseException | None = None
         try:
             self._pump_loop(rows)
@@ -369,11 +400,7 @@ class Scheduler:
                     self.devprof.close()
                 except Exception:  # noqa: BLE001 — shutdown best-effort
                     pass
-        if exc is not None:
-            # The waiters already carry the exception; re-raising from
-            # a daemon thread would only add unhandled-thread noise.
-            warnings.warn(f"scheduler pump died: {exc!r}",
-                          RuntimeWarning, stacklevel=2)
+        return exc
 
     def _pump_loop(self, rows: dict) -> None:
         sess = self.engine.stream_session(self.params)
@@ -410,12 +437,20 @@ class Scheduler:
                     prefill_chunks=req.chunks,
                     draft_ms=req.draft_ms, verify_ms=req.verify_ms)
                 attrib.push(req.timing)
-                if self.slo is not None and req.timing["tpot_ms"] \
-                        is not None:
-                    self.slo.observe("tpot", req.timing["tpot_ms"])
+                if req.timing["tpot_ms"] is not None:
+                    # Cumulative TPOT histogram next to the rolling
+                    # window: per-replica snapshots of it merge
+                    # BUCKET-WISE into the fleet TPOT percentiles
+                    # (obs.fleet.merge_fleet_snapshots — a fleet p99
+                    # must come from summed buckets, never from
+                    # averaging per-replica percentiles).
+                    obs.histogram("serving.tpot_ms").observe(
+                        req.timing["tpot_ms"])
+                    if self.slo is not None:
+                        self.slo.observe("tpot", req.timing["tpot_ms"])
                 trace.emit("i", "serving.retire", "serving",
-                           args={"row": row, "rid": req.rid,
-                                 "tokens": len(req.tokens)},
+                           args=self._targs({"row": row, "rid": req.rid,
+                                             "tokens": len(req.tokens)}),
                            trace_id=req.trace_id)
                 req.done.set()
 
@@ -427,10 +462,11 @@ class Scheduler:
                 self.slo.observe("queue_wait", qw_ms)
             obs.counter("serving.admitted").inc()
             trace.emit("i", "serving.admit", "serving",
-                       args={"row": row, "rid": req.rid,
-                             "prompt_len": len(req.prompt),
-                             "queued_ms": round(
-                                 (req.t_admit - req.t_submit) * 1e3, 3)},
+                       args=self._targs({
+                           "row": row, "rid": req.rid,
+                           "prompt_len": len(req.prompt),
+                           "queued_ms": round(
+                               (req.t_admit - req.t_submit) * 1e3, 3)}),
                        trace_id=req.trace_id)
             try:
                 with self._bind(req):

@@ -24,17 +24,41 @@ instead of stalling the connection —
        "max_waiting": M}
 
 Telemetry (docs/observability.md): a metrics request on the same
-protocol returns the process-local registry snapshot —
+protocol returns the server's registry snapshot, stamped with this
+replica's identity —
 
     → {"cmd": "metrics"}
-    ← {"metrics": {"counters": ..., "gauges": ..., "histograms": ...}}
+    ← {"metrics": {"counters": ..., "gauges": ..., "histograms": ...,
+                   "replica_id": "host:port"}}
 
 with ``"format": "prometheus"`` adding a ``prometheus`` text-exposition
 field for scrapers; a metrics scrape first forces a fresh SLO
 evaluation, so the ``serving.rolling.*`` / ``serving.slo_burn.*``
-gauges are current as of the reply (``tools/top.py`` polls this).
+gauges are current as of the reply (``"evaluate": false`` skips that —
+the last-evaluated gauges are returned as-is, which is what a 1 Hz
+dashboard over N replicas should ask for).
 Constructing a ModelServer enables the telemetry registry
 (``telemetry=False`` opts out).
+
+The fleet control surface (ISSUE 14, docs/observability.md "Fleet
+view"): every server carries a stable ``replica_id`` (ctor >
+``TDT_REPLICA_ID`` > ``host:port``) stamped into its metrics
+snapshot, its scheduler's trace instants, and its flight-dump
+filenames, and answers the CHEAP health verb —
+
+    → {"cmd": "health"}
+    ← {"health": {"replica_id": ..., "seq": N, "uptime_s": ...,
+                  "rolling": ..., "slo": ..., "queue_depth": ...,
+                  "batch_occupancy": ..., "breakers": ..., ...}}
+
+``health`` never force-evaluates SLOs and reads gauges lock-free
+(``obs.fleet.replica_health``): monitoring N replicas at 1 Hz
+perturbs no pump loop. ``seq`` is a monotonic per-server snapshot
+number. ``registry="private"`` gives the server its own metrics
+registry (``obs.scoped_registry`` routes its handler threads and
+scheduler pump there), so several replicas in ONE process — the
+``serving_fleet`` bench, the fleet tests — keep distinct,
+correctly-fleet-summable metrics.
 
 Per-request latency attribution (ISSUE 8): scheduler-served responses
 carry a ``"timing"`` waterfall per prompt (queue_wait → prefill →
@@ -70,7 +94,9 @@ reference's server.
 
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import socket
 import socketserver
 import threading
@@ -80,11 +106,22 @@ import jax.numpy as jnp
 import numpy as np
 
 from triton_dist_tpu import obs
+from triton_dist_tpu.obs import fleet as _fleet
 from triton_dist_tpu.obs import flight, trace
 
 
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
+        # One scope per connection: this handler thread's emissions
+        # (request counters, error accounting, everything the request
+        # path records) land in the owning server's registry — the
+        # per-replica isolation that keeps fleet counter sums correct
+        # when several servers share a process (no-op when the server
+        # uses the process-global registry).
+        with obs.scoped_registry(self.server.model_server.registry):
+            self._handle_scoped()
+
+    def _handle_scoped(self):
         for line in self.rfile:
             line = line.strip()
             if not line:
@@ -152,9 +189,22 @@ class ModelServer:
                  port: int = 0, telemetry: bool = True,
                  scheduler: bool | None = None,
                  max_waiting: int | None = None,
-                 prefill_chunk: int | None = None):
+                 prefill_chunk: int | None = None,
+                 replica_id: str | None = None, registry=None):
+        """``replica_id``: this server's stable fleet identity
+        (explicit > ``TDT_REPLICA_ID`` > ``host:port`` after bind).
+        ``registry``: ``"private"`` gives the server its own metrics
+        registry (or pass a ``obs.Registry``) — REQUIRED for distinct
+        per-replica metrics when several servers share one process;
+        the default (None) keeps the historical process-global
+        registry."""
         self.engine = engine
         self.params = params
+        self.registry = None
+        if registry == "private":
+            self.registry = obs.Registry()
+        elif registry is not None:
+            self.registry = registry
         if telemetry:
             # A serving process wants its numbers scrapeable; direct
             # Engine users keep the zero-overhead no-op default.
@@ -166,28 +216,62 @@ class ModelServer:
             if trace.env_enabled(default=True):
                 trace.enable()
                 flight.install_signal_handlers()
-        if scheduler is None:
-            # Auto: on for engines a stream session can actually serve
-            # (test doubles without a kv keep the serialized path).
-            # Oversubscribed paged pools stream via block-granular
-            # admission (ISSUE 6), and mega engines stream via the
-            # per-row mega step (ISSUE 11) — neither is a special case
-            # anymore. ``scheduler=False`` stays as the explicit
-            # serialized-path override.
-            scheduler = getattr(engine, "kv", None) is not None
-        self.scheduler = None
-        if scheduler:
-            from triton_dist_tpu.serving.scheduler import Scheduler
-            self.scheduler = Scheduler(
-                engine, params, max_waiting=max_waiting,
-                prefill_chunk=prefill_chunk).start()
+        # Bind FIRST so the default replica_id can be host:port — but
+        # close the listening socket if the REST of construction
+        # raises (e.g. a malformed TDT_MAX_WAITING inside the
+        # Scheduler ctor): pre-ISSUE-14 the bind happened last, so a
+        # ctor failure never left a bound fd behind.
         self._lock = threading.Lock()  # serialized path only
         self._srv = _TCPServer((host, port), _Handler)
-        self._srv.model_server = self
-        self.host, self.port = self._srv.server_address
+        try:
+            self._srv.model_server = self
+            self.host, self.port = self._srv.server_address
+            self.replica_id = str(
+                replica_id
+                or os.environ.get("TDT_REPLICA_ID", "").strip()
+                or f"{self.host}:{self.port}")
+            self._started_monotonic = time.monotonic()
+            self._health_seq = itertools.count(1)  # thread-safe counter
+            if telemetry:
+                # Flight dumps (filename + metadata) carry the replica
+                # identity so two same-host replicas' postmortems
+                # cannot alias (in-process multi-server shares one
+                # tracer — the last server's id wins there,
+                # documented). Unconditional on tracing state: a
+                # cheap global write now means dumps stay stamped
+                # even when tracing is enabled AFTER server start.
+                flight.set_replica_id(self.replica_id)
+            if scheduler is None:
+                # Auto: on for engines a stream session can actually
+                # serve (test doubles without a kv keep the serialized
+                # path). Oversubscribed paged pools stream via
+                # block-granular admission (ISSUE 6), and mega engines
+                # stream via the per-row mega step (ISSUE 11) —
+                # neither is a special case anymore.
+                # ``scheduler=False`` stays as the explicit
+                # serialized-path override.
+                scheduler = getattr(engine, "kv", None) is not None
+            self.scheduler = None
+            if scheduler:
+                from triton_dist_tpu.serving.scheduler import Scheduler
+                self.scheduler = Scheduler(
+                    engine, params, max_waiting=max_waiting,
+                    prefill_chunk=prefill_chunk,
+                    replica_id=self.replica_id,
+                    registry=self.registry).start()
+        except BaseException:
+            self._srv.server_close()
+            raise
         self._thread: threading.Thread | None = None
 
     def _serve_request(self, req: dict) -> dict:
+        # Handler threads route their emissions into this replica's
+        # registry (no-op scope when registry=None — the historical
+        # process-global path).
+        with obs.scoped_registry(self.registry):
+            return self._serve_request_scoped(req)
+
+    def _serve_request_scoped(self, req: dict) -> dict:
         if "cmd" in req:
             return self._serve_command(req)
         obs.counter("server.requests").inc()
@@ -214,16 +298,38 @@ class ModelServer:
     def _serve_command(self, req: dict) -> dict:
         """Control-plane requests on the same JSON-lines protocol."""
         cmd = req["cmd"]
+        if cmd == "health":
+            # The CHEAP control verb (ISSUE 14): lock-free gauge/
+            # counter peeks, NO SLO force-evaluation — the pump
+            # refreshes the gauges every working iteration, and the
+            # monotonic ``seq`` + ``uptime_s`` let the fleet view
+            # judge freshness. Monitoring N replicas at 1 Hz through
+            # this perturbs no pump loop (obs.fleet.replica_health).
+            obs.counter("serving.replica_health_requests").inc()
+            seq = next(self._health_seq)
+            obs.gauge("serving.replica_health_seq").set(seq)
+            health = _fleet.replica_health(
+                self.replica_id, seq, self._started_monotonic,
+                registry=self.registry or obs.get_registry(),
+                engine=self.engine, scheduler=self.scheduler)
+            obs.gauge("serving.replica_uptime_s").set(
+                health["uptime_s"])
+            return {"health": health}
         if cmd == "metrics":
             # Snapshot under the generation lock is NOT needed: the
             # registry is internally locked, and a scraper must not
             # queue behind a multi-second generation.
-            if self.scheduler is not None \
+            if req.get("evaluate", True) \
+                    and self.scheduler is not None \
                     and self.scheduler.slo is not None:
                 # Rolling/burn gauges current as of THIS scrape (the
                 # pump only evaluates while it is doing work).
+                # ``"evaluate": false`` opts out — dashboards polling
+                # N replicas read the last-evaluated gauges instead
+                # of forcing N quantile merges per tick.
                 self.scheduler.slo.evaluate(force=True)
             snap = obs.snapshot()
+            snap["replica_id"] = self.replica_id
             if trace.enabled():
                 # Tracing counts + last flight record ride inside the
                 # snapshot (tools/report.py renders them as the
@@ -253,8 +359,8 @@ class ModelServer:
             from triton_dist_tpu.obs import attrib
             return {"requests": attrib.last(req.get("last"))}
         obs.counter("server.errors").inc()
-        return {"error": f"unknown cmd {cmd!r} "
-                         f"(known: metrics, dump_trace, request_stats)"}
+        return {"error": f"unknown cmd {cmd!r} (known: metrics, "
+                         f"health, dump_trace, request_stats)"}
 
     def _effective_gen_len(self, req: dict, prompts) -> int:
         """Clamp the requested gen_len to the protocol cap (4096) AND

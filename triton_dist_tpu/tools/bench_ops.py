@@ -372,6 +372,70 @@ def check_spec_serving_wellformed(extras: dict) -> list[str]:
     return fails
 
 
+def check_fleet_wellformed(extras: dict) -> list[str]:
+    """Failure strings when the serving_fleet part ran (its tokens/s
+    key exists) without leaving well-formed fleet evidence
+    (ISSUE 14): the two-replica-vs-one ratio must be present and
+    positive, the per-replica rows must exist (at least two replica
+    ids — a "fleet" of one would fake the scale-out number), no
+    replica may have been ``down`` after the timed window, EVERY
+    replica must have retired rows during the window (a replica whose
+    pump died mid-window still answers health from its handler
+    threads, so liveness alone cannot catch it — its retired-delta
+    can), and no request in either timed leg may have errored (a
+    fanout half-landing on a dead replica would otherwise publish a
+    fleet tokens/s that is really a single-replica number). Empty
+    when the part did not run."""
+    if "serving_fleet_tokens_per_s" not in extras:
+        return []
+    fails = []
+    v = extras.get("serving_fleet_vs_single")
+    if not isinstance(v, (int, float)) or isinstance(v, bool) \
+            or float(v) <= 0.0:
+        fails.append(
+            f"serving_fleet_vs_single: missing/malformed ({v!r}) — "
+            f"the serving_fleet part ran but published no "
+            f"fleet-vs-single ratio")
+    ids = extras.get("serving_fleet_replica_ids")
+    if not isinstance(ids, (list, tuple)) or len(ids) < 2 \
+            or len(set(ids)) != len(ids):
+        fails.append(
+            f"serving_fleet_replica_ids: want >= 2 distinct replica "
+            f"rows, got {ids!r}")
+    down = extras.get("serving_fleet_down_replicas")
+    if not isinstance(down, (int, float)) or isinstance(down, bool):
+        fails.append(
+            f"serving_fleet_down_replicas: missing/malformed "
+            f"({down!r})")
+    elif down:
+        fails.append(
+            f"serving_fleet_down_replicas: {down} replica(s) were not "
+            f"live during the timed window — the fleet tokens/s is "
+            f"not a 2-replica number")
+    retired = extras.get("serving_fleet_replica_retired")
+    if not isinstance(retired, (list, tuple)) or len(retired) < 2:
+        fails.append(
+            f"serving_fleet_replica_retired: want >= 2 per-replica "
+            f"retired-deltas, got {retired!r}")
+    elif not all(isinstance(r, (int, float))
+                 and not isinstance(r, bool) and r > 0
+                 for r in retired):
+        fails.append(
+            f"serving_fleet_replica_retired: every replica must have "
+            f"retired rows in the timed window, got {retired!r} — a "
+            f"dead-pump replica served nothing")
+    for key in ("serving_fleet_error_count",
+                "serving_fleet_single_error_count"):
+        n = extras.get(key)
+        if not isinstance(n, (int, float)) or isinstance(n, bool):
+            fails.append(f"{key}: missing/malformed ({n!r})")
+        elif n:
+            fails.append(
+                f"{key}: {n} request(s) errored in the timed window — "
+                f"the tokens/s numbers are not comparable")
+    return fails
+
+
 def _extras_from_file(path: str) -> dict:
     """Extras dict from any bench artifact: a bench.py checkpoint
     ({"extras": ...}), a bench.py result line ({"metric", "extras"}),
@@ -432,6 +496,7 @@ def run_regress(baseline_path: str, from_file: str | None,
     fails += check_serving_wellformed(extras)
     fails += check_mega_serving_wellformed(extras)
     fails += check_spec_serving_wellformed(extras)
+    fails += check_fleet_wellformed(extras)
     fails += check_overlap_measured_wellformed(extras)
     fails += check_measured_overlap_floors(
         extras, load_measured_overlap_floors(baseline_path, tier))

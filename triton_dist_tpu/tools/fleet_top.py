@@ -1,0 +1,177 @@
+"""Fleet dashboard: ``tools/top.py`` lifted across N replicas.
+
+One refresh-loop screen over a replica fleet (ISSUE 14,
+docs/observability.md "Fleet view"): a row per replica — status
+(live/stale/down), last-good-snapshot age, queue depth, occupancy,
+rolling TTFT/TPOT, breach flags, placement score — plus a fleet
+rollup line with the bucket-merged TTFT/TPOT percentiles
+(``obs.fleet.merge_fleet_snapshots`` — summed buckets through
+``histogram_quantile``, never averaged per-replica percentiles).
+
+The scrapes are the CHEAP path on purpose: ``{"cmd": "health"}``
+(lock-free server-side reads, no SLO force-evaluation) for the rows
+and ``{"cmd": "metrics", "evaluate": false}`` for the merged
+histograms — watching a fleet at 1 Hz perturbs no pump loop. A dead
+or wedged replica renders as ``stale``/``down`` with its age; the
+screen never raises.
+
+Usage:
+    python -m triton_dist_tpu.tools.fleet_top \\
+        --endpoints 127.0.0.1:8777,127.0.0.1:8778 [--interval 2]
+        [--once]
+
+``render()`` is pure (state dict → string) so the screen is testable
+without servers (tests/test_fleet.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+#: Full-metrics scrape cadence: the per-replica rows come from the
+#: cheap health verb EVERY tick, the bucket-merged fleet percentiles
+#: only every Nth (a full snapshot ships every histogram — at 1 Hz
+#: over N replicas that is exactly the monitoring load the health
+#: verb exists to avoid; the stale merge is rendered from cache in
+#: between).
+METRICS_EVERY = 5
+
+
+def fetch(view, with_metrics: bool = True) -> dict:
+    """One refresh: a concurrent health poll through a persistent
+    :class:`~triton_dist_tpu.obs.fleet.FleetView` (persistent so
+    staleness ages survive across refresh ticks), plus — only when
+    ``with_metrics`` (every :data:`METRICS_EVERY` ticks in the loop)
+    — a full-snapshot scrape for the bucket-merged fleet percentiles;
+    otherwise the last merge is rendered from the view's cache.
+    Returns the dict :func:`render` consumes."""
+    rows = view.poll()
+    merged = (view.scrape_metrics(evaluate=False) if with_metrics
+              else view.merged())
+    return {"replicas": rows, "merged": merged}
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    f = float(v)
+    return str(int(f)) if f == int(f) else f"{f:.3f}"
+
+
+def _row_cells(r: dict) -> list:
+    h = r.get("health") or {}
+    rolling = h.get("rolling") or {}
+    breaches = sum(1 for t in (h.get("slo") or {}).values()
+                   if t.get("breached"))
+    occ = _fmt(h.get("batch_occupancy"))
+    batch = h.get("batch")
+    return [
+        r.get("replica_id") or r.get("endpoint") or "?",
+        r.get("status", "?"),
+        f"{_fmt(r.get('age_s'))}s",
+        _fmt(h.get("queue_depth")),
+        f"{occ}/{_fmt(batch)}" if batch is not None else occ,
+        f"{_fmt(rolling.get('ttft_p50_ms'))}/"
+        f"{_fmt(rolling.get('ttft_p99_ms'))}",
+        f"{_fmt(rolling.get('tpot_p50_ms'))}/"
+        f"{_fmt(rolling.get('tpot_p99_ms'))}",
+        str(breaches) if breaches else "-",
+        _fmt(r.get("score")),
+    ]
+
+
+_HEADER = ["replica", "st", "age", "q", "occ", "ttft p50/p99",
+           "tpot p50/p99", "brch", "score"]
+
+
+def render(state: dict) -> str:
+    """One fleet screen from ``{"replicas": [...], "merged": {...}}``
+    (the :func:`fetch` shape — per-replica rows are
+    ``FleetView.replicas()`` dicts, ``merged`` a
+    ``merge_fleet_snapshots`` result or None)."""
+    from triton_dist_tpu.obs.fleet import merged_percentiles
+    rows = state.get("replicas") or []
+    counts = {"live": 0, "stale": 0, "down": 0}
+    for r in rows:
+        counts[r.get("status", "down")] = counts.get(
+            r.get("status", "down"), 0) + 1
+    lines = [f"tdt fleet — {time.strftime('%H:%M:%S')} — "
+             f"{len(rows)} replica(s) ({counts['live']} live / "
+             f"{counts['stale']} stale / {counts['down']} down)", ""]
+    if not rows:
+        lines.append("(no replicas)")
+        return "\n".join(lines)
+
+    table = [_HEADER] + [_row_cells(r) for r in rows]
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(_HEADER))]
+    for row in table:
+        lines.append("  ".join(c.ljust(w)
+                               for c, w in zip(row, widths)).rstrip())
+
+    merged = state.get("merged")
+    fleet_bits = []
+    healths = [r.get("health") or {} for r in rows
+               if r.get("status") != "down"]
+    if healths:
+        q = sum(float(h.get("queue_depth") or 0) for h in healths)
+        occ = sum(float(h.get("batch_occupancy") or 0) for h in healths)
+        fleet_bits.append(f"queue {_fmt(q)}   occupancy {_fmt(occ)}")
+    if merged:
+        for label, p in merged_percentiles(
+                merged.get("histograms")).items():
+            fleet_bits.append(
+                f"{label} p50 {_fmt(p['p50'])} / p99 {_fmt(p['p99'])} "
+                f"ms (bucket-merged, n {p['n']})")
+        c = merged.get("counters", {})
+        if "serving.retired" in c:
+            fleet_bits.append(f"retired {_fmt(c['serving.retired'])}")
+    if fleet_bits:
+        lines += ["", "fleet: " + "   ".join(fleet_bits)]
+    errs = [r for r in rows if r.get("error")]
+    for r in errs[:4]:
+        lines.append(f"  ! {r.get('endpoint')}: "
+                     f"{str(r['error'])[:70]}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    from triton_dist_tpu.obs.fleet import FleetView
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--endpoints", required=True,
+                    help="comma-separated host:port replica list")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--iterations", type=int, default=None,
+                    help="stop after N refreshes (default: forever)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one screen and exit (no ANSI clear)")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="per-replica scrape timeout "
+                         "(default TDT_FLEET_TIMEOUT_S)")
+    args = ap.parse_args(argv)
+    eps = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+    view = FleetView(eps, timeout_s=args.timeout)
+    n = 1 if args.once else args.iterations
+    i = 0
+    try:
+        while n is None or i < n:
+            screen = render(fetch(
+                view, with_metrics=args.once or i % METRICS_EVERY == 0))
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(screen)
+            sys.stdout.flush()
+            i += 1
+            if n is not None and i >= n:
+                break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,6 +138,53 @@ def render_kv(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_fleet(merged: dict | None) -> str:
+    """Summarize a fleet-merged snapshot (``obs.fleet.
+    merge_fleet_snapshots`` — bench.py's ``serving_fleet`` part embeds
+    one under ``extras.telemetry.fleet``; docs/observability.md
+    "Fleet view"): the replica roster, per-replica queue/occupancy/
+    rolling-p99 rows, and the fleet rollup with BUCKET-MERGED TTFT/
+    TPOT percentiles. Empty string when no merged snapshot is
+    present."""
+    if not merged or not merged.get("replicas"):
+        return ""
+    per = merged.get("per_replica", {})
+    lines = ["#### fleet",
+             f"replicas: {', '.join(merged['replicas'])}", "",
+             "| replica | queue | occupancy | rolling ttft p99 | "
+             "rolling tpot p99 | admitted | retired |",
+             "|---|---|---|---|---|---|---|"]
+    for rid in merged["replicas"]:
+        g = per.get(rid, {}).get("gauges", {})
+        c = per.get(rid, {}).get("counters", {})
+
+        def _v(x):
+            return "-" if x is None else (
+                int(x) if float(x) == int(x) else round(float(x), 3))
+
+        lines.append(
+            f"| {rid} | {_v(g.get('serving.queue_depth'))} | "
+            f"{_v(g.get('serving.batch_occupancy'))} | "
+            f"{_v(g.get('serving.rolling.ttft_p99_ms'))} | "
+            f"{_v(g.get('serving.rolling.tpot_p99_ms'))} | "
+            f"{_v(c.get('serving.admitted'))} | "
+            f"{_v(c.get('serving.retired'))} |")
+    from triton_dist_tpu.obs.fleet import merged_percentiles
+    fleet_bits = []
+    for label, p in merged_percentiles(merged.get("histograms")).items():
+        p50, p99 = p["p50"], p["p99"]
+        fleet_bits.append(
+            f"{label} p50={round(p50, 3) if p50 is not None else '-'}"
+            f" p99={round(p99, 3) if p99 is not None else '-'}"
+            f" (n={p['n']}, bucket-merged)")
+    c = merged.get("counters", {})
+    if "serving.retired" in c:
+        fleet_bits.append(f"retired={int(c['serving.retired'])}")
+    if fleet_bits:
+        lines += ["", "fleet rollup: " + "  ".join(fleet_bits)]
+    return "\n".join(lines)
+
+
 def render_tracing(stats: dict | None) -> str:
     """Summarize the event-tracing / flight-recorder state
     (``obs.trace.stats()``, carried under the snapshot's ``trace`` key
@@ -242,6 +289,7 @@ def render_telemetry(snap: dict) -> str:
     resil = render_resilience(snap)
     serving = render_serving(snap)
     kv = render_kv(snap)
+    fleet = render_fleet(snap.get("fleet"))
     tracing = render_tracing(snap.get("trace"))
     devprof = render_devprof(snap, snap.get("devprof"))
     waterfalls = render_waterfalls(snap.get("waterfalls"))
@@ -271,6 +319,8 @@ def render_telemetry(snap: dict) -> str:
         lines += [serving, ""]
     if kv:
         lines += [kv, ""]
+    if fleet:
+        lines += [fleet, ""]
     if tracing:
         lines += [tracing, ""]
     if devprof:

@@ -1,7 +1,9 @@
 """Live serving dashboard: `top` for the SLO observatory.
 
-Polls a running ModelServer's ``{"cmd": "metrics"}`` (each scrape
-forces a fresh SLO evaluation server-side) plus ``{"cmd":
+Polls a running ModelServer's ``{"cmd": "metrics", "evaluate":
+false}`` (read-only — a render tick must not force SLO evaluations;
+the pump keeps the gauges fresh while it works, and the ``health``
+verb's seq/uptime header says how fresh) plus ``{"cmd":
 "request_stats"}`` and renders one refresh-loop screen: rolling
 p50/p99 latencies, per-target burn rates with breach flags, batch
 occupancy / queue depth, KV block-pool utilization, per-op live
@@ -27,11 +29,25 @@ import time
 
 def fetch(host: str, port: int, timeout: float = 10.0) -> dict:
     """One scrape: the metrics snapshot plus the newest request
-    waterfalls, as the dict :func:`render` consumes."""
+    waterfalls, as the dict :func:`render` consumes.
+
+    The read path is CHEAP on purpose (ISSUE 14 bugfix): the metrics
+    request passes ``"evaluate": false`` — rendering a dashboard must
+    not force an SLO evaluation per tick, or monitoring N replicas at
+    1 Hz perturbs N pump loops — and the replica header comes from the
+    lock-free ``{"cmd": "health"}`` verb (its ``seq``/``uptime_s``
+    tell the reader how fresh the last-evaluated gauges are; the pump
+    re-evaluates every working iteration, so an ACTIVE server's
+    gauges are at most ~1 s old anyway)."""
     from triton_dist_tpu.serving.client import ChatClient
     c = ChatClient(host, port, timeout=timeout)
     try:
-        snap = c.request({"cmd": "metrics"})["metrics"]
+        snap = c.request({"cmd": "metrics",
+                          "evaluate": False})["metrics"]
+        try:
+            snap["health"] = c.health()
+        except Exception:  # noqa: BLE001 — pre-ISSUE-14 servers
+            snap["health"] = None
         snap["requests"] = c.request_stats(last=5)
     finally:
         c.close()
@@ -61,6 +77,15 @@ def render(snap: dict) -> str:
     g = snap.get("gauges", {})
     c = snap.get("counters", {})
     lines = [f"tdt top — {time.strftime('%H:%M:%S')}", ""]
+
+    h = snap.get("health")
+    rid = snap.get("replica_id") or (h or {}).get("replica_id")
+    if rid:
+        parts = [f"replica {rid}"]
+        if h:
+            parts.append(f"up {_fmt(h.get('uptime_s'))}s")
+            parts.append(f"seq {_fmt(h.get('seq'))}")
+        lines[0] += "   [" + "   ".join(parts) + "]"
 
     slo_rows = []
     for m in ("ttft", "tpot", "queue_wait", "pump"):
