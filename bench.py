@@ -45,7 +45,11 @@ What it benches (BASELINE.md north star; reference e2e_dense.md:21-38):
   step), serving_fleet (TWO in-process ModelServer replicas behind a
   client-side round-robin fanout vs one replica of the same config —
   the first measured multi-replica number, with fleet-merged
-  bucket-summed TTFT/TPOT percentiles, ISSUE 14), prefix (shared-preamble
+  bucket-summed TTFT/TPOT percentiles, ISSUE 14), serving_router
+  (THREE replicas behind the fault-tolerant RouterServer vs direct
+  round-robin, then the chaos acceptance scenario: one replica killed
+  mid-window → zero client-visible failures, failovers recorded, down
+  detected within the configured age — CPU-valid, ISSUE 15), prefix (shared-preamble
   clients, prefix cache warm vs cold — also CPU-valid), sp_attn, train. On a single chip the collective parts
   collapse, so the numbers measure Mosaic-kernel vs XLA compute
   quality; on a real slice the same code measures overlap.
@@ -180,7 +184,8 @@ def _probe_backend_subprocess(timeout_s: float) -> bool:
 _PART_ORDER = ("ag_gemm", "gemm_rs", "gemm_ar", "flash_decode", "tp_mlp",
                "layer_8b", "layer_32b", "overlap", "moe_ag_gg", "mega",
                "serving", "serving_mega", "serving_spec",
-               "serving_fleet", "prefix", "sp_attn", "train")
+               "serving_fleet", "serving_router", "prefix", "sp_attn",
+               "train")
 
 #: Sweep-heavy parts get longer deadlines: ag_gemm/gemm_rs autotune
 #: 6-8 candidates at ~25 s Mosaic compile each on a COLD cache (the
@@ -274,9 +279,12 @@ def _run_parts_in_children(extras: dict) -> None:
                 wf = {**((prev or {}).get("waterfalls") or {}),
                       **(tel.get("waterfalls") or {})}
                 # The fleet-merged snapshot (serving_fleet child) is
-                # metadata merge_snapshots drops, like the waterfalls.
+                # metadata merge_snapshots drops, like the waterfalls;
+                # ditto the router-status snapshot (serving_router).
                 fleet = (tel.get("fleet")
                          or (prev or {}).get("fleet"))
+                router_snap = (tel.get("router")
+                               or (prev or {}).get("router"))
                 try:
                     from triton_dist_tpu.obs import merge_snapshots
                     extras["telemetry"] = merge_snapshots([prev, tel])
@@ -284,6 +292,8 @@ def _run_parts_in_children(extras: dict) -> None:
                         extras["telemetry"]["waterfalls"] = wf
                     if fleet:
                         extras["telemetry"]["fleet"] = fleet
+                    if router_snap:
+                        extras["telemetry"]["router"] = router_snap
                 except Exception:  # noqa: BLE001 — telemetry is extra
                     # Keep what already accumulated over prior parts;
                     # only seed from this child when there is nothing.
@@ -1515,6 +1525,213 @@ def _bench_serving_fleet(mesh, n, on_tpu, extras):
     return tps_fleet, extras.get("serving_fleet_vs_single")
 
 
+def _bench_serving_router(mesh, n, on_tpu, extras):
+    """The fault-tolerant router under measurement AND under fire
+    (ISSUE 15): THREE in-process ``ModelServer`` replicas — same
+    model/params/config, private registries — first behind client-side
+    round-robin (the direct leg), then behind a ``RouterServer``
+    (``serving_router_vs_direct`` prices the router hop: placement,
+    breaker gate, one extra socket round trip per request), and
+    finally the chaos acceptance scenario: a traffic window through
+    the router with one replica KILLED mid-window
+    (``testing.chaos.kill_replica`` — connections severed, listener
+    closed, pump stopped). The headline numbers are the gate's
+    (tools/bench_ops.py ``check_router_wellformed``): ZERO
+    client-visible failures, >= 1 recorded failover (the response
+    carries ``failovers``), and the victim marked ``down`` within the
+    configured age. The router's ``replica_down`` flight dump is
+    validated and its path published; one failover response's
+    trace_id + timing ride under ``extras.telemetry.router_waterfall``
+    so the report shows the stitched hop."""
+    import jax
+    import jax.numpy as jnp
+    from triton_dist_tpu.models import DenseLLM, Engine, ModelConfig
+    from triton_dist_tpu.serving import ModelServer, RouterServer
+    from triton_dist_tpu.serving.client import ChatClient, fanout
+    from triton_dist_tpu.testing import chaos
+
+    if on_tpu:
+        cfg = ModelConfig(hidden_size=512, intermediate_size=1024,
+                          num_hidden_layers=2, num_attention_heads=8,
+                          num_key_value_heads=8, head_dim=64,
+                          vocab_size=2048, max_position_embeddings=512,
+                          dtype=jnp.bfloat16)
+        gen_short, gen_long, gen_kill = 16, 96, 128
+    else:
+        cfg = ModelConfig(hidden_size=32, intermediate_size=64,
+                          num_hidden_layers=1, num_attention_heads=4,
+                          num_key_value_heads=4, head_dim=8,
+                          vocab_size=64, max_position_embeddings=256,
+                          dtype=jnp.float32)
+        gen_short, gen_long, gen_kill = 4, 24, 48
+    model = DenseLLM(cfg, mesh=mesh, axis="tp", impl="xla")
+    params = model.init(jax.random.PRNGKey(0))
+    clients, batch, replicas = 9, 2, 3
+    down_s = 3.0
+    prompt_lens = [3, 5, 8, 4, 6, 7, 5, 3, 6]
+    gens = [gen_long, gen_short, gen_long] * 3
+    reqs = [{"prompt_ids": [[(7 * i + j) % (cfg.vocab_size - 1) + 1
+                             for j in range(pl)]],
+             "gen_len": g}
+            for i, (pl, g) in enumerate(zip(prompt_lens, gens))]
+
+    srvs = [ModelServer(Engine(model, batch=batch,
+                               max_seq=cfg.max_position_embeddings,
+                               prefill_mode="xla_ar",
+                               decode_mode="gemm_ar"),
+                        params, port=0, registry="private",
+                        replica_id=f"router-r{i}").start()
+            for i in range(replicas)]
+    eps = [(s.host, s.port) for s in srvs]
+    router = RouterServer(
+        eps, registry="private", poll_s=0.1, try_timeout_s=30.0,
+        deadline_s=120.0,
+        fleet_kwargs={"stale_s_": 1.0, "down_s_": down_s}).start()
+    rc = ChatClient(router.host, router.port, timeout=180)
+    try:
+        # Warm every replica's compiles through BOTH paths.
+        fanout(endpoints=eps,
+               requests=[dict(r, gen_len=2) for r in reqs])
+        fanout(router.host, router.port,
+               requests=[dict(r, gen_len=2) for r in reqs])
+
+        # Direct leg: client-side round-robin straight at the fleet.
+        t0 = time.perf_counter()
+        outs_d = fanout(endpoints=eps, requests=reqs)
+        dt_d = time.perf_counter() - t0
+        toks_d = sum(len(o["tokens"][0]) for o in outs_d
+                     if "tokens" in o)
+        err_d = [o for o in outs_d if "tokens" not in o]
+
+        # Router leg: same requests through the front door.
+        t0 = time.perf_counter()
+        outs_r = fanout(router.host, router.port, requests=reqs)
+        dt_r = time.perf_counter() - t0
+        toks_r = sum(len(o["tokens"][0]) for o in outs_r
+                     if "tokens" in o)
+        err_r = [o for o in outs_r if "tokens" not in o]
+
+        tps_d = toks_d / dt_d if dt_d > 0 else 0.0
+        tps_r = toks_r / dt_r if dt_r > 0 else 0.0
+        extras["serving_router_clients"] = clients
+        extras["serving_router_replicas"] = replicas
+        extras["serving_router_tokens_per_s"] = round(tps_r, 2)
+        extras["serving_router_direct_tokens_per_s"] = round(tps_d, 2)
+        if tps_d > 0:
+            extras["serving_router_vs_direct"] = round(tps_r / tps_d, 4)
+        if err_d or err_r:
+            extras["serving_router_errors"] = [
+                str(e)[:120] for e in (err_d + err_r)[:4]]
+
+        # Kill window: long generations through the router; kill
+        # whichever replica holds in-flight dispatches mid-window.
+        import threading
+        kill_reqs = [dict(r, gen_len=gen_kill) for r in reqs]
+        window: dict = {}
+
+        def traffic():
+            window["outs"] = fanout(router.host, router.port,
+                                    requests=kill_reqs)
+        th = threading.Thread(target=traffic, daemon=True)
+        th.start()
+        victim_idx, deadline = None, time.perf_counter() + 20.0
+        while victim_idx is None and time.perf_counter() < deadline:
+            rows = rc.request({"cmd": "router_status"}
+                              )["router"]["replicas"]
+            busy = [i for i, r in enumerate(rows)
+                    if r["inflight"] > 0]
+            if busy:
+                victim_idx = busy[0]
+            else:
+                time.sleep(0.005)
+        if victim_idx is None:
+            victim_idx = 0          # kill anyway; the gate will judge
+        victim = srvs[victim_idx]
+        victim_ep = f"{victim.host}:{victim.port}"
+        t_kill = time.perf_counter()
+        chaos.kill_replica(victim)
+
+        # Detection latency is timestamped by a CONCURRENT watcher —
+        # measuring after th.join() would conflate the remaining
+        # traffic window's duration with the router's detection time
+        # and trip the gate on any slow container (review finding).
+        detect_box: dict = {}
+
+        def watch_down():
+            deadline = time.perf_counter() + down_s + 20.0
+            while time.perf_counter() < deadline:
+                try:
+                    rows = rc.request({"cmd": "router_status"}
+                                      )["router"]["replicas"]
+                except Exception:  # noqa: BLE001 — keep watching
+                    time.sleep(0.05)
+                    continue
+                st = {r["endpoint"]: r["status"] for r in rows}
+                if st.get(victim_ep) == "down":
+                    detect_box["s"] = time.perf_counter() - t_kill
+                    return
+                time.sleep(0.05)
+        watcher = threading.Thread(target=watch_down, daemon=True)
+        watcher.start()
+        th.join(timeout=300)
+        outs_k = window.get("outs") or []
+        err_k = [o for o in outs_k if "tokens" not in o]
+        failovers = sum(int(o.get("failovers", 0)) for o in outs_k
+                        if isinstance(o, dict))
+        extras["serving_router_kill_client_errors"] = len(err_k)
+        if err_k:
+            extras["serving_router_kill_errors"] = [
+                str(e)[:120] for e in err_k[:4]]
+        extras["serving_router_failovers"] = failovers
+        extras["serving_router_down_s"] = down_s
+        watcher.join(timeout=down_s + 25.0)
+        if "s" in detect_box:
+            extras["serving_router_down_detect_s"] = round(
+                detect_box["s"], 3)
+
+        # The postmortem evidence: the router's replica_down flight
+        # dump (validated), the router status snapshot, and one
+        # failover response's trace-stitched waterfall.
+        status = rc.request({"cmd": "router_status"})["router"]
+        hop = next((o for o in outs_k if isinstance(o, dict)
+                    and o.get("failovers")), None)
+        if hop is not None:
+            # The trace-ID-stitched hop: this ID filters to the
+            # victim's admit, the router's failover instant, and the
+            # survivor's retire in the flight dump below.
+            status["failover_sample"] = {
+                "trace_id": hop.get("trace_id"),
+                "failovers": hop.get("failovers"),
+                "replica": hop.get("replica"),
+                "timing": hop.get("timing"),
+            }
+        extras["router_snapshot"] = status
+        from triton_dist_tpu.obs import trace as _trc
+        stats = _trc.stats() if _trc.enabled() else {}
+        dump = stats.get("last_flight_record")
+        if dump:
+            extras["serving_router_flight_record"] = dump
+            try:
+                from triton_dist_tpu.tools import trace_export
+                with open(dump) as f:
+                    chrome = json.load(f)
+                errors, _w = trace_export.validate(chrome)
+                extras["serving_router_flight_valid"] = not errors
+            except Exception as e:  # noqa: BLE001 — evidence is extra
+                extras["serving_router_flight_valid"] = False
+                extras["serving_router_flight_error"] = _err(e)
+    finally:
+        rc.close()
+        router.stop()
+        for s in srvs:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 — victim already dead
+                pass
+    return (extras.get("serving_router_tokens_per_s"),
+            extras.get("serving_router_vs_direct"))
+
+
 def _bench_prefix(mesh, n, on_tpu, extras):
     """Cross-request prefix caching (ISSUE 6): 8 clients sharing one
     long system preamble against the paged block-granular scheduler,
@@ -2181,6 +2398,8 @@ def main():
              lambda: _bench_serving_spec(mesh, n, on_tpu, extras)),
             ("serving_fleet",
              lambda: _bench_serving_fleet(mesh, n, on_tpu, extras)),
+            ("serving_router",
+             lambda: _bench_serving_router(mesh, n, on_tpu, extras)),
             ("prefix",
              lambda: _bench_prefix(mesh, n, on_tpu, extras)),
             ("sp_attn",
@@ -2225,6 +2444,15 @@ def main():
                 fleet_acc = (extras.get("telemetry") or {}).get("fleet")
             if fleet_acc:
                 tel["fleet"] = fleet_acc
+            if "router_snapshot" in extras:
+                # The serving_router part's status snapshot likewise
+                # (report.py "router" section).
+                router_acc = extras.pop("router_snapshot")
+            else:
+                router_acc = (extras.get("telemetry")
+                              or {}).get("router")
+            if router_acc:
+                tel["router"] = router_acc
             if any(tel.values()):
                 extras["telemetry"] = tel
             _checkpoint_extras(extras, name)

@@ -427,6 +427,7 @@ def test_regress_gates_fleet(tmp_path):
         "flash_decode_vs_xla": 1.0, "serving_sched_vs_serial": 50.0,
         "serving_prefix_ttft_vs_cold": 6.0,
         "serving_mega_vs_plain": 1.0, "serving_spec_vs_plain": 1.6,
+        "serving_router_vs_direct": 0.9,
         "serving_fleet_vs_single": 0.84,
         "serving_fleet_tokens_per_s": 1200.0,
         "serving_fleet_replica_ids": ["r0", "r1"],

@@ -307,9 +307,18 @@ def test_server_backpressure_structured_reply(tiny):
         _wait_until(lambda: c.request({"cmd": "metrics"})["metrics"]
                     ["gauges"].get("serving.queue_depth", 0) >= 1,
                     what="B queued")
-        rej = c.generate_ids([[6]], gen_len=2)
+        # The raw protocol reply is under test: opt out of the
+        # client's sleep-and-retry-on-retry_after_ms (ISSUE 15).
+        raw = ChatClient(srv.host, srv.port, timeout=180,
+                         retry_shed=False)
+        rej = raw.generate_ids([[6]], gen_len=2)
+        raw.close()
         assert rej.get("type") == "queue_full", rej
         assert "max_waiting" in rej and "queue_depth" in rej
+        # The backpressure hint rides the reply (rolling TPOT x queue
+        # depth, clamped — docs/serving.md).
+        assert isinstance(rej.get("retry_after_ms"), int)
+        assert rej["retry_after_ms"] >= 25
         ta.join(timeout=180)
         tb.join(timeout=180)
         assert "tokens" in done["a"] and "tokens" in done["b"]

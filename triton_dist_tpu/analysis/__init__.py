@@ -234,13 +234,17 @@ def _vmem_pass(root):
                "agree, both directions",
                # The package-wide glob already covers serving/ and
                # models/spec.py; the explicit entries pin the ISSUE-13
-               # contract (spec telemetry stays cataloged) — and the
-               # ISSUE-14 one (fleet/fleet_top telemetry likewise) —
-               # against a future narrowing of the package glob.
+               # contract (spec telemetry stays cataloged), the
+               # ISSUE-14 one (fleet/fleet_top telemetry likewise),
+               # and the ISSUE-15 one (router + chaos-harness
+               # telemetry) against a future narrowing of the package
+               # glob.
                watches=("triton_dist_tpu/", "docs/observability.md",
                         "triton_dist_tpu/serving/",
+                        "triton_dist_tpu/serving/router.py",
                         "triton_dist_tpu/models/spec.py",
                         "triton_dist_tpu/obs/fleet.py",
+                        "triton_dist_tpu/testing/chaos.py",
                         "triton_dist_tpu/tools/fleet_top.py"))
 def _metrics_pass(root):
     from triton_dist_tpu.analysis import lint_metrics
@@ -285,12 +289,17 @@ def _fallback_pass(root):
                # rides along for the same reason. The fleet surfaces
                # (ISSUE 14) ride too: a fleet-plane edit that touched
                # the pump's read path must re-verify the device.step
-               # labels under --changed.
+               # labels under --changed. The ISSUE-15 router + chaos
+               # harness ride for the same reason: the chaos wedge
+               # hooks into the pump's work region and the router
+               # re-drives the serving path end to end.
                watches=("triton_dist_tpu/resilience/router.py",
                         "triton_dist_tpu/obs/devprof.py",
                         "triton_dist_tpu/serving/",
+                        "triton_dist_tpu/serving/router.py",
                         "triton_dist_tpu/models/spec.py",
                         "triton_dist_tpu/obs/fleet.py",
+                        "triton_dist_tpu/testing/chaos.py",
                         "triton_dist_tpu/tools/fleet_top.py",
                         "triton_dist_tpu/analysis/lint_annotations.py"))
 def _annotation_pass(root):

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import os
 import re
+import threading
 import time
 
 from triton_dist_tpu.obs import registry as _registry
@@ -202,6 +203,13 @@ def replica_health(replica_id: str, seq: int, started_monotonic: float,
         health["decode_path"] = getattr(engine, "decode_path", None)
     if scheduler is not None:
         health["max_waiting"] = getattr(scheduler, "max_waiting", None)
+    if g.get("serving.draining"):
+        # Graceful drain in progress (ISSUE 15): the replica finishes
+        # its in-flight work but admits nothing new — routers must
+        # stop placing here (serving/router.py skips draining
+        # replicas outright; the flag rides health so remote routers
+        # see it without a full metrics scrape).
+        health["draining"] = True
     if "kv.block_utilization" in g:
         health["kv"] = {"block_utilization": g["kv.block_utilization"],
                         "blocks_free": g.get("kv.blocks_free")}
@@ -429,19 +437,55 @@ class FleetView:
         self._clock = clock
         self._scrape = scrape       # injectable (tests): (eps, req) -> list
         now = clock()
+        self._eps_lock = threading.Lock()
         self._recs = {ep: _Rec(ep, now) for ep in self.endpoints}
         self._merged = None
 
+    # -- dynamic membership (ISSUE 15: live replica add/remove) ------------
+    def add_endpoint(self, ep) -> tuple:
+        """Start tracking a replica (it joins the next poll; its
+        status starts ``stale`` until a good scrape). Returns the
+        parsed ``(host, port)``; duplicate endpoints are a
+        ``ValueError`` like at construction."""
+        ep = parse_endpoint(ep)
+        with self._eps_lock:
+            if ep in self._recs:
+                raise ValueError(f"endpoint already tracked: {ep}")
+            self._recs[ep] = _Rec(ep, self._clock())
+            self.endpoints.append(ep)
+        return ep
+
+    def remove_endpoint(self, ep) -> tuple:
+        """Stop tracking a replica (its record — and its contribution
+        to any future merge — is dropped; a concurrent poll that
+        already snapshotted the endpoint list finishes harmlessly
+        against the dropped record)."""
+        ep = parse_endpoint(ep)
+        with self._eps_lock:
+            if ep not in self._recs:
+                raise ValueError(f"endpoint not tracked: {ep}")
+            self._recs.pop(ep)
+            self.endpoints.remove(ep)
+        return ep
+
+    def _snapshot_eps(self) -> list:
+        with self._eps_lock:
+            return list(self.endpoints)
+
     # -- scraping ----------------------------------------------------------
-    def _scrape_all(self, req: dict) -> list:
+    def _scrape_all(self, eps, req: dict) -> list:
         """One request to every endpoint concurrently; per-slot
         ``{"error", "type"}`` dicts on failure (client fanout
         contract)."""
         if self._scrape is not None:
-            return self._scrape(self.endpoints, req)
+            return self._scrape(eps, req)
         from triton_dist_tpu.serving.client import fanout
-        return fanout(requests=[dict(req) for _ in self.endpoints],
-                      timeout=self.timeout_s, endpoints=self.endpoints)
+        # retry_next=False pins slot i to endpoint i: a probe of
+        # replica A answered by replica B (the generation-path retry)
+        # would corrupt A's staleness record.
+        return fanout(requests=[dict(req) for _ in eps],
+                      timeout=self.timeout_s, endpoints=eps,
+                      retry_next=False)
 
     def _record(self, rec: _Rec, resp, key: str) -> None:
         now = self._clock()
@@ -490,11 +534,14 @@ class FleetView:
     def poll(self) -> list:
         """One concurrent health scrape; returns :meth:`replicas`."""
         t0 = time.perf_counter()
-        outs = self._scrape_all({"cmd": "health"})
+        eps = self._snapshot_eps()
+        outs = self._scrape_all(eps, {"cmd": "health"})
         _registry.histogram("fleet.scrape_ms").observe(
             (time.perf_counter() - t0) * 1e3)
-        for ep, resp in zip(self.endpoints, outs):
-            self._record(self._recs[ep], resp, "health")
+        for ep, resp in zip(eps, outs):
+            rec = self._recs.get(ep)   # may have been removed mid-poll
+            if rec is not None:
+                self._record(rec, resp, "health")
         rows = self.replicas()
         self._publish(rows)
         return rows
@@ -509,14 +556,17 @@ class FleetView:
         GOOD snapshot only if still ``stale`` or better — a ``down``
         replica's numbers leave the merge."""
         t0 = time.perf_counter()
-        outs = self._scrape_all({"cmd": "metrics",
-                                 "evaluate": bool(evaluate)})
+        eps = self._snapshot_eps()
+        outs = self._scrape_all(eps, {"cmd": "metrics",
+                                      "evaluate": bool(evaluate)})
         _registry.histogram("fleet.scrape_ms").observe(
             (time.perf_counter() - t0) * 1e3)
         now = self._clock()
         by_replica: dict = {}
-        for ep, resp in zip(self.endpoints, outs):
-            rec = self._recs[ep]
+        for ep, resp in zip(eps, outs):
+            rec = self._recs.get(ep)   # may have been removed mid-poll
+            if rec is None:
+                continue
             self._record(rec, resp, "metrics")
             status, _ = self._status(rec, now)
             if rec.snapshot is not None and status != "down":
@@ -547,8 +597,10 @@ class FleetView:
         value is never presented as current."""
         now = self._clock()
         rows = []
-        for ep in self.endpoints:
-            rec = self._recs[ep]
+        for ep in self._snapshot_eps():
+            rec = self._recs.get(ep)
+            if rec is None:
+                continue
             status, age = self._status(rec, now)
             rows.append({
                 "endpoint": f"{ep[0]}:{ep[1]}",

@@ -6,4 +6,5 @@ cross-request continuous batching, docs/serving.md)."""
 from triton_dist_tpu.serving.server import ModelServer  # noqa: F401
 from triton_dist_tpu.serving.client import ChatClient, fanout  # noqa: F401
 from triton_dist_tpu.serving.scheduler import (  # noqa: F401
-    QueueFull, Request, Scheduler)
+    Draining, QueueFull, Request, Scheduler)
+from triton_dist_tpu.serving.router import RouterServer  # noqa: F401

@@ -17,6 +17,18 @@ cheap ``{"cmd": "health"}`` verb; ``fanout(endpoints=[...])``
 round-robins a request list across replicas — the client-side fanout
 behind ``bench.py``'s ``serving_fleet`` part and
 ``obs.fleet.FleetView``'s concurrent scrapes.
+
+Fault awareness (ISSUE 15): multi-endpoint round-robin skips
+endpoints whose last round trip died at the socket level and retries
+the failed request once on the next endpoint (``fanout`` does the
+same per slot, sharing one dead-set per call), so a replica death
+costs a failover, not a client-visible error; and a ``queue_full`` /
+``draining`` reply's ``retry_after_ms`` hint earns one
+sleep-and-retry when the timeout budget allows
+(``retry_shed=False`` opts out). For health-gated placement and
+deadline-budgeted re-dispatch, front the fleet with
+``serving.router.RouterServer`` instead — these client-side paths
+are the router-less fallback.
 """
 
 from __future__ import annotations
@@ -24,6 +36,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 
 #: Sentinel distinguishing "no per-call timeout given" from an explicit
 #: ``timeout=None`` (= block forever).
@@ -42,7 +55,7 @@ def _parse_endpoint(ep) -> tuple:
 class ChatClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 8777,
                  tokenizer=None, timeout: float | None = None,
-                 endpoints=None):
+                 endpoints=None, retry_shed: bool = True):
         """``timeout``: seconds each protocol round trip may take
         (connect included) before ``TimeoutError``; ``None`` blocks
         indefinitely (the historical behavior). ``endpoints``: a list
@@ -50,9 +63,18 @@ class ChatClient:
         round-robin across them over one lazy persistent connection
         each (``host``/``port`` are ignored then); the single-endpoint
         form keeps its eager connect, so a refused connection still
-        fails at construction."""
+        fails at construction. Endpoints whose last round trip died at
+        the socket level are SKIPPED by the round-robin (and the
+        failed request retried ONCE on the next endpoint) until a
+        later success clears them — a dead replica degrades to one
+        client-side retry, never a per-request error (ISSUE 15).
+        ``retry_shed``: honor a ``queue_full`` / ``draining`` reply's
+        ``retry_after_ms`` hint on generation requests — sleep that
+        long and retry once when the timeout budget allows
+        (``False`` returns the raw shed reply)."""
         self.tokenizer = tokenizer
         self.timeout = timeout
+        self.retry_shed = retry_shed
         if endpoints:
             self.endpoints = [_parse_endpoint(e) for e in endpoints]
         else:
@@ -62,6 +84,7 @@ class ChatClient:
         self._rr = 0
         self._lock = threading.Lock()   # rr index + conn/lock creation
         self._ep_locks: dict = {}       # endpoint -> round-trip lock
+        self._bad: set = set()          # endpoints whose last try died
         if not endpoints:
             self._conn(self.endpoints[0])   # eager: historical contract
 
@@ -101,26 +124,34 @@ class ChatClient:
         return lk
 
     def _next_endpoint(self) -> tuple:
+        """Round-robin, skipping endpoints whose last round trip died
+        at the socket level (all-bad falls back to plain round-robin —
+        somebody has to probe them back to life)."""
         with self._lock:
-            ep = self.endpoints[self._rr % len(self.endpoints)]
+            n = len(self.endpoints)
+            for _ in range(n):
+                ep = self.endpoints[self._rr % n]
+                self._rr += 1
+                if ep not in self._bad:
+                    return ep
+            ep = self.endpoints[self._rr % n]
             self._rr += 1
         return ep
 
-    def request(self, req: dict, timeout=_UNSET, endpoint=None) -> dict:
-        """One protocol round trip with an arbitrary request object
-        (generation or control-plane, e.g. ``{"cmd": "metrics"}``).
-        ``timeout`` overrides the client default for this call only
-        (``socket.timeout`` is a ``TimeoutError``; that endpoint's
-        connection is left in an undefined protocol state after one —
-        reconnect). ``endpoint`` pins the replica; otherwise
-        multi-endpoint clients round-robin. Thread-safe: each
-        endpoint's write→read round trip runs under a per-endpoint
-        lock, so concurrent callers sharing one client serialize per
-        connection instead of interleaving protocol bytes (use
-        :func:`fanout` for genuinely concurrent traffic — one fresh
-        connection per request)."""
-        ep = (_parse_endpoint(endpoint) if endpoint is not None
-              else self._next_endpoint())
+    def _mark_bad(self, ep) -> None:
+        """Remember a socket-level failure and drop the endpoint's
+        (now protocol-undefined) cached connection."""
+        with self._lock:
+            self._bad.add(ep)
+            conn = self._conns.pop(ep, None)
+        if conn is not None:
+            for c in conn[::-1]:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+    def _roundtrip(self, ep, req: dict, timeout=_UNSET) -> dict:
         with self._ep_lock(ep):
             sock, file = self._conn(ep, connect_timeout=timeout)
             if timeout is not _UNSET:
@@ -135,6 +166,71 @@ class ChatClient:
         if not line:
             raise ConnectionError("server closed the connection")
         return json.loads(line)
+
+    def request(self, req: dict, timeout=_UNSET, endpoint=None) -> dict:
+        """One protocol round trip with an arbitrary request object
+        (generation or control-plane, e.g. ``{"cmd": "metrics"}``).
+        ``timeout`` overrides the client default for this call only
+        (``socket.timeout`` is a ``TimeoutError``; that endpoint's
+        connection is left in an undefined protocol state after one —
+        reconnect). ``endpoint`` pins the replica; otherwise
+        multi-endpoint clients round-robin, skip endpoints whose last
+        round trip died, and retry a socket-level failure ONCE on the
+        next endpoint (so a replica death surfaces as a failover, not
+        a client error — ISSUE 15); a pinned or single-endpoint call
+        keeps the historical raise. Thread-safe: each endpoint's
+        write→read round trip runs under a per-endpoint lock, so
+        concurrent callers sharing one client serialize per connection
+        instead of interleaving protocol bytes (use :func:`fanout`
+        for genuinely concurrent traffic — one fresh connection per
+        request)."""
+        pinned = endpoint is not None
+        ep = _parse_endpoint(endpoint) if pinned else None
+        resp = self._request_failover(ep, req, timeout, pinned)
+        # Shed backpressure with a hint (docs/serving.md): a
+        # queue_full / draining reply carrying retry_after_ms earns
+        # ONE sleep-and-retry on a generation request — when the
+        # timeout budget covers the sleep — instead of bouncing the
+        # shed straight back to a caller who will immediately hammer.
+        if (self.retry_shed and "prompt_ids" in req
+                and isinstance(resp, dict)
+                and resp.get("type") in ("queue_full", "draining")
+                and resp.get("retry_after_ms")):
+            delay_s = float(resp["retry_after_ms"]) / 1e3
+            budget = self.timeout if timeout is _UNSET else timeout
+            if budget is None or delay_s < float(budget):
+                time.sleep(delay_s)
+                # Same failover contract as the first attempt: an
+                # endpoint dying DURING the backpressure sleep must
+                # cost the one retry, not a raw socket error.
+                resp = self._request_failover(ep, req, timeout,
+                                              pinned)
+        return resp
+
+    def _request_failover(self, ep, req: dict, timeout,
+                          pinned: bool) -> dict:
+        """One round trip with the dead-endpoint contract: a failure
+        at the socket OR framing level (``OSError``; ``ValueError``
+        covers a torn/garbled reply line from a connection severed
+        mid-write — the same classes the router's dispatch counts)
+        marks the endpoint bad and retries ONCE on the next endpoint;
+        pinned/single-endpoint calls keep the historical raise."""
+        if ep is None:
+            ep = self._next_endpoint()
+        try:
+            resp = self._roundtrip(ep, req, timeout)
+        except (OSError, ValueError):
+            self._mark_bad(ep)
+            if pinned or len(self.endpoints) < 2:
+                raise
+            nxt = self._next_endpoint()
+            if nxt == ep:
+                raise
+            resp = self._roundtrip(nxt, req, timeout)  # single retry
+            ep = nxt
+        with self._lock:
+            self._bad.discard(ep)
+        return resp
 
     def generate_ids(self, prompt_ids, gen_len: int = 16,
                      trace_id: str | None = None,
@@ -199,9 +295,33 @@ class ChatClient:
                 pass
 
 
+def request_once(endpoint, req: dict,
+                 timeout: float | None = None) -> dict:
+    """One fresh-connection protocol round trip — the raw JSON-lines
+    framing primitive, shared with ``RouterServer``'s dispatch
+    attempts (serving/router.py) so the wire contract has ONE home.
+    Raises ``OSError`` on transport failure (connect/timeout/reset),
+    ``ConnectionError`` when the server closes without a reply line,
+    and ``ValueError`` on a torn/garbled reply — the failure classes
+    breakers and failover count. No retries, no endpoint skipping:
+    callers that want the fault-aware behavior use
+    :class:`ChatClient` / :func:`fanout`."""
+    ep = _parse_endpoint(endpoint)
+    with socket.create_connection(ep, timeout=timeout) as s:
+        s.settimeout(timeout)
+        with s.makefile("rwb") as f:
+            f.write((json.dumps(req) + "\n").encode())
+            f.flush()
+            line = f.readline()
+    if not line:
+        raise ConnectionError("server closed the connection")
+    return json.loads(line)
+
+
 def fanout(host: str | None = None, port: int | None = None,
            requests: list | None = None,
-           timeout: float | None = None, endpoints=None) -> list:
+           timeout: float | None = None, endpoints=None,
+           retry_next: bool = True) -> list:
     """Issue ``requests`` (protocol dicts) CONCURRENTLY — one fresh
     connection and thread per request — and return the responses in
     request order. A request that fails client-side (timeout, refused
@@ -214,7 +334,15 @@ def fanout(host: str | None = None, port: int | None = None,
     list: request ``i`` goes to ``endpoints[i % len(endpoints)]`` —
     the client-side round-robin the ``serving_fleet`` bench and
     ``obs.fleet.FleetView`` ride (per-request timeout, so one wedged
-    replica cannot stall the other slots)."""
+    replica cannot stall the other slots). A slot whose endpoint
+    fails client-side is retried ONCE on the next endpoint that no
+    sibling slot has seen die (ISSUE 15): a replica death mid-fanout
+    costs one retry, and cannot be mis-attributed as a client
+    failure; only a retry that ALSO fails records the error dict.
+    ``retry_next=False`` pins slot ``i`` to ``endpoints[i % n]``
+    exactly — what a health/metrics scrape needs: replica A's probe
+    answered by replica B would corrupt per-replica records
+    (``obs.fleet.FleetView`` passes it)."""
     if endpoints:
         eps = [_parse_endpoint(e) for e in endpoints]
     else:
@@ -224,18 +352,44 @@ def fanout(host: str | None = None, port: int | None = None,
     if requests is None:
         raise ValueError("fanout needs requests")
     results: list = [None] * len(requests)
+    dead: set = set()       # endpoints some slot saw die (GIL-safe)
+
+    def one_shot(ep, payload: dict) -> dict:
+        c = ChatClient(ep[0], ep[1], timeout=timeout)
+        try:
+            return c.request(payload)
+        finally:
+            c.close()
+
+    def pick(start: int):
+        """The first not-known-dead endpoint from ``start``; falls
+        back to the start slot when every endpoint is dead."""
+        n = len(eps)
+        for j in range(n):
+            ep = eps[(start + j) % n]
+            if ep not in dead:
+                return ep
+        return eps[start % n]
 
     def worker(i: int, payload: dict) -> None:
-        h, p = eps[i % len(eps)]
+        ep = pick(i) if retry_next else eps[i % len(eps)]
         try:
-            c = ChatClient(h, p, timeout=timeout)
-            try:
-                results[i] = c.request(payload)
-            finally:
-                c.close()
+            results[i] = one_shot(ep, payload)
+            return
         except Exception as e:  # noqa: BLE001 — per-slot isolation
-            results[i] = {"error": str(e) or repr(e),
-                          "type": type(e).__name__}
+            dead.add(ep)
+            err = e
+        if retry_next and len(eps) > 1:
+            nxt = pick(i + 1)
+            if nxt != ep:
+                try:
+                    results[i] = one_shot(nxt, payload)
+                    return
+                except Exception as e:  # noqa: BLE001
+                    dead.add(nxt)
+                    err = e
+        results[i] = {"error": str(err) or repr(err),
+                      "type": type(err).__name__}
 
     threads = [threading.Thread(target=worker, args=(i, r), daemon=True)
                for i, r in enumerate(requests)]

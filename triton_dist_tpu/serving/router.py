@@ -1,0 +1,694 @@
+"""Fault-tolerant replica router: the fleet's front door (ISSUE 15).
+
+One :class:`RouterServer` speaks the existing JSON-lines wire protocol
+in front of N ``ModelServer`` replicas, so a client talks to ONE
+endpoint and the fleet's failures stay the fleet's problem — a dead
+replica costs the fleet its capacity share, never a client-visible
+failure or a head-of-line stall (the T3 interleaving thesis applied at
+the fleet level; the one-sided-progress posture of the NVSHMEM paper
+in PAPERS.md applied to replicas instead of peers):
+
+- **Health-gated placement.** The router owns an
+  :class:`~triton_dist_tpu.obs.fleet.FleetView` over the replicas
+  (background health polls every ``TDT_ROUTER_POLL_S``) and places
+  each generation request on the best-scoring replica via the ISSUE-14
+  ``placement_score`` ranking — ``down`` replicas are excluded,
+  ``stale`` ones penalized, ``draining`` ones (router-side or
+  advertised through the health verb) skipped outright.
+- **Per-replica circuit breakers.** Each replica carries its own
+  :class:`~triton_dist_tpu.resilience.breaker.CircuitBreaker`
+  (op ``replica.<host:port>`` — the same machinery, gauges and
+  half-open probe semantics the fused-op paths use): dispatch
+  failures open it, an open breaker removes the replica from
+  placement until the cooldown admits one half-open probe dispatch,
+  and that probe's outcome re-closes or re-opens it.
+- **Failover re-dispatch.** Generation requests are RE-ISSUABLE: the
+  router holds the prompt, so when a replica dies or wedges mid-flight
+  (connection refused/reset, per-attempt timeout, torn reply, or any
+  error reply that is a REPLICA fault — engine/device failure, a
+  dying scheduler's farewell; the request's own errors like a
+  malformed prompt pass through, replaying them elsewhere would fail
+  identically) the router replays the
+  request on the next healthy replica — bounded by
+  ``TDT_ROUTER_RETRIES`` re-dispatches with ``TDT_ROUTER_BACKOFF_MS``
+  exponential backoff, all inside the request's
+  ``TDT_ROUTER_DEADLINE_S`` budget — and the client sees ONE response,
+  annotated ``"failovers": n``. Greedy decode replays are
+  idempotent-by-construction (same prompt → same tokens on any
+  replica); docs/resilience.md "Replica failover" carries the full
+  argument.
+- **Structured load-shed.** When every placeable replica sheds
+  (``queue_full`` / ``draining``) the router answers a FLEET-level
+  ``{"type": "queue_full", "scope": "fleet"}`` with a
+  ``retry_after_ms`` hint derived from the replicas' rolling TPOT ×
+  queue depth (``serving.scheduler.retry_after_ms_hint`` — the
+  soonest replica's estimate); when nothing is placeable at all (or
+  the retry/deadline budget runs out) the reply is
+  ``{"type": "no_healthy_replicas"}``, still with a hint.
+- **Live add/remove with graceful drain.** ``router_add`` attaches a
+  replica (it joins placement after its first health poll);
+  ``router_remove`` stops placing, waits for the router's in-flight
+  dispatches to that replica to finish (optionally asking the replica
+  itself to ``drain``), then detaches — in-flight accounting rides
+  the per-replica dispatch counters, the replica side rides
+  ``Scheduler.inflight()``.
+- **Observability.** ``router.*`` counters/gauges (docs/observability
+  .md), ``router.request`` spans + ``router.failover`` /
+  ``router.shed`` / ``router.replica_down`` instants carrying the
+  request's trace ID (the router forwards the SAME ID to every
+  dispatch attempt, so one Perfetto story spans the failed replica,
+  the failover hop, and the replica that answered), and flight dumps
+  on a replica going down and on failover storms
+  (``TDT_ROUTER_STORM`` failovers within 10 s).
+
+Protocol verbs (docs/serving.md "Router"): generation requests and
+``dump_trace``/``metrics``/``health`` behave like a single server's
+(metrics/health are the ROUTER's own — scrape replicas directly, or
+through ``router_status``, for theirs); plus
+
+    → {"cmd": "router_status"}
+    ← {"router": {"replicas": [...], "counters": ...,
+                  "uptime_s": ...}}
+    → {"cmd": "router_add", "endpoint": "host:port"}
+    → {"cmd": "router_remove", "endpoint": "host:port",
+       "drain": true, "wait_s": 10}
+
+Tested end to end by the chaos harness (testing/chaos.py +
+tests/test_router.py): kill one of three replicas mid-traffic-window →
+zero failed client requests, every in-flight request re-dispatched
+(``failovers ≥ 1``), the replica marked down within the configured
+age, and a validated flight dump with the trace-ID-stitched failover
+story. The ``serving_router`` bench part measures the same scenario
+(`serving_router_vs_direct`, gated by ``check_router_wellformed``).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+
+from triton_dist_tpu import obs
+from triton_dist_tpu.obs import flight, trace
+from triton_dist_tpu.obs.fleet import (
+    FleetView, _env_float, parse_endpoint)
+from triton_dist_tpu.resilience.breaker import CircuitBreaker
+from triton_dist_tpu.serving.scheduler import retry_after_ms_hint
+from triton_dist_tpu.serving.server import _Handler, _TCPServer
+
+__all__ = ["DEFAULT_BACKOFF_MS", "DEFAULT_DEADLINE_S",
+           "DEFAULT_POLL_S", "DEFAULT_RETRIES", "DEFAULT_STORM",
+           "DEFAULT_TRY_TIMEOUT_S", "RouterServer"]
+
+DEFAULT_RETRIES = 3           # max re-dispatches per request
+DEFAULT_BACKOFF_MS = 50       # base failover backoff (exponential)
+DEFAULT_DEADLINE_S = 120.0    # whole-request re-dispatch budget
+DEFAULT_TRY_TIMEOUT_S = 30.0  # per-dispatch-attempt cap
+DEFAULT_POLL_S = 1.0          # background health-poll cadence
+DEFAULT_STORM = 5             # failovers in STORM_WINDOW_S → dump
+STORM_WINDOW_S = 10.0
+#: Placement penalty per ROUTER-SIDE in-flight dispatch to a replica.
+#: Health-derived scores only refresh per poll; between polls every
+#: identical replica ties and the sort is stable, so without a live
+#: term EVERY concurrent request would land on the same replica until
+#: the next poll. The router's own dispatch counter is the real-time
+#: signal placement_score cannot see (same scale as its QUEUE_WEIGHT
+#: family — obs/fleet.py).
+INFLIGHT_WEIGHT = 0.25
+#: Replies whose type means "this replica is shedding, place
+#: elsewhere" — liveness evidence, NOT a breaker failure.
+_SHED_TYPES = ("queue_full", "draining")
+#: Error-reply types that are the REQUEST's own fault (malformed
+#: prompt, over-budget batch — the scheduler/server raise these for
+#: client mistakes): passed through unchanged, since replaying the
+#: same bad request elsewhere would fail identically. Every OTHER
+#: error reply is a REPLICA fault (engine/device failure, a dying
+#: scheduler's farewell) — re-dispatchable like a connection failure,
+#: and a breaker count against the replica that produced it.
+_CLIENT_FAULT_TYPES = ("ValueError", "TypeError", "KeyError")
+
+
+class _Replica:
+    """Router-side state for one replica endpoint."""
+
+    __slots__ = ("endpoint", "label", "breaker", "inflight",
+                 "draining", "last_status")
+
+    def __init__(self, endpoint, breaker: CircuitBreaker):
+        self.endpoint = endpoint
+        self.label = f"{endpoint[0]}:{endpoint[1]}"
+        self.breaker = breaker
+        self.inflight = 0          # router-side dispatches in flight
+        self.draining = False      # router-side: stop placing
+        self.last_status = None    # last observed FleetView status
+
+
+class RouterServer:
+    """Front-end replica router over N ``ModelServer`` endpoints.
+
+    Same construction surface as ``ModelServer`` where it makes sense:
+    ``port=0`` picks a free port, ``registry="private"`` scopes the
+    router's own metrics (REQUIRED when router and replicas share a
+    process, e.g. the bench/tests), ``telemetry=True`` arms the
+    tracer/flight recorder. The fault knobs are ctor-overridable for
+    tests (``retries``, ``backoff_ms``, ``deadline_s``,
+    ``try_timeout_s``, ``poll_s``, ``breaker_threshold``,
+    ``breaker_cooldown_s``) and env-tunable in production
+    (``TDT_ROUTER_*`` — docs/serving.md "Router")."""
+
+    def __init__(self, endpoints, host: str = "127.0.0.1",
+                 port: int = 0, telemetry: bool = True, registry=None,
+                 retries: int | None = None,
+                 backoff_ms: int | None = None,
+                 deadline_s: float | None = None,
+                 try_timeout_s: float | None = None,
+                 poll_s: float | None = None,
+                 breaker_threshold: int | None = None,
+                 breaker_cooldown_s: float | None = None,
+                 fleet: FleetView | None = None,
+                 fleet_kwargs: dict | None = None):
+        if not endpoints:
+            raise ValueError("RouterServer needs at least one replica "
+                             "endpoint")
+        self.registry = None
+        if registry == "private":
+            self.registry = obs.Registry()
+        elif registry is not None:
+            self.registry = registry
+        if telemetry:
+            obs.enable()
+            if trace.env_enabled(default=True):
+                trace.enable()
+                flight.install_signal_handlers()
+        self.retries = (retries if retries is not None else
+                        obs.env_int("TDT_ROUTER_RETRIES",
+                                    DEFAULT_RETRIES))
+        self.backoff_ms = (backoff_ms if backoff_ms is not None else
+                           obs.env_int("TDT_ROUTER_BACKOFF_MS",
+                                       DEFAULT_BACKOFF_MS))
+        self.deadline_s = (deadline_s if deadline_s is not None else
+                           _env_float("TDT_ROUTER_DEADLINE_S",
+                                      DEFAULT_DEADLINE_S))
+        self.try_timeout_s = (
+            try_timeout_s if try_timeout_s is not None else
+            _env_float("TDT_ROUTER_TRY_TIMEOUT_S",
+                       DEFAULT_TRY_TIMEOUT_S))
+        self.poll_s = (poll_s if poll_s is not None else
+                       _env_float("TDT_ROUTER_POLL_S", DEFAULT_POLL_S))
+        self.storm = obs.env_int("TDT_ROUTER_STORM", DEFAULT_STORM)
+        self._breaker_threshold = breaker_threshold
+        self._breaker_cooldown_s = breaker_cooldown_s
+        self.fleet = (fleet if fleet is not None
+                      else FleetView(endpoints, **(fleet_kwargs or {})))
+        self._lock = threading.Lock()   # replica dict + inflight
+        self._replicas: dict = {}
+        for ep in self.fleet.endpoints:
+            self._replicas[ep] = self._make_replica(ep)
+        self._failover_times: collections.deque = collections.deque()
+        self._health_seq = itertools.count(1)
+        self._started_monotonic = time.monotonic()
+        self._stop = threading.Event()
+        self._srv = _TCPServer((host, port), _Handler)
+        try:
+            self._srv.model_server = self   # duck-typed for _Handler
+            self.host, self.port = self._srv.server_address
+        except BaseException:
+            self._srv.server_close()
+            raise
+        self._thread: threading.Thread | None = None
+        self._poll_thread: threading.Thread | None = None
+        # One synchronous poll so placement works from request one
+        # (an unpolled FleetView scores every replica -inf).
+        with obs.scoped_registry(self.registry):
+            self._poll_once()
+
+    # -- replica bookkeeping ----------------------------------------------
+    def _make_replica(self, ep) -> _Replica:
+        # Private breaker instances (not the global per-op registry):
+        # the router's breakers are per-ENDPOINT infra state, reset
+        # with the router, and their gauges still emit through the
+        # shared resilience.<op>.* names for dashboards. Construction
+        # emits the initial state gauge, so it must run under the
+        # router's registry scope like every later state change — an
+        # unscoped ctor would write resilience.* gauges into the
+        # process-global registry an in-process sibling replica
+        # scrapes (review finding).
+        with obs.scoped_registry(self.registry):
+            return _Replica(ep, CircuitBreaker(
+                f"replica.{ep[0]}:{ep[1]}",
+                threshold=self._breaker_threshold,
+                cooldown_s=self._breaker_cooldown_s))
+
+    def add_replica(self, endpoint) -> dict:
+        """Attach a replica live: it joins the fleet view now and
+        placement as soon as a health poll sees it (one runs
+        immediately)."""
+        ep = self.fleet.add_endpoint(endpoint)
+        with self._lock:
+            self._replicas[ep] = self._make_replica(ep)
+        self._poll_once()
+        obs.counter("router.replicas_added").inc()
+        return {"added": f"{ep[0]}:{ep[1]}",
+                "replicas": len(self._replicas)}
+
+    def remove_replica(self, endpoint, drain: bool = True,
+                       wait_s: float | None = None,
+                       replica_drain: bool = False) -> dict:
+        """Detach a replica — gracefully by default: stop placing
+        (router-side draining flag), wait up to ``wait_s`` (default
+        10 s) for this router's in-flight dispatches to it to finish,
+        then drop it from placement and the fleet view.
+        ``replica_drain=True`` additionally sends the replica itself
+        the ``drain`` verb first (it stops admitting from EVERY
+        client, not just this router)."""
+        ep = parse_endpoint(endpoint)
+        with self._lock:
+            st = self._replicas.get(ep)
+        if st is None:
+            return {"error": f"unknown replica {endpoint!r}"}
+        st.draining = True
+        self._publish_draining()
+        if replica_drain:
+            try:
+                self._dispatch(ep, {"cmd": "drain"},
+                               self.try_timeout_s)
+            except Exception:  # noqa: BLE001 — replica may be dead
+                pass
+        drained = True
+        if drain:
+            deadline = time.monotonic() + (10.0 if wait_s is None
+                                           else float(wait_s))
+            while st.inflight > 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            drained = st.inflight == 0
+        with self._lock:
+            self._replicas.pop(ep, None)
+        try:
+            self.fleet.remove_endpoint(ep)
+        except ValueError:
+            pass
+        self._publish_draining()
+        obs.counter("router.replicas_removed").inc()
+        return {"removed": st.label, "drained": drained,
+                "inflight": st.inflight}
+
+    def _publish_draining(self) -> None:
+        with self._lock:
+            n = sum(1 for st in self._replicas.values() if st.draining)
+        obs.gauge("router.replicas_draining").set(n)
+
+    # -- health polling ----------------------------------------------------
+    def _poll_once(self) -> None:
+        rows = self.fleet.poll()
+        for r in rows:
+            ep = parse_endpoint(r["endpoint"])
+            with self._lock:
+                st = self._replicas.get(ep)
+            if st is None:
+                continue
+            prev, st.last_status = st.last_status, r["status"]
+            if r["status"] == "down" and prev not in (None, "down"):
+                # A replica just went dark: leave the postmortem NOW,
+                # while the ring still holds its last requests'
+                # events (rate-limited; no-op when tracing is off).
+                obs.counter("router.replicas_down_seen").inc()
+                trace.instant("router.replica_down", "resilience",
+                              args={"replica": st.label,
+                                    "age_s": r["age_s"]})
+                flight.maybe_dump("replica_down")
+
+    def _poll_loop(self) -> None:
+        with obs.scoped_registry(self.registry):
+            while not self._stop.wait(self.poll_s):
+                try:
+                    self._poll_once()
+                except Exception:  # noqa: BLE001 — polling must survive
+                    obs.counter("router.poll_errors").inc()
+
+    # -- placement ---------------------------------------------------------
+    def _candidates(self, excluded: set) -> list:
+        """Placeable replicas best-first: attached, not draining
+        (router-side or health-advertised), not ``down``, not already
+        tried/saturated for this request. Breaker gating happens at
+        selection time (``_place``) because ``allow()`` consumes the
+        half-open probe slot."""
+        out = []
+        for r in self.fleet.replicas():
+            ep = parse_endpoint(r["endpoint"])
+            if ep in excluded or r["status"] == "down":
+                continue
+            with self._lock:
+                st = self._replicas.get(ep)
+            if st is None or st.draining:
+                continue
+            if (r["health"] or {}).get("draining"):
+                continue
+            score = r["score"]
+            score = float("-inf") if score is None else score
+            out.append((score - INFLIGHT_WEIGHT * st.inflight,
+                        ep, st))
+        out.sort(key=lambda t: -t[0])
+        return [(ep, st) for _, ep, st in out]
+
+    def _place(self, excluded: set):
+        """The best placeable replica whose breaker admits a call
+        right now (an open breaker's replica is skipped until its
+        cooldown admits the single half-open probe — which this
+        dispatch then IS)."""
+        for ep, st in self._candidates(excluded):
+            if st.breaker.allow():
+                return ep, st
+        return None, None
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch(self, ep, payload: dict, timeout: float) -> dict:
+        """One fresh-connection round trip to a replica. Raises
+        ``OSError``/``TimeoutError``/``ValueError`` on transport or
+        framing failure — the failure classes the breaker counts
+        (``serving.client.request_once`` is the one home for the
+        wire framing)."""
+        from triton_dist_tpu.serving.client import request_once
+        return request_once(ep, payload, timeout=timeout)
+
+    def _fleet_retry_after_ms(self) -> int:
+        """The fleet-level backpressure hint: the SOONEST replica's
+        rolling-TPOT × queue-depth estimate (the client should retry
+        when the least-loaded replica is likely to have a free slot),
+        through the same clamped formula the single-server reply uses
+        (``serving.scheduler.retry_after_ms_hint``)."""
+        hints = []
+        for r in self.fleet.replicas():
+            h = r["health"]
+            if r["status"] == "down" or not h:
+                continue
+            hints.append(retry_after_ms_hint(
+                (h.get("rolling") or {}).get("tpot_p50_ms"),
+                h.get("queue_depth")))
+        return min(hints) if hints else retry_after_ms_hint(None, 0)
+
+    def _note_failover(self) -> None:
+        obs.counter("router.failovers").inc()
+        now = time.monotonic()
+        self._failover_times.append(now)
+        while self._failover_times and \
+                now - self._failover_times[0] > STORM_WINDOW_S:
+            self._failover_times.popleft()
+        if len(self._failover_times) >= self.storm:
+            # A failover STORM means the fleet is churning (several
+            # replicas failing, or one flapping fast): dump the
+            # window while it still shows the churn (rate-limited).
+            obs.counter("router.failover_storms").inc()
+            flight.maybe_dump("failover_storm")
+
+    def _serve_generate(self, req: dict) -> dict:
+        obs.counter("router.requests").inc()
+        obs.gauge("router.inflight").inc()
+        t0 = time.perf_counter()
+        try:
+            resp = self._serve_generate_placed(req, t0)
+        finally:
+            obs.gauge("router.inflight").dec()
+        obs.histogram("router.request_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
+        return resp
+
+    def _serve_generate_placed(self, req: dict, t0: float) -> dict:
+        trace_id = str(req.get("trace_id") or trace.new_trace_id())
+        payload = dict(req)
+        # One trace ID across EVERY dispatch attempt: the failed
+        # replica's admission events, the router's failover instant,
+        # and the answering replica's retire all tell one story.
+        payload["trace_id"] = trace_id
+        deadline = t0 + self.deadline_s
+        failed = 0                     # failed dispatch attempts
+        excluded: set = set()          # endpoints tried this request
+        saturated = False              # saw >= 1 shed reply
+        last_err = None
+        cleared_at = -1                # last `failed` a re-round ran at
+        with trace.bind(trace_id), \
+                trace.span("router.request", "serving",
+                           args={"gen_len": req.get("gen_len"),
+                                 "batch": len(req.get("prompt_ids")
+                                              or [])}):
+            while True:
+                ep, st = self._place(excluded)
+                if ep is None and excluded and failed \
+                        and failed != cleared_at \
+                        and failed <= self.retries \
+                        and time.perf_counter() < deadline:
+                    # Every candidate was consumed by THIS request's
+                    # failures/sheds but retry budget remains: one
+                    # more round (covers the single-replica transient
+                    # blip — with nothing else to fail over to, the
+                    # bounded retry goes back to the same replica
+                    # after the backoff). At most one re-round per
+                    # FAILURE — shed replies alone never re-round,
+                    # they answer with retry_after_ms instead.
+                    cleared_at = failed
+                    excluded = set()
+                    ep, st = self._place(excluded)
+                if ep is None:
+                    return self._shed_reply(saturated, failed,
+                                            last_err, trace_id)
+                budget = deadline - time.perf_counter()
+                if budget <= 0:
+                    obs.counter("router.deadline_exhausted").inc()
+                    return self._shed_reply(saturated, failed,
+                                            last_err, trace_id)
+                timeout = min(self.try_timeout_s, budget)
+                obs.counter(f"router.placements.{st.label}").inc()
+                with self._lock:
+                    st.inflight += 1
+                try:
+                    resp = self._dispatch(ep, payload, timeout)
+                except (OSError, ValueError) as e:
+                    # Transport death: refused/reset/timeout/garbage.
+                    failure, resp = e, None
+                finally:
+                    with self._lock:
+                        st.inflight -= 1
+                if resp is not None:
+                    err = resp.get("error") if isinstance(resp, dict) \
+                        else None
+                    if isinstance(resp, dict) \
+                            and resp.get("type") in _SHED_TYPES:
+                        # The replica answered "busy/leaving" — alive
+                        # (close a half-open probe), just not
+                        # placeable for THIS request.
+                        st.breaker.record_success()
+                        excluded.add(ep)
+                        saturated = True
+                        obs.counter("router.replica_sheds").inc()
+                        continue
+                    if err is None or resp.get("type") \
+                            in _CLIENT_FAULT_TYPES:
+                        # Success — or the REQUEST's own error
+                        # (malformed prompt: replaying it elsewhere
+                        # fails identically): passthrough unchanged,
+                        # the replica did its job. Any other error
+                        # reply is a replica fault and takes the
+                        # failover path below — a replica whose
+                        # engine is broken must open its breaker and
+                        # lose placements, not keep erroring at
+                        # clients while healthy siblings idle.
+                        st.breaker.record_success()
+                        if failed:
+                            resp["failovers"] = failed
+                        resp.setdefault("trace_id", trace_id)
+                        resp.setdefault("replica", st.label)
+                        return resp
+                    failure = RuntimeError(
+                        f"{resp.get('type')}: {err}")
+                # A replica failure: count it, open the breaker path,
+                # back off, re-dispatch elsewhere (the prompt is right
+                # here — generation requests are re-issuable).
+                last_err = failure
+                failed += 1
+                excluded.add(ep)
+                st.breaker.record_failure()
+                obs.counter("router.dispatch_errors").inc()
+                trace.instant("router.failover", "resilience",
+                              args={"replica": st.label,
+                                    "attempt": failed,
+                                    "error": str(failure)[:120]})
+                if failed > self.retries:
+                    obs.counter("router.retries_exhausted").inc()
+                    return self._shed_reply(saturated, failed,
+                                            last_err, trace_id)
+                self._note_failover()
+                backoff = (self.backoff_ms / 1e3) * (2 ** (failed - 1))
+                backoff = min(backoff,
+                              max(deadline - time.perf_counter(), 0.0))
+                if backoff > 0:
+                    time.sleep(backoff)
+
+    def _shed_reply(self, saturated: bool, failed: int, last_err,
+                    trace_id: str) -> dict:
+        hint = self._fleet_retry_after_ms()
+        if saturated and last_err is None:
+            # Every placeable replica answered queue_full/draining:
+            # the fleet is SATURATED, not broken — same structured
+            # shape as a single server's shed, scoped to the fleet.
+            obs.counter("router.shed").inc()
+            trace.instant("router.shed", "serving",
+                          args={"retry_after_ms": hint})
+            return {"error": "every replica is saturated — retry "
+                             "later", "type": "queue_full",
+                    "scope": "fleet", "retry_after_ms": hint,
+                    "trace_id": trace_id}
+        obs.counter("router.no_replicas").inc()
+        resp = {"error": "no healthy replica could serve the request"
+                         + (f" (last failure: {last_err})"
+                            if last_err else ""),
+                "type": "no_healthy_replicas",
+                "retry_after_ms": hint, "trace_id": trace_id}
+        if failed:
+            resp["failovers"] = failed
+        return resp
+
+    # -- protocol ----------------------------------------------------------
+    def _serve_request(self, req: dict) -> dict:
+        with obs.scoped_registry(self.registry):
+            return self._serve_request_scoped(req)
+
+    def _serve_request_scoped(self, req: dict) -> dict:
+        if "cmd" in req:
+            return self._serve_command(req)
+        if "prompt_ids" not in req:
+            obs.counter("router.errors").inc()
+            return {"error": "request needs prompt_ids or cmd",
+                    "type": "ValueError"}
+        return self._serve_generate(req)
+
+    def status(self) -> dict:
+        """The ``router_status`` payload: per-replica placement rows —
+        fleet status/age/score joined with the router's OWN dimension
+        (breaker state, in-flight dispatches, draining flag) — plus
+        the router counters a postmortem reads first."""
+        rows = []
+        for r in self.fleet.replicas():
+            ep = parse_endpoint(r["endpoint"])
+            with self._lock:
+                st = self._replicas.get(ep)
+            if st is None:
+                continue
+            rows.append({
+                "endpoint": r["endpoint"],
+                "replica_id": r["replica_id"],
+                "status": r["status"],
+                "age_s": r["age_s"],
+                "score": r["score"],
+                "breaker": st.breaker.state,
+                "inflight": st.inflight,
+                "draining": bool(
+                    st.draining
+                    or (r["health"] or {}).get("draining")),
+            })
+        from triton_dist_tpu.obs.fleet import peek_counters
+        c = peek_counters(self.registry or obs.get_registry())
+        counters = {k: v for k, v in c.items()
+                    if k.startswith("router.")
+                    and not k.startswith("router.placements.")}
+        placements = {k[len("router.placements."):]: v
+                      for k, v in c.items()
+                      if k.startswith("router.placements.")}
+        return {"replicas": rows, "counters": counters,
+                "placements": placements,
+                "uptime_s": round(
+                    time.monotonic() - self._started_monotonic, 3)}
+
+    def _serve_command(self, req: dict) -> dict:
+        cmd = req["cmd"]
+        if cmd == "router_status":
+            return {"router": self.status()}
+        if cmd == "router_add":
+            if "endpoint" not in req:
+                obs.counter("router.errors").inc()
+                return {"error": "router_add needs endpoint"}
+            try:
+                return self.add_replica(req["endpoint"])
+            except ValueError as e:
+                obs.counter("router.errors").inc()
+                return {"error": str(e), "type": "ValueError"}
+        if cmd == "router_remove":
+            if "endpoint" not in req:
+                obs.counter("router.errors").inc()
+                return {"error": "router_remove needs endpoint"}
+            wait_s = req.get("wait_s")
+            return self.remove_replica(
+                req["endpoint"], drain=bool(req.get("drain", True)),
+                wait_s=float(wait_s) if wait_s is not None else None,
+                replica_drain=bool(req.get("replica_drain")))
+        if cmd == "health":
+            # The router's OWN health (a router is not a replica —
+            # point FleetView at the replicas, or use router_status,
+            # for theirs): enough for a watchdog to gate on.
+            seq = next(self._health_seq)
+            rows = self.fleet.replicas()
+            return {"health": {
+                "router": True,
+                "replica_id": f"router@{self.host}:{self.port}",
+                "seq": seq,
+                "uptime_s": round(
+                    time.monotonic() - self._started_monotonic, 3),
+                "replicas": {
+                    st: sum(1 for r in rows if r["status"] == st)
+                    for st in ("live", "stale", "down")},
+            }}
+        if cmd == "metrics":
+            snap = obs.snapshot()
+            snap["replica_id"] = f"router@{self.host}:{self.port}"
+            snap["router"] = self.status()
+            if trace.enabled():
+                snap["trace"] = trace.stats()
+            resp = {"metrics": snap}
+            if req.get("format") == "prometheus":
+                resp["prometheus"] = obs.render_prometheus(snap)
+            return resp
+        if cmd == "dump_trace":
+            if not trace.enabled():
+                obs.counter("router.errors").inc()
+                return {"error": "tracing is disabled (TDT_TRACE)"}
+            path = flight.dump("cmd", last_s=req.get("seconds"))
+            return {"dumped": path, "trace": trace.stats()}
+        obs.counter("router.errors").inc()
+        return {"error": f"unknown cmd {cmd!r} (known: router_status, "
+                         f"router_add, router_remove, health, "
+                         f"metrics, dump_trace)"}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "RouterServer":
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self._poll_thread = threading.Thread(target=self._poll_loop,
+                                             name="tdt-router-poll",
+                                             daemon=True)
+        self._poll_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5.0)
+            self._poll_thread = None
+
+
+def main():  # pragma: no cover - manual entry
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--endpoints", required=True,
+                    help="comma-separated host:port replica list")
+    ap.add_argument("--port", type=int, default=8700)
+    args = ap.parse_args()
+    eps = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+    srv = RouterServer(eps, port=args.port).start()
+    print(f"routing {len(eps)} replica(s) on {srv.host}:{srv.port}")
+    threading.Event().wait()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

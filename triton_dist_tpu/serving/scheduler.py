@@ -55,6 +55,17 @@ Design:
   (docs/serving.md "Speculative decoding"). Fairness is unchanged:
   admission is still strictly FIFO per iteration, and a burst never
   exceeds the row's remaining ``gen_len`` budget.
+- **Drain + in-flight accounting** (ISSUE 15). :meth:`Scheduler.drain`
+  flips the scheduler to admit-nothing-new (``submit`` raises
+  :class:`Draining`, the server answers a structured ``draining``
+  reply, ``serving.draining`` advertises it through the health verb)
+  while everything already in flight finishes; :meth:`inflight` counts
+  the requests still owed an answer and :meth:`wait_idle` blocks until
+  it reaches zero — the wait a graceful replica removal
+  (``RouterServer.remove_replica``) rides. ``retry_after_ms_hint``
+  turns rolling TPOT × queue depth into the backpressure hint both
+  the single-server ``queue_full`` reply and the router's fleet-level
+  shed carry.
 - **Observability** (docs/observability.md): ``serving.queue_depth``
   and ``serving.batch_occupancy`` gauges, per-request
   ``serving.ttft_ms`` and ``serving.queue_wait_ms`` histograms,
@@ -94,15 +105,53 @@ import warnings
 from triton_dist_tpu import obs
 from triton_dist_tpu.obs import attrib, devprof, slo, trace
 
-__all__ = ["DEFAULT_MAX_WAITING", "QueueFull", "Request", "Scheduler"]
+__all__ = ["DEFAULT_MAX_WAITING", "Draining", "QueueFull", "Request",
+           "RETRY_AFTER_MAX_MS", "RETRY_AFTER_MIN_MS", "Scheduler",
+           "retry_after_ms_hint"]
 
 DEFAULT_MAX_WAITING = 64
+
+#: Bounds on the ``retry_after_ms`` backpressure hint (ISSUE 15): the
+#: floor keeps a quiet server from telling clients to hammer at 0 ms,
+#: the cap keeps one deep queue from parking clients for minutes.
+RETRY_AFTER_MIN_MS = 25
+RETRY_AFTER_MAX_MS = 5000
+#: The hint when no TPOT signal exists yet (cold server): one modest
+#: beat, not zero.
+RETRY_AFTER_DEFAULT_MS = 100
+
+
+def retry_after_ms_hint(tpot_p50_ms, queue_depth) -> int:
+    """Backpressure hint for ``queue_full`` / ``draining`` replies:
+    how long a shed client should wait before retrying, derived from
+    the rolling per-output-token time times the queue depth (a crude
+    but honest estimate of when a queued slot frees up), clamped to
+    ``[RETRY_AFTER_MIN_MS, RETRY_AFTER_MAX_MS]``. With no TPOT signal
+    (cold server, SLO engine off) the hint is
+    ``RETRY_AFTER_DEFAULT_MS`` — the one home for the formula shared
+    by the single-server reply and the router's fleet-level shed
+    (serving/router.py)."""
+    try:
+        tpot = float(tpot_p50_ms) if tpot_p50_ms is not None else 0.0
+    except (TypeError, ValueError):
+        tpot = 0.0
+    if tpot <= 0.0:
+        return RETRY_AFTER_DEFAULT_MS
+    est = tpot * max(float(queue_depth or 0.0), 1.0)
+    return int(min(max(est, RETRY_AFTER_MIN_MS), RETRY_AFTER_MAX_MS))
 
 
 class QueueFull(RuntimeError):
     """Admission queue is at ``max_waiting`` — backpressure; the caller
     should retry later (the server turns this into a structured
     ``queue_full`` reply)."""
+
+
+class Draining(QueueFull):
+    """The scheduler is draining (ISSUE 15): it finishes what is in
+    flight but admits nothing new — the server answers a structured
+    ``draining`` reply so a router stops placing here and clients
+    retry elsewhere."""
 
 
 class Request:
@@ -215,10 +264,74 @@ class Scheduler:
         self._running = False
         self._thread: threading.Thread | None = None
         self._session = None
+        self._inflight = 0          # live requests queued or in rows
+        self._draining = False
+        #: Injectable per-iteration hook (testing.chaos.wedge_pump):
+        #: called by the pump thread at the top of every work
+        #: iteration, OUTSIDE the scheduler lock — a hook that blocks
+        #: wedges the pump exactly the way a stuck device step would,
+        #: while handler threads (health, metrics) keep answering.
+        self.pump_hook = None
 
     # -- client side -------------------------------------------------------
     def queue_depth(self) -> int:
         return len(self._queue)
+
+    def inflight(self) -> int:
+        """Live requests the scheduler currently owes an answer —
+        queued plus admitted (in a decode row or mid-prefill). The
+        in-flight accounting a graceful drain waits on (ISSUE 15)."""
+        with self._cond:
+            return self._inflight
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> None:
+        """Stop admitting NEW requests (``submit`` raises
+        :class:`Draining`); everything already queued or in flight
+        finishes normally. Publishes ``serving.draining`` so the
+        replica's health verb advertises it and a router stops placing
+        here (docs/serving.md "Drain")."""
+        with self._cond:
+            self._draining = True
+        with obs.scoped_registry(self._registry):
+            obs.gauge("serving.draining").set(1)
+
+    def resume(self) -> None:
+        """Cancel a drain: the scheduler admits again."""
+        with self._cond:
+            self._draining = False
+        with obs.scoped_registry(self._registry):
+            obs.gauge("serving.draining").set(0)
+
+    def wait_idle(self, timeout: float | None = None) -> bool:
+        """Block until no request is in flight (the drain wait);
+        True when idle, False if ``timeout`` elapsed first."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cond:
+            while self._inflight > 0:
+                left = (None if deadline is None
+                        else deadline - time.monotonic())
+                if left is not None and left <= 0:
+                    return False
+                self._cond.wait(0.05 if left is None
+                                else min(left, 0.05))
+            return True
+
+    def retry_after_ms(self) -> int:
+        """This scheduler's backpressure hint (rolling TPOT p50 ×
+        queue depth, clamped — :func:`retry_after_ms_hint`), read
+        lock-free from the replica's own registry like the health
+        verb."""
+        from triton_dist_tpu.obs import fleet as _fleet
+        g = _fleet.peek_gauges(self._registry
+                               or obs.get_registry())
+        return retry_after_ms_hint(
+            g.get("serving.rolling.tpot_p50_ms"),
+            g.get("serving.queue_depth", len(self._queue)))
 
     def _make_request(self, prompt, gen_len, stop_tokens, trace_id):
         prompt = [int(t) for t in prompt]
@@ -266,6 +379,10 @@ class Scheduler:
         with self._cond:
             if not self._running:
                 raise RuntimeError("scheduler is not running")
+            if self._draining:
+                raise Draining(
+                    "scheduler is draining — this replica admits "
+                    "nothing new; retry on another replica")
             reqs = [self._make_request(p, gen_len, stop_tokens, trace_id)
                     for p in prompts]
             live = [r for r in reqs if r.gen_len > 0]
@@ -290,6 +407,7 @@ class Scheduler:
                         f"({len(self._queue)} waiting, "
                         f"max_waiting {self.max_waiting})")
                 self._queue.extend(live)
+                self._inflight += len(live)
                 obs.gauge("serving.queue_depth").set(len(self._queue))
                 self._cond.notify()
         return reqs
@@ -342,6 +460,20 @@ class Scheduler:
 
     def _fail(self, req: Request, exc: BaseException) -> None:
         req.error = exc
+        self._finish(req)
+
+    def _finish(self, req: Request) -> None:
+        """Mark one live request done and release its in-flight slot
+        (idempotent — the pump-death drain may revisit an already
+        failed request). Wakes :meth:`wait_idle` when the count hits
+        zero."""
+        if req.done.is_set():
+            return
+        with self._cond:
+            if self._inflight > 0:
+                self._inflight -= 1
+            if self._inflight == 0:
+                self._cond.notify_all()
         req.done.set()
 
     def _pump(self) -> None:
@@ -452,7 +584,7 @@ class Scheduler:
                            args=self._targs({"row": row, "rid": req.rid,
                                              "tokens": len(req.tokens)}),
                            trace_id=req.trace_id)
-                req.done.set()
+                self._finish(req)
 
         def admit(row: int, req: Request) -> None:
             req.t_admit = time.perf_counter()
@@ -527,6 +659,15 @@ class Scheduler:
             # wait on queue capacity, never on device time. The devprof
             # sampler wraps exactly this lock-free region — a capture
             # can span it but never a held scheduler lock.
+            hook = self.pump_hook
+            if hook is not None:
+                # Chaos/test hook (testing.chaos.wedge_pump): runs in
+                # the lock-free work region, so a blocking hook wedges
+                # engine progress — in-flight rows stall, admissions
+                # stop — while handler threads stay responsive (the
+                # wedged-replica failure class the router's dispatch
+                # deadline exists for).
+                hook()
             t_iter0 = time.perf_counter()
             prof = (self.devprof.iteration()
                     if self.devprof is not None and (admits or rows)

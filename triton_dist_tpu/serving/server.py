@@ -18,10 +18,18 @@ response's ``gen_len`` echoes the EFFECTIVE value — requests past the
 protocol cap (4096) or the engine's room (max_seq − longest prompt)
 are clamped, counted into ``server.gen_len_clamped``, never silent.
 A full admission queue answers a structured backpressure reply
-instead of stalling the connection —
+instead of stalling the connection, with a ``retry_after_ms`` hint
+(rolling TPOT × queue depth, clamped — ISSUE 15) that ``ChatClient``
+honors instead of immediately hammering again —
 
     ← {"error": ..., "type": "queue_full", "queue_depth": N,
-       "max_waiting": M}
+       "max_waiting": M, "retry_after_ms": T}
+
+``{"cmd": "drain"}`` starts a graceful drain (nothing new admitted —
+generation requests answer ``{"type": "draining", ...}`` — while
+everything in flight finishes; ``"wait_s"`` blocks until idle,
+``"resume": true`` cancels): the verb a router's graceful replica
+removal speaks (docs/serving.md "Drain").
 
 Telemetry (docs/observability.md): a metrics request on the same
 protocol returns the server's registry snapshot, stamped with this
@@ -117,11 +125,34 @@ class _Handler(socketserver.StreamRequestHandler):
         # path records) land in the owning server's registry — the
         # per-replica isolation that keeps fleet counter sums correct
         # when several servers share a process (no-op when the server
-        # uses the process-global registry).
-        with obs.scoped_registry(self.server.model_server.registry):
-            self._handle_scoped()
+        # uses the process-global registry). The connection registers
+        # with the owner so a chaos-harness kill can SEVER live
+        # connections (testing/chaos.py: a killed replica's clients
+        # must see a dead socket, never a polite error reply).
+        owner = self.server.model_server
+        track = getattr(owner, "_track_connection", None)
+        if track is not None:
+            track(self.connection)
+        try:
+            with obs.scoped_registry(owner.registry):
+                self._handle_scoped()
+        finally:
+            untrack = getattr(owner, "_untrack_connection", None)
+            if untrack is not None:
+                untrack(self.connection)
 
     def _handle_scoped(self):
+        try:
+            self._serve_lines()
+        except OSError:
+            # The peer vanished mid-read (reset/abort): routers
+            # abandon dispatch connections at their per-attempt
+            # deadline BY DESIGN (serving/router.py), and a chaos
+            # sever does the same — connection-scoped, the server
+            # keeps serving every other client.
+            return
+
+    def _serve_lines(self):
         for line in self.rfile:
             line = line.strip()
             if not line:
@@ -216,6 +247,10 @@ class ModelServer:
             if trace.env_enabled(default=True):
                 trace.enable()
                 flight.install_signal_handlers()
+        # Live connection registry (chaos harness: kill_replica severs
+        # these; see _Handler.handle).
+        self._conn_lock = threading.Lock()
+        self._active_conns: set = set()
         # Bind FIRST so the default replica_id can be host:port — but
         # close the listening socket if the REST of construction
         # raises (e.g. a malformed TDT_MAX_WAITING inside the
@@ -263,6 +298,14 @@ class ModelServer:
             self._srv.server_close()
             raise
         self._thread: threading.Thread | None = None
+
+    def _track_connection(self, conn) -> None:
+        with self._conn_lock:
+            self._active_conns.add(conn)
+
+    def _untrack_connection(self, conn) -> None:
+        with self._conn_lock:
+            self._active_conns.discard(conn)
 
     def _serve_request(self, req: dict) -> dict:
         # Handler threads route their emissions into this replica's
@@ -347,6 +390,32 @@ class ModelServer:
             if req.get("format") == "prometheus":
                 resp["prometheus"] = obs.render_prometheus(snap)
             return resp
+        if cmd == "drain":
+            # Graceful drain (ISSUE 15, docs/serving.md "Drain"): stop
+            # admitting, finish what is in flight. ``"resume": true``
+            # cancels; ``"wait_s": N`` blocks until idle (or the
+            # deadline). The reply always carries the live in-flight
+            # count so a router can poll the drain to completion.
+            if self.scheduler is None:
+                obs.counter("server.errors").inc()
+                return {"error": "drain needs the scheduler path "
+                                 "(scheduler=False serializes whole "
+                                 "generations — stop the server "
+                                 "instead)"}
+            if req.get("resume"):
+                self.scheduler.resume()
+                return {"draining": False,
+                        "inflight": self.scheduler.inflight()}
+            self.scheduler.drain()
+            drained = None
+            if req.get("wait_s") is not None:
+                drained = self.scheduler.wait_idle(
+                    float(req["wait_s"]))
+            resp = {"draining": True,
+                    "inflight": self.scheduler.inflight()}
+            if drained is not None:
+                resp["drained"] = drained
+            return resp
         if cmd == "dump_trace":
             if not trace.enabled():
                 obs.counter("server.errors").inc()
@@ -360,7 +429,7 @@ class ModelServer:
             return {"requests": attrib.last(req.get("last"))}
         obs.counter("server.errors").inc()
         return {"error": f"unknown cmd {cmd!r} (known: metrics, "
-                         f"health, dump_trace, request_stats)"}
+                         f"health, drain, dump_trace, request_stats)"}
 
     def _effective_gen_len(self, req: dict, prompts) -> int:
         """Clamp the requested gen_len to the protocol cap (4096) AND
@@ -383,20 +452,38 @@ class ModelServer:
         gen_len = self._effective_gen_len(req, prompts)
         stop = req.get("stop_tokens")  # None → engine default (eos)
         if self.scheduler is not None:
-            from triton_dist_tpu.serving.scheduler import QueueFull
+            from triton_dist_tpu.serving.scheduler import (
+                Draining, QueueFull)
             try:
                 futures = self.scheduler.submit_many(
                     prompts, gen_len, stop_tokens=stop,
                     trace_id=trace.current_trace_id())
+            except Draining:
+                # Graceful drain in progress: structurally like
+                # queue_full (retry elsewhere / later) but with its
+                # own type so a router knows this replica is LEAVING,
+                # not merely busy.
+                obs.counter("server.backpressure_replies").inc()
+                return {"error": "replica is draining — retry on "
+                                 "another replica",
+                        "type": "draining",
+                        "inflight": self.scheduler.inflight(),
+                        "retry_after_ms":
+                            self.scheduler.retry_after_ms()}
             except QueueFull:
                 # Structured backpressure, not an exception page: the
                 # client sees WHY and can retry; the connection (and
-                # every other request in flight) is untouched.
+                # every other request in flight) is untouched. The
+                # retry_after_ms hint (rolling TPOT × queue depth,
+                # clamped) tells it WHEN — ChatClient honors it
+                # instead of hammering (docs/serving.md).
                 obs.counter("server.backpressure_replies").inc()
                 return {"error": "admission queue full — retry later",
                         "type": "queue_full",
                         "queue_depth": self.scheduler.queue_depth(),
-                        "max_waiting": self.scheduler.max_waiting}
+                        "max_waiting": self.scheduler.max_waiting,
+                        "retry_after_ms":
+                            self.scheduler.retry_after_ms()}
             # Rows retire exactly at their first stop token, so the
             # uniform client contract (tokens end at and include the
             # first stop token) needs no trimming here.

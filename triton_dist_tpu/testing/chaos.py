@@ -1,0 +1,267 @@
+"""Fleet-level fault injection: the chaos harness behind the router's
+fault-tolerance proofs (ISSUE 15).
+
+``testing/faults.py`` injects OP-level failures (compile timeouts,
+comm errors) inside one process; this module injects REPLICA-level
+failures against a live fleet, each injector producing exactly the
+failure signature its real-world counterpart would — so the router
+tests and the ``serving_router`` bench exercise the same transitions
+production would see (tests/test_chaos.py pins each injector to the
+FleetView/breaker transition it claims):
+
+- :func:`kill_replica` — the in-process analog of ``SIGKILL`` on a
+  replica: live connections are SEVERED (clients and routers see a
+  dead socket mid-request, never a polite error reply), the listening
+  socket closes (new connections refuse), the pump stops. FleetView:
+  scrapes fail immediately → ``stale`` → ``down`` by age.
+- :func:`wedge_pump` — blocks the scheduler pump via the injectable
+  ``Scheduler.pump_hook``: in-flight requests STALL while the replica
+  keeps answering health from its handler threads. The nastiest
+  failure class — liveness checks pass while the replica serves
+  nothing; only a dispatch deadline (the router's per-attempt
+  timeout → breaker) catches it. FleetView: stays ``live``.
+- :class:`ChaosProxy` — a TCP proxy fronting a replica with
+  switchable connection faults, for failure classes that live in the
+  NETWORK rather than the replica: ``blackhole`` (accepts, swallows
+  bytes, never answers — scrapes/dispatches hang to their timeout),
+  ``drop`` (accepts then immediately closes — instant connection
+  death), ``delay`` (forwards with added latency on the reply path —
+  drives health responses past the stale/down thresholds without
+  touching the replica), and :meth:`ChaosProxy.sever` (cut every live
+  link mid-request). Point the FleetView/router at
+  ``proxy.endpoint`` instead of the replica.
+
+All injectors are deterministic, wall-clock-free where possible
+(FleetView transitions are asserted with injected clocks), and
+reversible — ``forward`` mode / ``resume`` / ``release`` restore
+service so recovery paths are testable too.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import socket
+import threading
+
+__all__ = ["ChaosProxy", "Wedge", "kill_replica", "wedge_pump"]
+
+_BUF = 65536
+
+
+def kill_replica(server) -> None:
+    """Abruptly kill an in-process ``ModelServer`` — the deterministic
+    stand-in for ``kill -9`` on a replica process:
+
+    1. the listening socket closes (new connections are refused),
+    2. every live connection is severed at the socket level (a client
+       or router blocked on a reply gets EOF/reset — crucially NOT a
+       structured error reply: a dead process sends nothing),
+    3. the scheduler pump stops (in-flight rows die; their handler
+       threads' farewell writes land on the already-dead sockets).
+
+    Idempotent; ``server.stop()`` afterwards stays safe (test
+    teardown)."""
+    srv = server._srv
+    srv.shutdown()
+    srv.server_close()
+    with server._conn_lock:
+        conns = list(server._active_conns)
+    for conn in conns:
+        try:
+            conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+    if server.scheduler is not None:
+        server.scheduler.stop(timeout=5.0)
+
+
+class Wedge:
+    """Handle for a wedged pump: ``fired`` is set once the pump hit
+    the wedge (it is provably stuck, not merely idle); ``release()``
+    lets it continue."""
+
+    def __init__(self):
+        self.fired = threading.Event()
+        self._release = threading.Event()
+
+    def release(self) -> None:
+        self._release.set()
+
+    def _hook(self) -> None:
+        self.fired.set()
+        self._release.wait()
+
+
+@contextlib.contextmanager
+def wedge_pump(scheduler):
+    """Wedge a scheduler's pump thread for the duration of the block:
+    the next work iteration blocks inside the injectable
+    ``Scheduler.pump_hook`` (the stand-in for a stuck device step or
+    a hung collective), so in-flight requests stall and nothing
+    admits — while handler threads keep answering health/metrics.
+    Yields a :class:`Wedge`; the wedge always releases on exit (and
+    the hook is removed), so a test failure cannot leak a stuck
+    pump."""
+    w = Wedge()
+    prev = scheduler.pump_hook
+    scheduler.pump_hook = w._hook
+    try:
+        yield w
+    finally:
+        scheduler.pump_hook = prev
+        w.release()
+
+
+class ChaosProxy:
+    """TCP proxy fronting one replica endpoint with switchable
+    connection-level faults.
+
+    Modes (``set_mode``; applied to connections ACCEPTED after the
+    switch — use :meth:`sever` to also cut the live ones):
+
+    - ``"forward"`` — transparent byte pump both ways (default);
+      ``delay_s > 0`` adds that much latency before each reply-side
+      chunk (replica → client), which is how health responses are
+      pushed past the fleet's stale/down thresholds without touching
+      the replica.
+    - ``"blackhole"`` — accept, read and discard, never reply and
+      never contact the replica: the peer hangs until its own
+      timeout (the dropped-connection/partition class).
+    - ``"drop"`` — accept then immediately close: instant connection
+      death (the fast-failing variant).
+
+    ``stop()`` closes the listener and severs everything."""
+
+    MODES = ("forward", "blackhole", "drop")
+
+    def __init__(self, target, host: str = "127.0.0.1", port: int = 0):
+        from triton_dist_tpu.obs.fleet import parse_endpoint
+        self.target = parse_endpoint(target)
+        self._mode = "forward"
+        self.delay_s = 0.0
+        self._lock = threading.Lock()
+        self._links: set = set()
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.host, self.port = self._listener.getsockname()
+        self._stopped = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="tdt-chaos-proxy",
+            daemon=True)
+        self._accept_thread.start()
+
+    @property
+    def endpoint(self) -> tuple:
+        """The ``(host, port)`` clients/FleetViews should target."""
+        return (self.host, self.port)
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    def set_mode(self, mode: str, delay_s: float = 0.0) -> None:
+        if mode not in self.MODES:
+            raise ValueError(
+                f"unknown chaos mode {mode!r} (known: {self.MODES})")
+        self._mode = mode
+        self.delay_s = float(delay_s)
+
+    # -- plumbing ----------------------------------------------------------
+    def _register(self, sock) -> None:
+        with self._lock:
+            self._links.add(sock)
+
+    def _close(self, sock) -> None:
+        with self._lock:
+            self._links.discard(sock)
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return              # listener closed
+            mode, delay = self._mode, self.delay_s
+            if mode == "drop":
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            self._register(conn)
+            if mode == "blackhole":
+                threading.Thread(target=self._swallow, args=(conn,),
+                                 daemon=True).start()
+                continue
+            try:
+                up = socket.create_connection(self.target, timeout=10)
+            except OSError:
+                self._close(conn)
+                continue
+            self._register(up)
+            threading.Thread(target=self._pump,
+                             args=(conn, up, 0.0), daemon=True).start()
+            threading.Thread(target=self._pump,
+                             args=(up, conn, delay),
+                             daemon=True).start()
+
+    def _swallow(self, conn) -> None:
+        try:
+            while conn.recv(_BUF):
+                pass
+        except OSError:
+            pass
+        finally:
+            self._close(conn)
+
+    def _pump(self, src, dst, delay_s: float) -> None:
+        try:
+            while True:
+                data = src.recv(_BUF)
+                if not data:
+                    break
+                if delay_s > 0:
+                    # Latency injection on this direction (reply path
+                    # when src is the replica side).
+                    self._stopped.wait(delay_s)
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                self._close(s)
+
+    # -- faults ------------------------------------------------------------
+    def sever(self) -> int:
+        """Cut every LIVE proxied connection (both sides) — a
+        mid-request connection kill; new connections still follow the
+        current mode. Returns how many sockets were cut."""
+        with self._lock:
+            links = list(self._links)
+        for s in links:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._close(s)
+        return len(links)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.sever()

@@ -436,6 +436,78 @@ def check_fleet_wellformed(extras: dict) -> list[str]:
     return fails
 
 
+#: Slack on the down-detection deadline: "down" is DEFINED as
+#: last-good-scrape age exceeding the down threshold, so detection can
+#: never land meaningfully under it — what the gate must catch is a
+#: router that missed the death by a poll period or more, not the
+#: sub-second scrape/poll lag inherent to the mechanism.
+DOWN_DETECT_SLACK_S = 2.0
+
+
+def check_router_wellformed(extras: dict) -> list[str]:
+    """Failure strings when the serving_router part ran (its tokens/s
+    key exists) without leaving well-formed fault-tolerance evidence
+    (ISSUE 15). The kill window is the part's whole point, so when
+    the part ran its kill keys are REQUIRED:
+
+    - ``serving_router_vs_direct`` present and positive (router
+      overhead vs client-side round-robin on the same fleet);
+    - ``serving_router_kill_client_errors`` == 0 — killing one of
+      three replicas mid-window must cost ZERO client-visible
+      failures (the acceptance bar);
+    - ``serving_router_failovers`` ≥ 1 — at least one request was
+      actually re-dispatched (zero would mean the kill window missed
+      every in-flight request and proved nothing);
+    - ``serving_router_down_detect_s`` ≤ ``serving_router_down_s`` +
+      :data:`DOWN_DETECT_SLACK_S` (the configured
+      TDT_FLEET_DOWN_S-style age, plus the scrape/poll lag the
+      mechanism cannot avoid) — the router noticed the death within
+      its own threshold.
+
+    Empty when the part did not run."""
+    if "serving_router_tokens_per_s" not in extras:
+        return []
+    fails = []
+    v = extras.get("serving_router_vs_direct")
+    if not isinstance(v, (int, float)) or isinstance(v, bool) \
+            or float(v) <= 0.0:
+        fails.append(
+            f"serving_router_vs_direct: missing/malformed ({v!r}) — "
+            f"the serving_router part ran but published no "
+            f"router-vs-direct ratio")
+    errs = extras.get("serving_router_kill_client_errors")
+    if not isinstance(errs, (int, float)) or isinstance(errs, bool):
+        fails.append(f"serving_router_kill_client_errors: "
+                     f"missing/malformed ({errs!r})")
+    elif errs:
+        fails.append(
+            f"serving_router_kill_client_errors: {errs} client-"
+            f"visible failure(s) during the kill window — the router "
+            f"did not absorb the replica death")
+    fo = extras.get("serving_router_failovers")
+    if not isinstance(fo, (int, float)) or isinstance(fo, bool) \
+            or fo < 1:
+        fails.append(
+            f"serving_router_failovers: want >= 1 recorded failover "
+            f"in the kill window, got {fo!r} — zero means no request "
+            f"was in flight on the victim and the window proved "
+            f"nothing")
+    det = extras.get("serving_router_down_detect_s")
+    down_s = extras.get("serving_router_down_s")
+    if not isinstance(det, (int, float)) or isinstance(det, bool) \
+            or not isinstance(down_s, (int, float)) \
+            or isinstance(down_s, bool):
+        fails.append(
+            f"serving_router_down_detect_s/serving_router_down_s: "
+            f"missing/malformed ({det!r}/{down_s!r})")
+    elif det > down_s + DOWN_DETECT_SLACK_S:
+        fails.append(
+            f"serving_router_down_detect_s: {det} > configured down "
+            f"age {down_s} + {DOWN_DETECT_SLACK_S}s slack — the "
+            f"router missed its detection deadline")
+    return fails
+
+
 def _extras_from_file(path: str) -> dict:
     """Extras dict from any bench artifact: a bench.py checkpoint
     ({"extras": ...}), a bench.py result line ({"metric", "extras"}),
@@ -497,6 +569,7 @@ def run_regress(baseline_path: str, from_file: str | None,
     fails += check_mega_serving_wellformed(extras)
     fails += check_spec_serving_wellformed(extras)
     fails += check_fleet_wellformed(extras)
+    fails += check_router_wellformed(extras)
     fails += check_overlap_measured_wellformed(extras)
     fails += check_measured_overlap_floors(
         extras, load_measured_overlap_floors(baseline_path, tier))

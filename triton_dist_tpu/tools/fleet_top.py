@@ -19,9 +19,19 @@ Usage:
     python -m triton_dist_tpu.tools.fleet_top \\
         --endpoints 127.0.0.1:8777,127.0.0.1:8778 [--interval 2]
         [--once]
+    python -m triton_dist_tpu.tools.fleet_top --router 127.0.0.1:8700
 
-``render()`` is pure (state dict → string) so the screen is testable
-without servers (tests/test_fleet.py).
+``--router`` watches a :class:`~triton_dist_tpu.serving.router
+.RouterServer` instead (ISSUE 15): one ``{"cmd": "router_status"}``
+scrape per tick renders the ROUTER's per-replica placement rows —
+status/age/score joined with breaker state, router-side in-flight
+dispatches and draining flags — plus the failover / shed / placement
+counters, so a failover postmortem reads from the same dashboard as
+single-replica serving.
+
+``render()`` / ``render_router()`` are pure (state dict → string) so
+both screens are testable without servers (tests/test_fleet.py,
+tests/test_router.py).
 """
 
 from __future__ import annotations
@@ -138,11 +148,85 @@ def render(state: dict) -> str:
     return "\n".join(lines)
 
 
+_ROUTER_HEADER = ["replica", "st", "age", "breaker", "infl", "drain",
+                  "score", "placed"]
+
+
+def render_router(status: dict) -> str:
+    """One router screen from a ``{"cmd": "router_status"}``
+    ``router`` payload (``RouterServer.status()`` shape): per-replica
+    placement rows (fleet status joined with the router's breaker /
+    in-flight / draining dimension) and the router counters."""
+    rows = status.get("replicas") or []
+    placements = status.get("placements") or {}
+    lines = [f"tdt router — {time.strftime('%H:%M:%S')} — "
+             f"{len(rows)} replica(s), uptime "
+             f"{_fmt(status.get('uptime_s'))}s", ""]
+    if not rows:
+        lines.append("(no replicas)")
+    else:
+        table = [_ROUTER_HEADER]
+        for r in rows:
+            rid = r.get("replica_id") or r.get("endpoint") or "?"
+            table.append([
+                rid,
+                r.get("status", "?"),
+                f"{_fmt(r.get('age_s'))}s",
+                r.get("breaker", "?"),
+                _fmt(r.get("inflight")),
+                "yes" if r.get("draining") else "-",
+                _fmt(r.get("score")),
+                _fmt(placements.get(r.get("endpoint"))
+                     or placements.get(rid)),
+            ])
+        widths = [max(len(row[i]) for row in table)
+                  for i in range(len(_ROUTER_HEADER))]
+        for row in table:
+            lines.append("  ".join(
+                c.ljust(w) for c, w in zip(row, widths)).rstrip())
+    c = status.get("counters") or {}
+    bits = []
+    for key, label in (("router.requests", "requests"),
+                       ("router.failovers", "failovers"),
+                       ("router.shed", "shed"),
+                       ("router.no_replicas", "no-replica"),
+                       ("router.dispatch_errors", "dispatch-err"),
+                       ("router.failover_storms", "storms")):
+        if key in c:
+            bits.append(f"{label} {_fmt(c[key])}")
+    if bits:
+        lines += ["", "router: " + "   ".join(bits)]
+    return "\n".join(lines)
+
+
+def fetch_router(endpoint, timeout: float | None = None) -> dict:
+    """One ``router_status`` scrape (degrades to an error screen
+    payload, never raises — dashboard contract)."""
+    from triton_dist_tpu.serving.client import ChatClient
+    try:
+        c = ChatClient(*_parse(endpoint), timeout=timeout or 5.0)
+        try:
+            return c.request({"cmd": "router_status"}).get("router", {})
+        finally:
+            c.close()
+    except Exception as e:  # noqa: BLE001 — screen must render
+        return {"replicas": [], "counters": {},
+                "error": str(e) or repr(e)}
+
+
+def _parse(endpoint):
+    from triton_dist_tpu.obs.fleet import parse_endpoint
+    return parse_endpoint(endpoint)
+
+
 def main(argv=None) -> int:
     from triton_dist_tpu.obs.fleet import FleetView
     ap = argparse.ArgumentParser()
-    ap.add_argument("--endpoints", required=True,
+    ap.add_argument("--endpoints", default=None,
                     help="comma-separated host:port replica list")
+    ap.add_argument("--router", default=None,
+                    help="host:port of a RouterServer — render its "
+                         "router_status instead of direct scrapes")
     ap.add_argument("--interval", type=float, default=2.0)
     ap.add_argument("--iterations", type=int, default=None,
                     help="stop after N refreshes (default: forever)")
@@ -152,14 +236,28 @@ def main(argv=None) -> int:
                     help="per-replica scrape timeout "
                          "(default TDT_FLEET_TIMEOUT_S)")
     args = ap.parse_args(argv)
-    eps = [e.strip() for e in args.endpoints.split(",") if e.strip()]
-    view = FleetView(eps, timeout_s=args.timeout)
+    if not args.endpoints and not args.router:
+        ap.error("need --endpoints or --router")
+    view = None
+    if args.endpoints:
+        eps = [e.strip() for e in args.endpoints.split(",")
+               if e.strip()]
+        view = FleetView(eps, timeout_s=args.timeout)
     n = 1 if args.once else args.iterations
     i = 0
     try:
         while n is None or i < n:
-            screen = render(fetch(
-                view, with_metrics=args.once or i % METRICS_EVERY == 0))
+            if args.router:
+                screen = render_router(
+                    fetch_router(args.router, timeout=args.timeout))
+                if view is not None:
+                    screen += "\n\n" + render(fetch(
+                        view, with_metrics=args.once
+                        or i % METRICS_EVERY == 0))
+            else:
+                screen = render(fetch(
+                    view,
+                    with_metrics=args.once or i % METRICS_EVERY == 0))
             if not args.once:
                 sys.stdout.write("\x1b[2J\x1b[H")
             print(screen)

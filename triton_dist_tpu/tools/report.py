@@ -185,6 +185,49 @@ def render_fleet(merged: dict | None) -> str:
     return "\n".join(lines)
 
 
+def render_router(status: dict | None) -> str:
+    """Summarize a router-status payload (``RouterServer.status()`` —
+    the ``serving_router`` bench embeds one under
+    ``extras.telemetry.router``; a router's ``{"cmd": "metrics"}``
+    snapshot carries it under ``router``): per-replica placement rows
+    with the router's breaker / in-flight / draining dimension, plus
+    the failover and shed counters a failover postmortem reads first
+    (docs/serving.md "Router"). Empty string when absent."""
+    if not status or not status.get("replicas"):
+        return ""
+    placements = status.get("placements") or {}
+    lines = ["#### router",
+             "| replica | status | breaker | inflight | draining | "
+             "score | placed |", "|---|---|---|---|---|---|---|"]
+    for r in status["replicas"]:
+        rid = r.get("replica_id") or r.get("endpoint") or "?"
+        placed = (placements.get(r.get("endpoint"))
+                  or placements.get(rid) or 0)
+        lines.append(
+            f"| {rid} | {r.get('status')} | {r.get('breaker')} | "
+            f"{r.get('inflight')} | "
+            f"{'yes' if r.get('draining') else '-'} | "
+            f"{r.get('score')} | {int(placed)} |")
+    # EVERY router counter renders here: render_telemetry suppresses
+    # router.* from the generic table when this section exists, so a
+    # counter skipped here (retries_exhausted, poll_errors, ...)
+    # would be invisible in the postmortem — the opposite of what the
+    # section is for (review finding).
+    c = status.get("counters") or {}
+    bits = [f"{k.split('.', 1)[1]}={int(c[k])}" for k in sorted(c)]
+    if bits:
+        lines += ["", "router counters: " + "  ".join(bits)]
+    hop = status.get("failover_sample")
+    if hop:
+        # One stitched failover: this trace ID spans the dead
+        # replica's admit, the router's failover instant, and the
+        # answering replica's retire in the flight record.
+        lines += ["", f"failover sample: trace_id={hop.get('trace_id')}"
+                      f"  failovers={hop.get('failovers')}"
+                      f"  answered_by={hop.get('replica')}"]
+    return "\n".join(lines)
+
+
 def render_tracing(stats: dict | None) -> str:
     """Summarize the event-tracing / flight-recorder state
     (``obs.trace.stats()``, carried under the snapshot's ``trace`` key
@@ -290,6 +333,7 @@ def render_telemetry(snap: dict) -> str:
     serving = render_serving(snap)
     kv = render_kv(snap)
     fleet = render_fleet(snap.get("fleet"))
+    router = render_router(snap.get("router"))
     tracing = render_tracing(snap.get("trace"))
     devprof = render_devprof(snap, snap.get("devprof"))
     waterfalls = render_waterfalls(snap.get("waterfalls"))
@@ -307,9 +351,13 @@ def render_telemetry(snap: dict) -> str:
                                or (k.startswith("comms.")
                                    and ("_measured" in k
                                         or k.endswith("_drift_pct"))))))
+    # The router section renders every router.* COUNTER itself;
+    # router gauges/histograms are not in its payload and stay in the
+    # generic tables below.
     scalars = [("counter", k, v)
                for k, v in sorted(snap.get("counters", {}).items())
-               if not skip(k)]
+               if not skip(k)
+               and not (bool(router) and k.startswith("router."))]
     scalars += [("gauge", k, v)
                 for k, v in sorted(snap.get("gauges", {}).items())
                 if not skip(k)]
@@ -321,6 +369,8 @@ def render_telemetry(snap: dict) -> str:
         lines += [kv, ""]
     if fleet:
         lines += [fleet, ""]
+    if router:
+        lines += [router, ""]
     if tracing:
         lines += [tracing, ""]
     if devprof:
