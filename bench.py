@@ -49,7 +49,11 @@ What it benches (BASELINE.md north star; reference e2e_dense.md:21-38):
   (THREE replicas behind the fault-tolerant RouterServer vs direct
   round-robin, then the chaos acceptance scenario: one replica killed
   mid-window → zero client-visible failures, failovers recorded, down
-  detected within the configured age — CPU-valid, ISSUE 15), prefix (shared-preamble
+  detected within the configured age — CPU-valid, ISSUE 15),
+  serving_history (the SAME served workload with the obs.history
+  sampler off vs on — prices the history plane's overhead; the on-leg
+  must stay within the BASELINE.json floor of the off-leg, and its
+  sampled series snapshot is embedded for the report, ISSUE 16), prefix (shared-preamble
   clients, prefix cache warm vs cold — also CPU-valid), sp_attn, train. On a single chip the collective parts
   collapse, so the numbers measure Mosaic-kernel vs XLA compute
   quality; on a real slice the same code measures overlap.
@@ -184,8 +188,8 @@ def _probe_backend_subprocess(timeout_s: float) -> bool:
 _PART_ORDER = ("ag_gemm", "gemm_rs", "gemm_ar", "flash_decode", "tp_mlp",
                "layer_8b", "layer_32b", "overlap", "moe_ag_gg", "mega",
                "serving", "serving_mega", "serving_spec",
-               "serving_fleet", "serving_router", "prefix", "sp_attn",
-               "train")
+               "serving_fleet", "serving_router", "serving_history",
+               "prefix", "sp_attn", "train")
 
 #: Sweep-heavy parts get longer deadlines: ag_gemm/gemm_rs autotune
 #: 6-8 candidates at ~25 s Mosaic compile each on a COLD cache (the
@@ -285,6 +289,8 @@ def _run_parts_in_children(extras: dict) -> None:
                          or (prev or {}).get("fleet"))
                 router_snap = (tel.get("router")
                                or (prev or {}).get("router"))
+                hist_snap = (tel.get("history")
+                             or (prev or {}).get("history"))
                 try:
                     from triton_dist_tpu.obs import merge_snapshots
                     extras["telemetry"] = merge_snapshots([prev, tel])
@@ -294,6 +300,8 @@ def _run_parts_in_children(extras: dict) -> None:
                         extras["telemetry"]["fleet"] = fleet
                     if router_snap:
                         extras["telemetry"]["router"] = router_snap
+                    if hist_snap:
+                        extras["telemetry"]["history"] = hist_snap
                 except Exception:  # noqa: BLE001 — telemetry is extra
                     # Keep what already accumulated over prior parts;
                     # only seed from this child when there is nothing.
@@ -1380,6 +1388,112 @@ def _bench_serving_spec(mesh, n, on_tpu, extras):
     return results["spec"], extras.get("serving_spec_vs_plain")
 
 
+def _bench_serving_history(mesh, n, on_tpu, extras):
+    """The history plane's overhead, priced (ISSUE 16): the SAME
+    model, scheduler, and concurrent request stream served twice —
+    sampler off (the default; its zero-overhead-when-unused contract)
+    vs on at an aggressive 20 Hz tick (``TDT_HISTORY=1``,
+    ``TDT_HISTORY_TICK_S=0.05`` — 20x the default cadence, so the
+    measured ratio BOUNDS the deployed cost). The on-leg's throughput
+    ratio ``serving_history_on_vs_off`` is floor-gated in
+    BASELINE.json (cpu tier): a background thread doing lock-free
+    registry peeks must not meaningfully tax the pump. The on-leg's
+    ``{"cmd": "history"}`` snapshot is embedded for report.py's
+    "history" section, and its tick/series counts are the
+    well-formedness evidence ``bench_ops --regress`` checks."""
+    import jax
+    import jax.numpy as jnp
+    from triton_dist_tpu.models import DenseLLM, Engine, ModelConfig
+    from triton_dist_tpu.serving import ModelServer
+    from triton_dist_tpu.serving.client import ChatClient
+
+    if on_tpu:
+        cfg = ModelConfig(hidden_size=512, intermediate_size=1024,
+                          num_hidden_layers=2, num_attention_heads=8,
+                          num_key_value_heads=8, head_dim=64,
+                          vocab_size=2048, max_position_embeddings=512,
+                          dtype=jnp.bfloat16)
+        gen = 48
+    else:
+        cfg = ModelConfig(hidden_size=16, intermediate_size=32,
+                          num_hidden_layers=1, num_attention_heads=4,
+                          num_key_value_heads=4, head_dim=8,
+                          vocab_size=32, max_position_embeddings=128,
+                          dtype=jnp.float32)
+        gen = 32
+    model = DenseLLM(cfg, mesh=mesh, axis="tp", impl="xla")
+    params = model.init(jax.random.PRNGKey(4))
+    reqs = [{"prompt_ids": [[5, 6, 7, (11 + i) % cfg.vocab_size]],
+             "gen_len": gen} for i in range(8)]
+
+    _HIST_ENV = ("TDT_HISTORY", "TDT_HISTORY_TICK_S")
+
+    def run(history_on):
+        # The scheduler reads TDT_HISTORY* at CONSTRUCTION
+        # (HistorySampler.from_env), so the env toggle must bracket
+        # the ModelServer build — and must be restored even when the
+        # leg dies, or the off-leg would silently sample.
+        saved = {k: os.environ.get(k) for k in _HIST_ENV}
+        if history_on:
+            os.environ["TDT_HISTORY"] = "1"
+            os.environ["TDT_HISTORY_TICK_S"] = "0.05"
+        else:
+            for k in _HIST_ENV:
+                os.environ.pop(k, None)
+        try:
+            eng = Engine(model, batch=4,
+                         max_seq=cfg.max_position_embeddings,
+                         prefill_mode="xla_ar", decode_mode="gemm_ar")
+            srv = ModelServer(eng, params, port=0).start()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        try:
+            tps, errors, warm, snap = _served_workload_run(srv, reqs)
+            hist = None
+            if history_on:
+                c = ChatClient(srv.host, srv.port, timeout=30.0)
+                try:
+                    hist = c.request(
+                        {"cmd": "history", "max_points": 64})["history"]
+                finally:
+                    c.close()
+            return tps, errors, snap, hist
+        finally:
+            srv.stop()
+
+    results = {}
+    for tag, on in (("off", False), ("on", True)):
+        tps, errors, snap, hist = run(on)
+        results[tag] = tps
+        key = ("serving_history" if on
+               else "serving_history_off")
+        extras[f"{key}_tokens_per_s"] = round(tps, 2)
+        if errors:
+            extras[f"{key}_errors"] = [str(e)[:120]
+                                       for e in errors[:4]]
+        if on:
+            c = (snap or {}).get("counters", {})
+            extras["serving_history_ticks"] = int(
+                c.get("history.ticks", 0))
+            extras["serving_history_warnings"] = int(
+                c.get("history.warnings", 0))
+            extras["serving_history_series"] = (
+                len((hist or {}).get("series") or {}))
+            if hist and hist.get("series"):
+                # Rides under extras.telemetry.history only (report.py
+                # "history" section) — extras itself stays a flat
+                # scalar map for the regress gate.
+                extras["history_snapshot"] = hist
+    if results["off"] > 0:
+        extras["serving_history_on_vs_off"] = round(
+            results["on"] / results["off"], 4)
+    return results["on"], extras.get("serving_history_on_vs_off")
+
+
 def _bench_serving_fleet(mesh, n, on_tpu, extras):
     """The first measured multi-replica number (ISSUE 14): TWO
     in-process ``ModelServer`` replicas — same model, same params,
@@ -2400,6 +2514,8 @@ def main():
              lambda: _bench_serving_fleet(mesh, n, on_tpu, extras)),
             ("serving_router",
              lambda: _bench_serving_router(mesh, n, on_tpu, extras)),
+            ("serving_history",
+             lambda: _bench_serving_history(mesh, n, on_tpu, extras)),
             ("prefix",
              lambda: _bench_prefix(mesh, n, on_tpu, extras)),
             ("sp_attn",
@@ -2453,6 +2569,15 @@ def main():
                               or {}).get("router")
             if router_acc:
                 tel["router"] = router_acc
+            if "history_snapshot" in extras:
+                # The serving_history part's sampled-series snapshot
+                # likewise (report.py "history" section).
+                hist_acc = extras.pop("history_snapshot")
+            else:
+                hist_acc = (extras.get("telemetry")
+                            or {}).get("history")
+            if hist_acc:
+                tel["history"] = hist_acc
             if any(tel.values()):
                 extras["telemetry"] = tel
             _checkpoint_extras(extras, name)

@@ -77,6 +77,13 @@ def _isolate_trace(monkeypatch, tmp_path):
     monkeypatch.delenv("TDT_DEVPROF_EVERY", raising=False)
     monkeypatch.delenv("TDT_DEVPROF_ON_BREACH", raising=False)
     monkeypatch.setenv("TDT_DEVPROF_DIR", str(tmp_path / "devprof"))
+    # The history sampler reads its knobs at scheduler construction;
+    # a developer's TDT_HISTORY* must not leak a sampler (or
+    # detectors) into tests that assert the off-by-default contract.
+    for k in ("TDT_HISTORY", "TDT_HISTORY_LEN", "TDT_HISTORY_TICK_S",
+              "TDT_HISTORY_DUMP_S", "TDT_HISTORY_SLOPE",
+              "TDT_HISTORY_STEP"):
+        monkeypatch.delenv(k, raising=False)
     from triton_dist_tpu.obs import devprof, flight, trace
     trace.reset()
     flight.reset()

@@ -444,6 +444,17 @@ def test_select_passes_fleet_watches():
         assert "ring-protocol" not in names, path
 
 
+def test_select_passes_history_watches():
+    """ISSUE 16 satellite: editing the history plane re-runs BOTH the
+    metric-catalog pass (history.* emissions must stay cataloged) and
+    the annotation-coverage pass (the sampler lives inside the pump's
+    lifecycle) under ``--changed``."""
+    names = select_passes_for(["triton_dist_tpu/obs/history.py"])
+    assert "metric-catalog" in names
+    assert "annotation-coverage" in names
+    assert "ring-protocol" not in names
+
+
 def test_driver_changed_scopes_to_diff(monkeypatch, capsys):
     monkeypatch.setattr(tdt_check, "changed_files",
                         lambda root=None: ["triton_dist_tpu/ops/p2p.py"])

@@ -245,6 +245,17 @@ def test_wedged_replica_fails_over_breaker_opens_then_recovers(tiny):
                 breaker_threshold=2, breaker_cooldown_s=0.3)
     c = ChatClient(r.host, r.port, timeout=120)
     try:
+        # Warm BOTH replicas' compiled programs directly (not through
+        # the router): each Engine jits its own step, and the first
+        # generation's XLA compile can exceed the deliberately tight
+        # 0.5 s dispatch deadline this test gives the router — which
+        # would open both breakers before anything is wedged.
+        for s in (s0, s1):
+            w = ChatClient(s.host, s.port, timeout=120)
+            try:
+                assert "tokens" in w.generate_ids([[1, 2]], gen_len=2)
+            finally:
+                w.close()
         # Find where the router places, then wedge THAT replica.
         first = c.generate_ids([[1, 2]], gen_len=2)
         assert "tokens" in first

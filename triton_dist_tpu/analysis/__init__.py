@@ -236,14 +236,15 @@ def _vmem_pass(root):
                # models/spec.py; the explicit entries pin the ISSUE-13
                # contract (spec telemetry stays cataloged), the
                # ISSUE-14 one (fleet/fleet_top telemetry likewise),
-               # and the ISSUE-15 one (router + chaos-harness
-               # telemetry) against a future narrowing of the package
-               # glob.
+               # the ISSUE-15 one (router + chaos-harness telemetry),
+               # and the ISSUE-16 one (history-plane telemetry)
+               # against a future narrowing of the package glob.
                watches=("triton_dist_tpu/", "docs/observability.md",
                         "triton_dist_tpu/serving/",
                         "triton_dist_tpu/serving/router.py",
                         "triton_dist_tpu/models/spec.py",
                         "triton_dist_tpu/obs/fleet.py",
+                        "triton_dist_tpu/obs/history.py",
                         "triton_dist_tpu/testing/chaos.py",
                         "triton_dist_tpu/tools/fleet_top.py"))
 def _metrics_pass(root):
@@ -292,13 +293,17 @@ def _fallback_pass(root):
                # labels under --changed. The ISSUE-15 router + chaos
                # harness ride for the same reason: the chaos wedge
                # hooks into the pump's work region and the router
-               # re-drives the serving path end to end.
+               # re-drives the serving path end to end. The ISSUE-16
+               # history sampler rides because it lives inside the
+               # pump's lifecycle (scheduler-owned thread peeking the
+               # registry the labeled step updates).
                watches=("triton_dist_tpu/resilience/router.py",
                         "triton_dist_tpu/obs/devprof.py",
                         "triton_dist_tpu/serving/",
                         "triton_dist_tpu/serving/router.py",
                         "triton_dist_tpu/models/spec.py",
                         "triton_dist_tpu/obs/fleet.py",
+                        "triton_dist_tpu/obs/history.py",
                         "triton_dist_tpu/testing/chaos.py",
                         "triton_dist_tpu/tools/fleet_top.py",
                         "triton_dist_tpu/analysis/lint_annotations.py"))

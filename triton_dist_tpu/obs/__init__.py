@@ -31,6 +31,15 @@ down), and merges snapshots correctly by metric kind, plus the
 (docs/observability.md "Fleet view"). Several replicas in one process
 keep distinct metrics via ``obs.scoped_registry``.
 
+The history plane (ISSUE 16, ``obs.history``) retains what everything
+above only reads point-in-time: an opt-in sampler (``TDT_HISTORY=1``)
+records every gauge (value) and counter (rate) into ring-buffered
+series behind the server's ``{"cmd": "history"}`` verb, pure trend
+math (``slope`` / ``ema`` / ``eta_to``) forecasts crossings, and
+early-warning detectors arm the flight recorder BEFORE the SLO breach
+— with the trailing series embedded in every dump as Perfetto counter
+tracks (docs/observability.md "History plane").
+
 Disabled by default at zero hot-path cost; flip metrics on with
 ``obs.enable()`` (the ModelServer does this at construction;
 ``TDT_TRACE=1`` makes that enable tracing too).
@@ -68,7 +77,7 @@ from triton_dist_tpu.obs.exposition import (  # noqa: F401
     render_prometheus,
 )
 from triton_dist_tpu.obs import (  # noqa: F401
-    attrib, devprof, fleet, flight, perfwatch, slo, trace)
+    attrib, devprof, fleet, flight, history, perfwatch, slo, trace)
 from triton_dist_tpu.obs.slo import (  # noqa: F401
     SLOTarget,
     SLOTracker,

@@ -46,6 +46,7 @@ import re
 import threading
 import time
 
+from triton_dist_tpu.obs import history as _history
 from triton_dist_tpu.obs import registry as _registry
 from triton_dist_tpu.obs.exposition import (
     _fmt, _prom_name, histogram_quantile, merge_snapshots)
@@ -386,7 +387,8 @@ class _Rec:
     """Mutable per-replica scrape record (internal)."""
 
     __slots__ = ("endpoint", "replica_id", "health", "snapshot", "seq",
-                 "t_ok", "t_created", "last_ok", "error")
+                 "t_ok", "t_created", "last_ok", "error", "hist",
+                 "rhist")
 
     def __init__(self, endpoint, t_created):
         self.endpoint = endpoint
@@ -398,6 +400,8 @@ class _Rec:
         self.t_created = t_created
         self.last_ok = False        # did the latest attempt succeed?
         self.error = None
+        self.hist = None            # SeriesStore fed from health polls
+        self.rhist = None           # last remote {"cmd": "history"} reply
 
 
 class FleetView:
@@ -440,6 +444,13 @@ class FleetView:
         self._eps_lock = threading.Lock()
         self._recs = {ep: _Rec(ep, now) for ep in self.endpoints}
         self._merged = None
+        # Health history (ISSUE 16): every poll() appends the headline
+        # health numbers into bounded per-replica ring buffers plus a
+        # fleet-level rollup store — no extra scrapes, the poll the
+        # dashboard already runs IS the sampler. TDT_HISTORY_LEN bounds
+        # every buffer.
+        self._hist_len = _history.history_len()
+        self._fleet_hist = _history.SeriesStore(maxlen=self._hist_len)
 
     # -- dynamic membership (ISSUE 15: live replica add/remove) ------------
     def add_endpoint(self, ep) -> tuple:
@@ -532,7 +543,11 @@ class FleetView:
         _registry.gauge("fleet.replicas_down").set(counts["down"])
 
     def poll(self) -> list:
-        """One concurrent health scrape; returns :meth:`replicas`."""
+        """One concurrent health scrape; returns :meth:`replicas`.
+        Each poll also appends the headline health numbers into the
+        bounded per-replica / fleet history stores (:meth:`history`) —
+        the scrape the dashboard already runs IS the history sampler,
+        no extra requests (ISSUE 16)."""
         t0 = time.perf_counter()
         eps = self._snapshot_eps()
         outs = self._scrape_all(eps, {"cmd": "health"})
@@ -543,8 +558,53 @@ class FleetView:
             if rec is not None:
                 self._record(rec, resp, "health")
         rows = self.replicas()
+        self._append_history(rows)
         self._publish(rows)
         return rows
+
+    def _append_history(self, rows: list) -> None:
+        """One history tick from the poll that just completed: per
+        LIVE-answering replica the headline health numbers (queue
+        depth, batch occupancy, rolling TTFT p99, per-target fast
+        burn), and one fleet-level rollup (additive sums over every
+        replica not ``down``, plus how many replicas reported).
+        Staleness-aware by construction: a replica that failed this
+        poll gets NO new point — its series simply stops advancing, so
+        a sparkline gap is a staleness signal, not a zero."""
+        now = self._clock()
+        reporting = 0
+        fleet_q = fleet_occ = 0.0
+        by_ep = {r["endpoint"]: r for r in rows}
+        for ep in self._snapshot_eps():
+            rec = self._recs.get(ep)
+            row = by_ep.get(f"{ep[0]}:{ep[1]}")
+            if rec is None or row is None or rec.health is None:
+                continue
+            h = rec.health
+            if row["status"] != "down":
+                reporting += 1
+                fleet_q += float(h.get("queue_depth") or 0.0)
+                fleet_occ += float(h.get("batch_occupancy") or 0.0)
+            if not rec.last_ok:
+                continue
+            if rec.hist is None:
+                rec.hist = _history.SeriesStore(maxlen=self._hist_len)
+            rec.hist.record("queue_depth",
+                            now, float(h.get("queue_depth") or 0.0))
+            rec.hist.record("batch_occupancy",
+                            now, float(h.get("batch_occupancy") or 0.0))
+            p99 = (h.get("rolling") or {}).get("ttft_p99_ms")
+            if p99 is not None:
+                rec.hist.record("ttft_p99_ms", now, float(p99))
+            for name, t in (h.get("slo") or {}).items():
+                burn = t.get("burn")
+                if burn is not None:
+                    rec.hist.record(f"slo_burn.{name}",
+                                    now, float(burn))
+        self._fleet_hist.record("queue_depth", now, fleet_q)
+        self._fleet_hist.record("batch_occupancy", now, fleet_occ)
+        self._fleet_hist.record("replicas_reporting",
+                                now, float(reporting))
 
     def scrape_metrics(self, evaluate: bool = False) -> dict | None:
         """Concurrent full-snapshot scrape → the fleet merge (also
@@ -637,3 +697,61 @@ class FleetView:
             return None
         h = self._merged.get("histograms", {}).get(hist_name)
         return histogram_quantile(h, q) if h else None
+
+    # -- health history (ISSUE 16) -----------------------------------------
+    def history(self, last_s: float | None = None,
+                max_points: int | None = None) -> dict:
+        """The poll-fed health history: ``{"fleet": <snapshot>,
+        "replicas": {replica_id: <snapshot>}}`` where each snapshot is
+        ``obs.history.SeriesStore.snapshot`` shaped (per-replica
+        ``queue_depth`` / ``batch_occupancy`` / ``ttft_p99_ms`` /
+        ``slo_burn.<name>``; fleet-level additive sums over non-down
+        replicas plus ``replicas_reporting``). Timestamps are this
+        view's ``clock`` — comparable within one view, not across
+        processes. Empty until the first :meth:`poll`."""
+        out = {"fleet": self._fleet_hist.snapshot(
+                   last_s=last_s, max_points=max_points),
+               "replicas": {}}
+        for ep in self._snapshot_eps():
+            rec = self._recs.get(ep)
+            if rec is not None and rec.hist is not None:
+                out["replicas"][rec.replica_id] = rec.hist.snapshot(
+                    last_s=last_s, max_points=max_points)
+        return out
+
+    def scrape_history(self, last_s: float | None = None,
+                       max_points: int | None = 64) -> dict:
+        """One concurrent ``{"cmd": "history"}`` scrape: each
+        replica's OWN sampled series (its in-process
+        ``HistorySampler``, far richer than the poll-fed health
+        history) is fetched and cached per replica, then returned as
+        :meth:`remote_history`. Replicas without a sampler answer
+        ``{"history": None}`` and simply stay absent. Deliberately
+        does NOT touch the staleness clock — history is a bulk read,
+        not liveness evidence (``poll`` owns that)."""
+        eps = self._snapshot_eps()
+        req: dict = {"cmd": "history"}
+        if last_s is not None:
+            req["last_s"] = last_s
+        if max_points is not None:
+            req["max_points"] = max_points
+        outs = self._scrape_all(eps, req)
+        for ep, resp in zip(eps, outs):
+            rec = self._recs.get(ep)
+            if rec is None:
+                continue
+            if isinstance(resp, dict) and "history" in resp:
+                _registry.counter("fleet.history_scrapes").inc()
+                rec.rhist = resp["history"]
+        return self.remote_history()
+
+    def remote_history(self) -> dict:
+        """``{replica_id: <history snapshot>}`` from the last
+        :meth:`scrape_history` — cached, zero requests (the dashboard
+        reads this between its sparse scrape ticks)."""
+        out: dict = {}
+        for ep in self._snapshot_eps():
+            rec = self._recs.get(ep)
+            if rec is not None and rec.rhist is not None:
+                out[rec.replica_id] = rec.rhist
+        return out

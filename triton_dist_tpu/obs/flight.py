@@ -37,8 +37,9 @@ import time
 from triton_dist_tpu.obs import registry as _registry
 from triton_dist_tpu.obs import trace as _trace
 
-__all__ = ["dump", "flight_seconds", "install_signal_handlers",
-           "last_record", "maybe_dump", "replica_id", "reset",
+__all__ = ["dump", "flight_seconds", "history_provider",
+           "install_signal_handlers", "last_record", "maybe_dump",
+           "replica_id", "reset", "set_history_provider",
            "set_replica_id", "trace_dir"]
 
 DEFAULT_FLIGHT_SECONDS = 30.0
@@ -52,6 +53,26 @@ _COUNT = 0
 _LAST_BY_REASON: dict[str, float] = {}
 _SIGTERM_INSTALLED = False
 _REPLICA_ID: str | None = None
+_HISTORY_PROVIDER = None      # () -> obs.history snapshot dict, or None
+
+
+def set_history_provider(fn) -> None:
+    """Install the zero-arg callable whose return value (an
+    ``obs.history`` snapshot dict — the trailing ``TDT_HISTORY_DUMP_S``
+    seconds of sampled series) every later dump embeds under
+    ``metadata.history`` (ISSUE 16): a breach dump then shows the
+    LEAD-UP, not just the instant, and ``trace_export.to_chrome``
+    renders the series as Perfetto counter tracks next to the event
+    timeline. A live ``obs.history.HistorySampler`` installs its own
+    ``dump_payload`` here and uninstalls it on close; like
+    :func:`set_replica_id`, the last installer wins. ``None``
+    uninstalls."""
+    global _HISTORY_PROVIDER
+    _HISTORY_PROVIDER = fn
+
+
+def history_provider():
+    return _HISTORY_PROVIDER
 
 
 def set_replica_id(rid: str | None) -> None:
@@ -110,6 +131,14 @@ def dump(reason: str, last_s: float | None = None) -> str | None:
             "unix_time": time.time()}
     if _REPLICA_ID:
         meta["replica_id"] = _REPLICA_ID
+    prov = _HISTORY_PROVIDER
+    if prov is not None:
+        try:
+            hist = prov()
+        except Exception:  # noqa: BLE001 — history must never block a dump
+            hist = None
+        if hist and hist.get("series"):
+            meta["history"] = hist
     chrome = _texp.to_chrome(_trace.collect(last_s=window),
                              metadata=meta)
     d = trace_dir()
@@ -196,9 +225,10 @@ def install_signal_handlers() -> bool:
 def reset() -> None:
     """Drop process-local recorder state (tests). The SIGTERM handler
     is left installed — it re-checks tracing at fire time."""
-    global _LAST, _COUNT, _REPLICA_ID
+    global _LAST, _COUNT, _REPLICA_ID, _HISTORY_PROVIDER
     with _LOCK:
         _LAST = None
         _COUNT = 0
         _LAST_BY_REASON.clear()
         _REPLICA_ID = None
+        _HISTORY_PROVIDER = None

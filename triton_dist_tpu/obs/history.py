@@ -1,0 +1,661 @@
+"""History plane: sampled time series, trend math, early-warning
+detectors (ISSUE 16).
+
+Every signal the repo computes — SLO burn rates, queue depth, KV-block
+occupancy, ``device.step.*`` times — is point-in-time: the registry
+keeps the latest value, the rolling windows forget, and a flight dump
+begins at the breach instant. This module retains the lead-up, so any
+number becomes comparable to itself five minutes ago:
+
+- :class:`Series` / :class:`SeriesStore` — fixed-size ring buffers of
+  ``(t, value)`` points (``TDT_HISTORY_LEN`` points per series,
+  monotonic ``time.perf_counter()`` timestamps plus a wall-clock
+  ``epoch`` anchor so exported points line up with ``obs.trace``'s
+  micros). Appends are lock-free on the sampler thread (preallocated
+  slots, GIL-atomic stores); readers snapshot without blocking it.
+- :class:`HistorySampler` — an opt-in background thread that rides the
+  same C-level ``peek_gauges`` / ``peek_counters`` reads the ``health``
+  verb uses, every ``TDT_HISTORY_TICK_S`` seconds: gauges are stored
+  as values, counters as per-second RATES (the delta between ticks).
+  ``from_env`` returns None unless ``TDT_HISTORY=1`` — the
+  zero-overhead-when-unused contract of ``obs.registry`` is preserved:
+  no sampler, no thread, no cost.
+- Trend queries as pure functions over point lists — :func:`slope`
+  (least squares), :func:`ema`, :func:`window_stats`, and
+  :func:`eta_to` ("queue depth crosses max_waiting in ~N s", "KV pool
+  exhausted in ~N s", "burn rate crosses 1.0 in ~N s") — the forecast
+  surface ISSUE 17's autoscaler will consume verbatim, the way the
+  router consumed ``placement_score``.
+- Early-warning **detectors** — :class:`SustainedSlope` and
+  :class:`StepChange` over configurable windows
+  (``TDT_HISTORY_SLOPE`` / ``TDT_HISTORY_STEP``, e.g.
+  ``serving.queue_depth>0.5@30``) — that emit a ``history.warning``
+  trace instant and arm the existing flight-dump +
+  ``TDT_DEVPROF_ON_BREACH`` machinery *before* the SLO breach
+  (``obs.flight.maybe_dump`` → ``obs.devprof.arm``), turning
+  postmortems into pre-mortems. A detector latches: it fires exactly
+  once per sustained excursion and re-arms only after the condition
+  clears (no instant-storm).
+- :func:`sparkline` — the unicode renderer ``tools/top.py`` /
+  ``fleet_top.py`` / ``report.py`` share.
+
+A live sampler installs itself as ``obs.flight``'s history provider,
+so every flight dump embeds the trailing ``TDT_HISTORY_DUMP_S``
+seconds of sampled series (``metadata.history``) and
+``tools/trace_export.to_chrome`` renders them as Perfetto COUNTER
+tracks next to the event timeline.
+
+Knobs (docs/observability.md "History plane"): ``TDT_HISTORY``,
+``TDT_HISTORY_LEN``, ``TDT_HISTORY_TICK_S``, ``TDT_HISTORY_DUMP_S``,
+``TDT_HISTORY_SLOPE``, ``TDT_HISTORY_STEP``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import threading
+import time
+
+from triton_dist_tpu.obs import flight as _flight
+from triton_dist_tpu.obs import registry as _registry
+from triton_dist_tpu.obs import trace as _trace
+
+__all__ = [
+    "DEFAULT_DETECTOR_WINDOW_S", "DEFAULT_DUMP_S", "DEFAULT_EMA_ALPHA",
+    "DEFAULT_HISTORY_LEN", "DEFAULT_TICK_S", "DetectorSpec",
+    "HistorySampler", "Series", "SeriesStore", "StepChange",
+    "SustainedSlope", "downsample", "ema", "eta_to", "history_dump_s",
+    "history_enabled", "history_len", "history_tick_s",
+    "make_detector", "parse_detectors", "slope", "sparkline",
+    "window_stats",
+]
+
+DEFAULT_HISTORY_LEN = 512
+DEFAULT_TICK_S = 1.0
+DEFAULT_DUMP_S = 60.0
+DEFAULT_DETECTOR_WINDOW_S = 30.0
+DEFAULT_EMA_ALPHA = 0.3
+
+#: Warning records retained per store (newest-first in snapshots).
+MAX_WARNINGS = 64
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(f"{name} must be a number: {v!r}") from None
+
+
+def history_enabled() -> bool:
+    """``TDT_HISTORY=1`` opts the scheduler's sampler in (default off:
+    the zero-overhead contract)."""
+    return bool(_registry.env_int("TDT_HISTORY", 0))
+
+
+def history_len() -> int:
+    return _registry.env_int("TDT_HISTORY_LEN", DEFAULT_HISTORY_LEN,
+                             minimum=2)
+
+
+def history_tick_s() -> float:
+    v = _env_float("TDT_HISTORY_TICK_S", DEFAULT_TICK_S)
+    if v <= 0:
+        raise ValueError(f"TDT_HISTORY_TICK_S must be positive: {v}")
+    return v
+
+
+def history_dump_s() -> float:
+    """Trailing seconds of series a flight dump embeds."""
+    return _env_float("TDT_HISTORY_DUMP_S", DEFAULT_DUMP_S)
+
+
+# ---------------------------------------------------------------------------
+# Ring-buffered series + the store.
+# ---------------------------------------------------------------------------
+
+class Series:
+    """Fixed-size ring of ``(t, value)`` points — one writer (the
+    sampler), lock-free readers.
+
+    The slots are preallocated lists written by index, so an append is
+    three GIL-atomic stores and never allocates; :meth:`points` copies
+    the slot lists (one C-level pass each) and reorders. With a
+    concurrent append, the OLDEST returned point may belong to the
+    next generation — benign for trend math over a trailing window,
+    and the price of never taking a lock on the sample path."""
+
+    __slots__ = ("name", "maxlen", "_t", "_v", "_n")
+
+    def __init__(self, name: str, maxlen: int):
+        maxlen = int(maxlen)
+        if maxlen < 2:
+            raise ValueError(f"series maxlen must be >= 2: {maxlen}")
+        self.name = name
+        self.maxlen = maxlen
+        self._t = [0.0] * maxlen
+        self._v = [0.0] * maxlen
+        self._n = 0                    # total appends ever
+
+    def append(self, t: float, v: float) -> None:
+        i = self._n % self.maxlen
+        self._t[i] = float(t)
+        self._v[i] = float(v)
+        self._n += 1                   # publish last
+
+    def __len__(self) -> int:
+        return min(self._n, self.maxlen)
+
+    @property
+    def total(self) -> int:
+        """Total points ever appended (ring overwrites included)."""
+        return self._n
+
+    def last(self):
+        """The newest ``(t, value)`` or None when empty."""
+        n = self._n
+        if n == 0:
+            return None
+        i = (n - 1) % self.maxlen
+        return (self._t[i], self._v[i])
+
+    def points(self, last_s: float | None = None,
+               now: float | None = None) -> list:
+        """Oldest-first ``[(t, value), ...]``; ``last_s`` trims to the
+        trailing window ending at ``now`` (default: the newest
+        point's timestamp)."""
+        n = self._n
+        k = min(n, self.maxlen)
+        if k == 0:
+            return []
+        ts = list(self._t)
+        vs = list(self._v)
+        pts = []
+        for j in range(n - k, n):
+            i = j % self.maxlen
+            pts.append((ts[i], vs[i]))
+        if last_s is not None:
+            anchor = pts[-1][0] if now is None else float(now)
+            cutoff = anchor - float(last_s)
+            pts = [p for p in pts if p[0] >= cutoff]
+        return pts
+
+    def values(self, last_s: float | None = None,
+               now: float | None = None) -> list:
+        return [v for _, v in self.points(last_s, now)]
+
+
+class SeriesStore:
+    """Named :class:`Series` rings plus a bounded warning ring.
+
+    The lock guards only series CREATION (a dict mutation); appends
+    and reads go straight to the rings. ``epoch`` is the same
+    wall-minus-perf anchor ``obs.trace``'s Tracer keeps, so exported
+    points convert to the trace's wall-anchored micros
+    (``(t + epoch) * 1e6``) and counter tracks line up with the event
+    timeline in one Perfetto view."""
+
+    def __init__(self, maxlen: int | None = None,
+                 max_warnings: int = MAX_WARNINGS):
+        self.maxlen = maxlen if maxlen is not None else history_len()
+        self.epoch = time.time() - time.perf_counter()
+        self._series: dict[str, Series] = {}
+        self._lock = threading.Lock()
+        self._warnings: collections.deque = collections.deque(
+            maxlen=max_warnings)
+
+    def __len__(self) -> int:
+        return len(self._series)
+
+    def names(self) -> list:
+        return sorted(self._series)
+
+    def get(self, name: str) -> Series | None:
+        return self._series.get(name)
+
+    def series(self, name: str) -> Series:
+        s = self._series.get(name)
+        if s is None:
+            with self._lock:
+                s = self._series.get(name)
+                if s is None:
+                    s = self._series[name] = Series(name, self.maxlen)
+        return s
+
+    def record(self, name: str, t: float, v: float) -> None:
+        self.series(name).append(t, v)
+
+    def add_warning(self, rec: dict) -> None:
+        self._warnings.append(dict(rec))
+
+    def warnings(self) -> list:
+        """Newest-first warning records (bounded ring)."""
+        return list(self._warnings)[::-1]
+
+    def snapshot(self, last_s: float | None = None, series=None,
+                 max_points: int | None = None) -> dict:
+        """JSON-safe view: ``{"epoch", "maxlen", "series": {name:
+        {"points": [[t, v], ...], "n": total}}, "warnings": [...]}``.
+        ``series`` filters by name, ``last_s`` trims to the trailing
+        window, ``max_points`` downsamples (stride, newest kept)."""
+        wanted = set(series) if series else None
+        out: dict = {"epoch": self.epoch, "maxlen": self.maxlen,
+                     "series": {}, "warnings": self.warnings()}
+        for name in self.names():
+            if wanted is not None and name not in wanted:
+                continue
+            s = self._series[name]
+            pts = downsample(s.points(last_s=last_s), max_points)
+            out["series"][name] = {
+                "points": [[round(t, 6), v] for t, v in pts],
+                "n": s.total}
+        return out
+
+
+def downsample(points: list, max_points: int | None) -> list:
+    """Stride-downsample oldest-first points to at most
+    ``max_points``, always keeping the NEWEST point (dashboards read
+    the right edge)."""
+    if max_points is None or len(points) <= max_points:
+        return list(points)
+    if max_points <= 0:
+        return []
+    stride = -(-len(points) // max_points)
+    return list(points)[-1::-stride][::-1]
+
+
+# ---------------------------------------------------------------------------
+# Trend math: pure functions over [(t, v), ...] point lists.
+# ---------------------------------------------------------------------------
+
+def slope(points: list) -> float | None:
+    """Least-squares slope in value-units per second, or None when
+    fewer than 2 points or zero time variance make a fit meaningless
+    (the len<2 degenerate case is the caller's no-data answer, not
+    0.0 — a flat reading and no reading must stay distinguishable)."""
+    n = len(points)
+    if n < 2:
+        return None
+    mt = sum(t for t, _ in points) / n
+    mv = sum(v for _, v in points) / n
+    num = sum((t - mt) * (v - mv) for t, v in points)
+    den = sum((t - mt) ** 2 for t, _ in points)
+    if den <= 0.0:
+        return None
+    return num / den
+
+
+def ema(points: list, alpha: float = DEFAULT_EMA_ALPHA) -> float | None:
+    """Exponential moving average of the values, oldest-first
+    (``s = alpha * v + (1 - alpha) * s``); None when empty."""
+    if not points:
+        return None
+    if not 0.0 < alpha <= 1.0:
+        raise ValueError(f"ema alpha must be in (0, 1]: {alpha}")
+    s = float(points[0][1])
+    for _, v in points[1:]:
+        s = alpha * float(v) + (1.0 - alpha) * s
+    return s
+
+
+def window_stats(points: list) -> dict:
+    """``{"n", "min", "max", "avg", "last", "span_s"}`` over a point
+    list (``{"n": 0}`` when empty)."""
+    if not points:
+        return {"n": 0}
+    vals = [v for _, v in points]
+    return {"n": len(vals), "min": min(vals), "max": max(vals),
+            "avg": sum(vals) / len(vals), "last": vals[-1],
+            "span_s": points[-1][0] - points[0][0]}
+
+
+def eta_to(points: list, threshold: float) -> float | None:
+    """Seconds until the least-squares fit reaches ``threshold``:
+    positive when the trend points at it, ``0.0`` when the last value
+    already sits ON it, None when there is no crossing ahead (flat or
+    moving away, including the negative-slope-below-threshold case)
+    or fewer than 2 points. This is the forecast behind "queue depth
+    crosses max_waiting in ~N s"."""
+    if len(points) < 2:
+        return None
+    last = float(points[-1][1])
+    threshold = float(threshold)
+    if last == threshold:
+        return 0.0
+    s = slope(points)
+    if not s:                       # None or exactly flat: never crosses
+        return None
+    t_cross = (threshold - last) / s
+    return t_cross if t_cross > 0 else None
+
+
+# ---------------------------------------------------------------------------
+# Sparklines (the dashboard renderer — pure).
+# ---------------------------------------------------------------------------
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int | None = None) -> str:
+    """Unicode sparkline of a value sequence. ``width`` caps the
+    output by averaging values into that many buckets; an all-equal
+    (or single-value) series renders as mid-blocks so "flat" and "no
+    data" (empty string) stay visually distinct."""
+    vals = [float(v) for v in values if v is not None]
+    if not vals:
+        return ""
+    if width is not None and width > 0 and len(vals) > width:
+        per = len(vals) / width
+        vals = [sum(vals[int(i * per):max(int((i + 1) * per),
+                                          int(i * per) + 1)])
+                / max(int((i + 1) * per) - int(i * per), 1)
+                for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_CHARS[3] * len(vals)
+    return "".join(
+        _SPARK_CHARS[min(int((v - lo) / span * 8), 7)] for v in vals)
+
+
+# ---------------------------------------------------------------------------
+# Early-warning detectors.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DetectorSpec:
+    """One parsed detector: ``metric OP threshold @ window_s``."""
+
+    kind: str                       # "slope" | "step"
+    metric: str
+    op: str                         # ">" | "<"
+    threshold: float
+    window_s: float = DEFAULT_DETECTOR_WINDOW_S
+
+    def __post_init__(self):
+        if self.kind not in ("slope", "step"):
+            raise ValueError(f"detector kind must be slope/step: "
+                             f"{self.kind!r}")
+        if self.op not in (">", "<"):
+            raise ValueError(f"detector op must be > or <: {self.op!r}")
+        if self.window_s <= 0:
+            raise ValueError(
+                f"detector window must be positive: {self.window_s}")
+
+
+def parse_detectors(spec: str, kind: str) -> list:
+    """Parse a ``;``-separated env spec (``TDT_HISTORY_SLOPE`` /
+    ``TDT_HISTORY_STEP``) into :class:`DetectorSpec` rows. Each entry
+    is ``<metric><op><threshold>[@<window_s>]`` — e.g.
+    ``serving.queue_depth>0.5@30`` ("queue depth climbing faster than
+    0.5/s sustained over 30 s" for the slope kind; "recent half-window
+    average 0.5 above the earlier half" for the step kind)."""
+    out = []
+    for entry in (spec or "").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        op = ">" if ">" in entry else ("<" if "<" in entry else None)
+        if op is None:
+            raise ValueError(
+                f"detector spec needs > or <: {entry!r} "
+                f"(want metric>threshold[@window_s])")
+        metric, _, rest = entry.partition(op)
+        thr_s, _, win_s = rest.partition("@")
+        metric = metric.strip()
+        if not metric or not thr_s.strip():
+            raise ValueError(f"malformed detector spec: {entry!r}")
+        try:
+            thr = float(thr_s)
+            win = float(win_s) if win_s.strip() \
+                else DEFAULT_DETECTOR_WINDOW_S
+        except ValueError:
+            raise ValueError(
+                f"malformed detector numbers in: {entry!r}") from None
+        out.append(DetectorSpec(kind, metric, op, thr, win))
+    return out
+
+
+class _Detector:
+    """Latch wrapper shared by both detector kinds: the condition is a
+    pure function of the trailing window, the latch makes a sustained
+    excursion fire exactly ONCE — :meth:`check` returns details only
+    on the clear → firing transition and re-arms when the condition
+    clears again."""
+
+    kind = "?"
+
+    def __init__(self, spec: DetectorSpec):
+        self.spec = spec
+        self.fired = False
+
+    def evaluate(self, points: list, now: float) -> dict | None:
+        raise NotImplementedError
+
+    def check(self, points: list, now: float) -> dict | None:
+        details = self.evaluate(points, now)
+        if details is None:
+            self.fired = False
+            return None
+        if self.fired:
+            return None
+        self.fired = True
+        return details
+
+    def _base(self) -> dict:
+        return {"detector": self.kind, "metric": self.spec.metric,
+                "op": self.spec.op, "threshold": self.spec.threshold,
+                "window_s": self.spec.window_s}
+
+
+class SustainedSlope(_Detector):
+    """Fires when the least-squares slope over the trailing window
+    crosses the threshold (per second) AND the window is at least
+    half covered — two points at the start of a ramp are a blip, not
+    a sustained trend."""
+
+    kind = "slope"
+
+    def evaluate(self, points: list, now: float) -> dict | None:
+        if len(points) < 3:
+            return None
+        if points[-1][0] - points[0][0] < 0.5 * self.spec.window_s:
+            return None
+        s = slope(points)
+        if s is None:
+            return None
+        hit = s > self.spec.threshold if self.spec.op == ">" \
+            else s < self.spec.threshold
+        if not hit:
+            return None
+        d = self._base()
+        d["slope_per_s"] = round(s, 6)
+        d["last"] = points[-1][1]
+        return d
+
+
+class StepChange(_Detector):
+    """Fires when the recent half-window average jumped past the
+    earlier half's by more than the threshold — the level-shift
+    detector (a deploy, a traffic step) that a slope fit smears out.
+    Needs points in BOTH halves, so a series that appears mid-window
+    cannot instant-fire on its first samples."""
+
+    kind = "step"
+
+    def evaluate(self, points: list, now: float) -> dict | None:
+        if len(points) < 4:
+            return None
+        if points[-1][0] - points[0][0] < 0.5 * self.spec.window_s:
+            return None
+        mid = now - 0.5 * self.spec.window_s
+        early = [v for t, v in points if t <= mid]
+        late = [v for t, v in points if t > mid]
+        if not early or not late:
+            return None
+        delta = sum(late) / len(late) - sum(early) / len(early)
+        hit = delta > self.spec.threshold if self.spec.op == ">" \
+            else delta < self.spec.threshold
+        if not hit:
+            return None
+        d = self._base()
+        d["delta"] = round(delta, 6)
+        d["last"] = points[-1][1]
+        return d
+
+
+_DETECTOR_KINDS = {"slope": SustainedSlope, "step": StepChange}
+
+
+def make_detector(spec: DetectorSpec) -> _Detector:
+    return _DETECTOR_KINDS[spec.kind](spec)
+
+
+# ---------------------------------------------------------------------------
+# The sampler.
+# ---------------------------------------------------------------------------
+
+class HistorySampler:
+    """Background sampler feeding a :class:`SeriesStore` from the
+    lock-free registry peeks, plus the detector pass.
+
+    Construction follows ``obs.devprof.PumpSampler``'s idiom: the
+    Scheduler builds one via :meth:`from_env` (None unless
+    ``TDT_HISTORY=1``) and closes it when the pump exits. Tests pass
+    ``thread=False`` and drive :meth:`sample_once` with explicit
+    timestamps — every condition is then deterministic, no sleeping.
+
+    A live sampler registers :meth:`dump_payload` as ``obs.flight``'s
+    history provider, so every flight dump — a breach, a watchdog
+    trip, one of THIS module's warnings — carries the trailing
+    ``TDT_HISTORY_DUMP_S`` seconds of series alongside the event ring.
+    """
+
+    def __init__(self, registry=None, store: SeriesStore | None = None,
+                 tick_s: float | None = None, maxlen: int | None = None,
+                 detectors=None, clock=time.perf_counter,
+                 thread: bool = True, install_flight_provider=True):
+        self.tick_s = tick_s if tick_s is not None else history_tick_s()
+        if self.tick_s <= 0:
+            raise ValueError(f"tick_s must be positive: {self.tick_s}")
+        self.store = store if store is not None \
+            else SeriesStore(maxlen=maxlen)
+        self.detectors = list(detectors or [])
+        self._registry = registry
+        self._clock = clock
+        self._prev_counters: dict[str, float] = {}
+        self._prev_t: float | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._installed_provider = False
+        if install_flight_provider:
+            _flight.set_history_provider(self.dump_payload)
+            self._installed_provider = True
+        if thread:
+            self._thread = threading.Thread(
+                target=self._run, name="tdt-history", daemon=True)
+            self._thread.start()
+
+    @classmethod
+    def from_env(cls, registry=None) -> "HistorySampler | None":
+        """The Scheduler's constructor path: None unless
+        ``TDT_HISTORY=1`` (the no-sampler-no-cost contract), else a
+        running sampler with the env cadence/length and any
+        ``TDT_HISTORY_SLOPE`` / ``TDT_HISTORY_STEP`` detectors."""
+        if not history_enabled():
+            return None
+        dets = [make_detector(s) for s in
+                parse_detectors(os.environ.get("TDT_HISTORY_SLOPE", ""),
+                                "slope")
+                + parse_detectors(os.environ.get("TDT_HISTORY_STEP", ""),
+                                  "step")]
+        return cls(registry=registry, detectors=dets)
+
+    # -- sampling ----------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self.tick_s):
+            try:
+                self.sample_once()
+            except Exception:  # noqa: BLE001 — sampling must never kill serving
+                try:
+                    self._reg().counter("history.sample_errors").inc()
+                except Exception:  # noqa: BLE001 — best-effort bookkeeping
+                    pass
+
+    def _reg(self):
+        return (self._registry if self._registry is not None
+                else _registry.get_registry())
+
+    def sample_once(self, now: float | None = None) -> None:
+        """One tick: peek every gauge (stored as value) and counter
+        (stored as per-second rate vs the previous tick), then run the
+        detector pass. ``now`` is injectable for tests."""
+        from triton_dist_tpu.obs.fleet import peek_counters, peek_gauges
+        now = self._clock() if now is None else float(now)
+        reg = self._reg()
+        for name, v in peek_gauges(reg).items():
+            self.store.record(name, now, float(v))
+        prev_t = self._prev_t
+        for name, v in peek_counters(reg).items():
+            v = float(v)
+            p = self._prev_counters.get(name)
+            if p is not None and prev_t is not None and now > prev_t:
+                self.store.record(name, now, (v - p) / (now - prev_t))
+            self._prev_counters[name] = v
+        self._prev_t = now
+        reg.counter("history.ticks").inc()
+        reg.gauge("history.series").set(len(self.store))
+        for det in self.detectors:
+            s = self.store.get(det.spec.metric)
+            pts = s.points(last_s=det.spec.window_s, now=now) \
+                if s is not None else []
+            details = det.check(pts, now)
+            if details is not None:
+                self._fire(det, details, now)
+
+    def _fire(self, det: _Detector, details: dict, now: float) -> None:
+        details = dict(details)
+        details["t"] = round(now, 3)
+        self.store.add_warning(details)
+        reg = self._reg()
+        reg.counter("history.warnings").inc()
+        reg.counter(f"history.warning.{det.kind}").inc()
+        _trace.instant("history.warning", "history", args=details)
+        # maybe_dump (not devprof.arm directly): the dump carries the
+        # attached series AND arms the breach-gated device profiler —
+        # the full pre-mortem, rate-limited per reason.
+        _flight.maybe_dump(f"history_{det.kind}_{det.spec.metric}")
+
+    # -- reads / lifecycle -------------------------------------------------
+    def snapshot(self, last_s: float | None = None, series=None,
+                 max_points: int | None = None) -> dict:
+        """The ``{"cmd": "history"}`` payload: the store snapshot plus
+        the sampler cadence."""
+        snap = self.store.snapshot(last_s=last_s, series=series,
+                                   max_points=max_points)
+        snap["tick_s"] = self.tick_s
+        return snap
+
+    def dump_payload(self) -> dict:
+        """What a flight dump embeds: the trailing
+        ``TDT_HISTORY_DUMP_S`` seconds, untrimmed point counts."""
+        return self.snapshot(last_s=history_dump_s())
+
+    def close(self) -> None:
+        """Stop the thread and (if ours) uninstall the flight
+        provider. Idempotent; never raises past a join timeout — the
+        pump's teardown path calls this."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout=2.0)
+        if self._installed_provider \
+                and _flight.history_provider() == self.dump_payload:
+            _flight.set_history_provider(None)
+            self._installed_provider = False

@@ -103,7 +103,7 @@ import time
 import warnings
 
 from triton_dist_tpu import obs
-from triton_dist_tpu.obs import attrib, devprof, slo, trace
+from triton_dist_tpu.obs import attrib, devprof, history, slo, trace
 
 __all__ = ["DEFAULT_MAX_WAITING", "Draining", "QueueFull", "Request",
            "RETRY_AFTER_MAX_MS", "RETRY_AFTER_MIN_MS", "Scheduler",
@@ -205,8 +205,8 @@ class Scheduler:
 
     def __init__(self, engine, params, max_waiting: int | None = None,
                  prefill_chunk: int | None = None, slo_tracker=None,
-                 devprof_sampler=None, replica_id: str | None = None,
-                 registry=None):
+                 devprof_sampler=None, history_sampler=None,
+                 replica_id: str | None = None, registry=None):
         self.engine = engine
         self.params = params
         # Fleet identity (ISSUE 14): stamped into this scheduler's
@@ -258,6 +258,21 @@ class Scheduler:
             self.devprof = devprof_sampler
         else:
             self.devprof = devprof.PumpSampler.from_env()
+        # Sampled signal history (obs.history, docs/observability.md
+        # "History plane"): an opt-in background sampler recording
+        # this replica's gauges (values) and counters (rates) into
+        # ring-buffered series behind the {"cmd": "history"} verb,
+        # plus the early-warning detector pass. None unless
+        # TDT_HISTORY=1 — no sampler, no thread, no cost. Pass a
+        # HistorySampler to override (tests: thread=False + explicit
+        # sample_once timestamps), False to disable regardless of env.
+        if history_sampler is False:
+            self.history = None
+        elif history_sampler is not None:
+            self.history = history_sampler
+        else:
+            self.history = history.HistorySampler.from_env(
+                registry=self._registry)
         self._cond = threading.Condition()
         self._queue: collections.deque[Request] = collections.deque()
         self._rid = 0
@@ -530,6 +545,13 @@ class Scheduler:
                     # A stop mid-capture must still end the profiler
                     # session (and parse what it got).
                     self.devprof.close()
+                except Exception:  # noqa: BLE001 — shutdown best-effort
+                    pass
+            if self.history is not None:
+                try:
+                    # Stop the sampler thread and release the flight
+                    # recorder's history-provider slot.
+                    self.history.close()
                 except Exception:  # noqa: BLE001 — shutdown best-effort
                     pass
         return exc

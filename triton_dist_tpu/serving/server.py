@@ -427,9 +427,26 @@ class ModelServer:
             # finished requests' waterfalls, newest first.
             from triton_dist_tpu.obs import attrib
             return {"requests": attrib.last(req.get("last"))}
+        if cmd == "history":
+            # Sampled series (ISSUE 16, docs/serving.md "History"):
+            # downsampled ring-buffer points from the scheduler's
+            # opt-in sampler — ``{"history": null}`` when no sampler
+            # runs (TDT_HISTORY unset), so dashboards degrade instead
+            # of erroring. ``last_s`` trims the window, ``series``
+            # filters names, ``max_points`` bounds the reply size
+            # (sparkline scrapes need ~32 points, not the whole ring).
+            sampler = getattr(self.scheduler, "history", None)
+            if sampler is None:
+                return {"history": None}
+            series = req.get("series")
+            return {"history": sampler.snapshot(
+                last_s=req.get("last_s"),
+                series=list(series) if series else None,
+                max_points=req.get("max_points"))}
         obs.counter("server.errors").inc()
         return {"error": f"unknown cmd {cmd!r} (known: metrics, "
-                         f"health, drain, dump_trace, request_stats)"}
+                         f"health, drain, dump_trace, request_stats, "
+                         f"history)"}
 
     def _effective_gen_len(self, req: dict, prompts) -> int:
         """Clamp the requested gen_len to the protocol cap (4096) AND

@@ -508,6 +508,50 @@ def check_router_wellformed(extras: dict) -> list[str]:
     return fails
 
 
+def check_history_wellformed(extras: dict) -> list[str]:
+    """Failure strings when the serving_history part ran (its
+    tokens/s key exists) without leaving well-formed history-plane
+    evidence (ISSUE 16):
+
+    - ``serving_history_on_vs_off`` present and positive (the
+      sampler-on vs sampler-off throughput ratio the BASELINE.json
+      cpu floor gates — this check guards SHAPE, the floor guards
+      magnitude);
+    - ``serving_history_ticks`` ≥ 1 — the 20 Hz sampler must have
+      actually ticked during the on-leg (zero would mean the ratio
+      priced nothing);
+    - ``serving_history_series`` ≥ 1 — at least one series was
+      recorded and shipped back through ``{"cmd": "history"}`` (the
+      pump publishes queue/occupancy gauges every working iteration,
+      so an empty snapshot means the verb or the sampler is broken).
+
+    Empty when the part did not run."""
+    if "serving_history_tokens_per_s" not in extras:
+        return []
+    fails = []
+    v = extras.get("serving_history_on_vs_off")
+    if not isinstance(v, (int, float)) or isinstance(v, bool) \
+            or float(v) <= 0.0:
+        fails.append(
+            f"serving_history_on_vs_off: missing/malformed ({v!r}) — "
+            f"the serving_history part ran but published no "
+            f"on-vs-off ratio")
+    ticks = extras.get("serving_history_ticks")
+    if not isinstance(ticks, (int, float)) or isinstance(ticks, bool) \
+            or ticks < 1:
+        fails.append(
+            f"serving_history_ticks: want >= 1 sampler tick in the "
+            f"on-leg, got {ticks!r} — the overhead ratio priced a "
+            f"sampler that never ran")
+    series = extras.get("serving_history_series")
+    if not isinstance(series, (int, float)) \
+            or isinstance(series, bool) or series < 1:
+        fails.append(
+            f"serving_history_series: want >= 1 recorded series in "
+            f"the on-leg history snapshot, got {series!r}")
+    return fails
+
+
 def _extras_from_file(path: str) -> dict:
     """Extras dict from any bench artifact: a bench.py checkpoint
     ({"extras": ...}), a bench.py result line ({"metric", "extras"}),
@@ -570,6 +614,7 @@ def run_regress(baseline_path: str, from_file: str | None,
     fails += check_spec_serving_wellformed(extras)
     fails += check_fleet_wellformed(extras)
     fails += check_router_wellformed(extras)
+    fails += check_history_wellformed(extras)
     fails += check_overlap_measured_wellformed(extras)
     fails += check_measured_overlap_floors(
         extras, load_measured_overlap_floors(baseline_path, tier))

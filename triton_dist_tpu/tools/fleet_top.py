@@ -61,7 +61,16 @@ def fetch(view, with_metrics: bool = True) -> dict:
     rows = view.poll()
     merged = (view.scrape_metrics(evaluate=False) if with_metrics
               else view.merged())
-    return {"replicas": rows, "merged": merged}
+    # History (ISSUE 16) rides the SAME cadence: the poll above just
+    # fed the view's poll-sampled health history for free, and the
+    # remote {"cmd": "history"} bulk read only goes out on the sparse
+    # metrics ticks — off-tick renders read the cached copy, issuing
+    # zero extra scrapes.
+    remote = (view.scrape_history(max_points=32) if with_metrics
+              else view.remote_history())
+    return {"replicas": rows, "merged": merged,
+            "history": view.history(max_points=32),
+            "remote_history": remote}
 
 
 def _fmt(v) -> str:
@@ -141,6 +150,38 @@ def render(state: dict) -> str:
             fleet_bits.append(f"retired {_fmt(c['serving.retired'])}")
     if fleet_bits:
         lines += ["", "fleet: " + "   ".join(fleet_bits)]
+
+    # Poll-fed health history (ISSUE 16): fleet-rollup sparklines plus
+    # one compact line per replica — the trend the instantaneous table
+    # above cannot show. Additive: absent until a view has polled.
+    hist = state.get("history") or {}
+    fseries = (hist.get("fleet") or {}).get("series") or {}
+    if any((s.get("points") or []) for s in fseries.values()):
+        from triton_dist_tpu.obs.history import sparkline
+
+        def _spark(series, name):
+            pts = (series.get(name) or {}).get("points") or []
+            return sparkline([v for _, v in pts], width=16) or "-"
+
+        lines += ["", "history: "
+                  f"queue {_spark(fseries, 'queue_depth')}   "
+                  f"occ {_spark(fseries, 'batch_occupancy')}   "
+                  f"reporting {_spark(fseries, 'replicas_reporting')}"]
+        for rid in sorted(hist.get("replicas") or {}):
+            rs = (hist["replicas"][rid] or {}).get("series") or {}
+            lines.append(
+                f"  {rid}: q {_spark(rs, 'queue_depth')}  "
+                f"ttft99 {_spark(rs, 'ttft_p99_ms')}")
+    # Remote samplers' early warnings (scrape_history cache): surface
+    # the newest one per replica — the fleet screen is exactly where a
+    # pre-breach warning must show up.
+    for rid in sorted(state.get("remote_history") or {}):
+        rh = state["remote_history"][rid] or {}
+        for w in (rh.get("warnings") or [])[:1]:
+            lines.append(
+                f"  ! {rid}: history.warning {w.get('detector', '?')} "
+                f"{w.get('metric', '?')}")
+
     errs = [r for r in rows if r.get("error")]
     for r in errs[:4]:
         lines.append(f"  ! {r.get('endpoint')}: "

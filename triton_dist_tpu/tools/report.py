@@ -280,6 +280,43 @@ def render_waterfalls(wf: dict | None) -> str:
     return "\n".join(lines)
 
 
+def render_history(hist: dict | None) -> str:
+    """Summarize a sampled-history snapshot (``obs.history`` — the
+    ``serving_history`` bench embeds one under
+    ``extras.telemetry.history``; docs/observability.md "History
+    plane"): per-series stats with a unicode sparkline, plus every
+    retained early-warning excerpt. Empty string when no series were
+    sampled."""
+    if not hist or not hist.get("series"):
+        return ""
+    from triton_dist_tpu.obs.history import sparkline, window_stats
+    lines = ["#### history",
+             "| series | n | last | min | max | avg | trend |",
+             "|---|---|---|---|---|---|---|"]
+
+    def _v(x):
+        return "-" if x is None else (
+            int(x) if float(x) == int(x) else round(float(x), 4))
+
+    for name in sorted(hist["series"]):
+        s = hist["series"][name] or {}
+        pts = s.get("points") or []
+        st = window_stats(pts)
+        if not st.get("n"):
+            continue
+        lines.append(
+            f"| {name} | {s.get('n', st['n'])} | {_v(st['last'])} | "
+            f"{_v(st['min'])} | {_v(st['max'])} | {_v(st['avg'])} | "
+            f"{sparkline([v for _, v in pts], width=20)} |")
+    for w in hist.get("warnings") or []:
+        lines.append(
+            f"\n⚠ history.warning: {w.get('detector', '?')} detector "
+            f"on `{w.get('metric', '?')}` "
+            f"({w.get('op', '?')} {_v(w.get('threshold'))} over "
+            f"{_v(w.get('window_s'))} s).")
+    return "\n".join(lines)
+
+
 def render_devprof(snap: dict, stats: dict | None = None) -> str:
     """Summarize the device-time truth layer (``obs.devprof``,
     docs/observability.md "Device-time truth"): measured per-op
@@ -337,6 +374,7 @@ def render_telemetry(snap: dict) -> str:
     tracing = render_tracing(snap.get("trace"))
     devprof = render_devprof(snap, snap.get("devprof"))
     waterfalls = render_waterfalls(snap.get("waterfalls"))
+    history = render_history(snap.get("history"))
     # trace.* gauges mirror what the tracing section already shows
     # (they exist for the Prometheus exposition path) — don't render
     # the same numbers twice when that section is present; ditto the
@@ -377,6 +415,8 @@ def render_telemetry(snap: dict) -> str:
         lines += [devprof, ""]
     if waterfalls:
         lines += [waterfalls, ""]
+    if history:
+        lines += [history, ""]
     if scalars:
         lines += ["| metric | type | value |", "|---|---|---|"]
         for kind, k, v in scalars:

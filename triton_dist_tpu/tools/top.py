@@ -48,6 +48,14 @@ def fetch(host: str, port: int, timeout: float = 10.0) -> dict:
             snap["health"] = c.health()
         except Exception:  # noqa: BLE001 — pre-ISSUE-14 servers
             snap["health"] = None
+        try:
+            # Sampled series (ISSUE 16): None unless the server runs
+            # with TDT_HISTORY=1; downsampled server-side so a screen's
+            # worth of sparklines costs one small reply.
+            snap["history"] = c.request(
+                {"cmd": "history", "max_points": 32}).get("history")
+        except Exception:  # noqa: BLE001 — pre-ISSUE-16 servers
+            snap["history"] = None
         snap["requests"] = c.request_stats(last=5)
     finally:
         c.close()
@@ -170,6 +178,32 @@ def render(snap: dict) -> str:
                          f"{dp['last_profile']} "
                          f"({dp.get('last_reason', '?')})"))
     _rows(lines, "device time (measured)", dev_rows)
+
+    # Sampled history (ISSUE 16): one sparkline per recorded series —
+    # the time dimension every panel above lacks — plus the newest
+    # early-warning excerpts. Only present when the server samples
+    # (TDT_HISTORY=1); rendering is additive so old snapshots are fine.
+    hist = snap.get("history") or {}
+    hist_rows = []
+    if hist.get("series"):
+        from triton_dist_tpu.obs.history import sparkline, window_stats
+        for name in sorted(hist["series"]):
+            s = hist["series"][name]
+            pts = s.get("points") or []
+            st = window_stats(pts)
+            if not st.get("n"):
+                continue
+            hist_rows.append(
+                (name, f"{sparkline([v for _, v in pts], width=24)} "
+                       f"last {_fmt(st['last'])}   "
+                       f"min {_fmt(st['min'])}   max {_fmt(st['max'])}"))
+        for w in (hist.get("warnings") or [])[:3]:
+            hist_rows.append(
+                (f"! {w.get('detector', '?')}",
+                 f"{w.get('metric', '?')} {w.get('op', '')} "
+                 f"{_fmt(w.get('threshold'))} "
+                 f"(window {_fmt(w.get('window_s'))}s)"))
+    _rows(lines, "history (sampled)", hist_rows)
 
     req_rows = []
     for r in snap.get("requests", [])[:5]:

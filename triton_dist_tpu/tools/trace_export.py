@@ -47,8 +47,28 @@ import json
 import re
 import sys
 
-__all__ = ["compute_overlap", "gather_to_chrome", "merge_chrome",
-           "merge_profile", "to_chrome", "validate", "write_trace"]
+__all__ = ["compute_overlap", "gather_to_chrome",
+           "history_counter_events", "merge_chrome", "merge_profile",
+           "to_chrome", "validate", "write_trace"]
+
+
+def history_counter_events(hist: dict, pid: int = 0) -> list[dict]:
+    """Render an ``obs.history`` snapshot (``{"epoch", "series":
+    {name: {"points": [[t, v], ...]}}}``) as Chrome ``"C"`` counter
+    events — Perfetto draws each name as a counter track, so sampled
+    series (queue depth, burn rates, KV occupancy) overlay the event
+    timeline on ONE clock. Timestamps are the store's perf-counter
+    seconds shifted by its wall ``epoch`` anchor onto the same
+    wall-anchored micros ``obs.trace`` stamps (ISSUE 16)."""
+    epoch = float(hist.get("epoch") or 0.0)
+    events: list[dict] = []
+    for name in sorted(hist.get("series") or {}):
+        for t, v in hist["series"][name].get("points") or []:
+            events.append({"ph": "C", "pid": pid, "tid": 0,
+                           "name": name, "cat": "history",
+                           "ts": (float(t) + epoch) * 1e6,
+                           "args": {"value": float(v)}})
+    return events
 
 
 def to_chrome(collected: dict, pid: int | None = None,
@@ -87,6 +107,12 @@ def to_chrome(collected: dict, pid: int | None = None,
             "ring_capacity": collected.get("ring_capacity", 0)}
     if metadata:
         meta.update(metadata)
+    # A flight dump with attached history (obs.flight's provider)
+    # carries the raw series in metadata AND as counter tracks, so
+    # the Perfetto view shows the lead-up without a second tool pass.
+    hist = meta.get("history")
+    if hist:
+        events.extend(history_counter_events(hist, pid=pid))
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "metadata": meta}
 
@@ -178,7 +204,7 @@ def merge_profile(chrome: dict, capture_path: str) -> dict:
 # Validation.
 # ---------------------------------------------------------------------------
 
-_KNOWN_PH = frozenset("BEXiM")
+_KNOWN_PH = frozenset("BEXiMC")
 
 
 def validate(chrome: dict) -> tuple[list[str], list[str]]:
@@ -193,7 +219,9 @@ def validate(chrome: dict) -> tuple[list[str], list[str]]:
     ends mid-span; the unclosed span IS the postmortem's answer), an
     ``E`` with no open begin (its ``B`` fell before the
     ``TDT_FLIGHT_SECONDS`` window or was ring-overwritten), and
-    unknown phases.
+    unknown phases. ``C`` (counter) events — the history-plane series
+    tracks — are validated for numeric ts/args but exempt from the
+    monotonic check (several series interleave on one tid).
     """
     errors: list[str] = []
     warnings: list[str] = []
@@ -214,6 +242,19 @@ def validate(chrome: dict) -> tuple[list[str], list[str]]:
             errors.append(f"event {i}: non-numeric ts {ts!r}")
             continue
         key = (e.get("pid", 0), e.get("tid", 0))
+        if ph == "C":
+            # Counter samples (history series): args must carry at
+            # least one numeric value. Several series interleave on
+            # one tid by design, so C events are exempt from the
+            # per-track monotonic check (like back-dated X events).
+            args = e.get("args")
+            if not isinstance(args, dict) or not args or not all(
+                    isinstance(v, (int, float))
+                    and not isinstance(v, bool)
+                    for v in args.values()):
+                errors.append(
+                    f"event {i}: C with non-numeric args {args!r}")
+            continue
         if ph == "X":
             dur = e.get("dur")
             if not isinstance(dur, (int, float)) or dur < 0:
@@ -364,9 +405,16 @@ def main(argv=None) -> int:
                     help="overlay a jax.profiler capture (file / run "
                          "dir / TDT_DEVPROF_DIR root) into the merged "
                          "dump on one wall clock; requires --out")
+    ap.add_argument("--history", default=None, metavar="SERIES",
+                    help="overlay an obs.history snapshot JSON (a "
+                         "saved {'cmd': 'history'} reply or a raw "
+                         "store snapshot) into the merged dump as "
+                         "Perfetto counter tracks; requires --out")
     args = ap.parse_args(argv)
     if args.merge_profile and not args.out:
         ap.error("--merge-profile needs --out for the overlaid dump")
+    if args.history and not args.out:
+        ap.error("--history needs --out for the overlaid dump")
     traces = []
     for p in args.paths:
         with open(p) as f:
@@ -391,6 +439,19 @@ def main(argv=None) -> int:
         merged = merge_chrome(traces) if len(traces) > 1 else traces[0]
         if args.merge_profile:
             merged = merge_profile(merged, args.merge_profile)
+        if args.history:
+            with open(args.history) as f:
+                hist = json.load(f)
+            if isinstance(hist, dict) and "history" in hist:
+                hist = hist["history"]      # a saved verb reply
+            if not isinstance(hist, dict) or not hist.get("series"):
+                ap.error(f"--history {args.history}: no series found")
+            merged = dict(merged)
+            merged["traceEvents"] = (list(merged.get("traceEvents", []))
+                                     + history_counter_events(hist))
+            meta = dict(merged.get("metadata") or {})
+            meta["history_series"] = len(hist["series"])
+            merged["metadata"] = meta
         write_trace(merged, args.out)
         print(f"wrote {args.out} "
               f"({len(merged['traceEvents'])} events)")
