@@ -189,7 +189,7 @@ _PART_ORDER = ("ag_gemm", "gemm_rs", "gemm_ar", "flash_decode", "tp_mlp",
                "layer_8b", "layer_32b", "overlap", "moe_ag_gg", "mega",
                "serving", "serving_mega", "serving_spec",
                "serving_fleet", "serving_router", "serving_history",
-               "prefix", "sp_attn", "train")
+               "serving_disagg", "prefix", "sp_attn", "train")
 
 #: Sweep-heavy parts get longer deadlines: ag_gemm/gemm_rs autotune
 #: 6-8 candidates at ~25 s Mosaic compile each on a COLD cache (the
@@ -1846,6 +1846,156 @@ def _bench_serving_router(mesh, n, on_tpu, extras):
             extras.get("serving_router_vs_direct"))
 
 
+def _bench_serving_disagg(mesh, n, on_tpu, extras):
+    """Disaggregated prefill/decode vs the unified fleet (ISSUE 18):
+    ONE prefill + TWO decode paged replicas behind a TIERED
+    ``RouterServer`` — single-prompt generates take the
+    ``disagg_prefill`` path (prefill admits, streams finished KV
+    blocks to the placed decode replica keyed by the prefix cache's
+    sha1 chain, decode verifies the chain and admits DECODE-ONLY) —
+    against THREE unified replicas behind an untiered router. Same
+    model/params/paged-engine config on both legs; the workload's
+    prompts share one long preamble so the content-addressed dedup
+    has a chain to find (steady-state handoffs ship near-zero
+    blocks). ``serving_disagg_vs_unified`` prices the whole
+    specialization, handoff latency included (floor-gated generously
+    in BASELINE.json's cpu tier — one GIL carries six pumps + two
+    routers); the gate (tools/bench_ops.py ``check_disagg_wellformed``)
+    also requires >= 1 COMPLETED handoff and a dedup ratio in [0, 1].
+    The disagg fleet's private-registry ``disagg.*`` metrics ride
+    under ``extras.telemetry`` (report.py "disagg" section) via
+    ``disagg_snapshot``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from triton_dist_tpu.models import DenseLLM, Engine, ModelConfig
+    from triton_dist_tpu.obs import histogram_quantile, merge_snapshots
+    from triton_dist_tpu.serving import ModelServer, RouterServer
+    from triton_dist_tpu.serving.client import fanout
+
+    if on_tpu:
+        cfg = ModelConfig(hidden_size=512, intermediate_size=1024,
+                          num_hidden_layers=2, num_attention_heads=8,
+                          num_key_value_heads=8, head_dim=64,
+                          vocab_size=2048, max_position_embeddings=1024,
+                          dtype=jnp.bfloat16)
+        page, preamble_len, tail_len, gen = 16, 512, 8, 8
+    else:
+        # Prefill-heavy on purpose (same sizing rationale as the
+        # prefix part): the handoff moves PREFILL work off the decode
+        # replicas, so prefill compute must dominate dispatch overhead
+        # for the ratio to price anything real on the CPU tier.
+        cfg = ModelConfig(hidden_size=128, intermediate_size=256,
+                          num_hidden_layers=2, num_attention_heads=8,
+                          num_key_value_heads=8, head_dim=16,
+                          vocab_size=256, max_position_embeddings=512,
+                          dtype=jnp.float32)
+        page, preamble_len, tail_len, gen = 16, 192, 4, 4
+    devs = np.asarray([d for d in mesh.devices.flat])
+    mesh2 = Mesh(devs.reshape(1, -1), ("tp", "sp"))
+    max_seq = cfg.max_position_embeddings
+    assert max_seq % (len(devs) * page) == 0
+    model = DenseLLM(cfg, mesh=mesh2, axis="tp", sp_axis="sp",
+                     impl="xla", fwd_mode="sp")
+    params = model.init(jax.random.PRNGKey(0))
+    clients, batch = 9, 4
+    preamble = [(13 * j) % (cfg.vocab_size - 1) + 1
+                for j in range(preamble_len)]
+    reqs = [{"prompt_ids": [preamble + [(7 * i + j) % 61 + 1
+                                        for j in range(tail_len)]],
+             "gen_len": gen}
+            for i in range(clients)]
+
+    def run(tiers):
+        srvs = [ModelServer(
+            Engine(model, batch=batch, max_seq=max_seq,
+                   prefill_mode="sp", decode_mode="sp", paged=True,
+                   page_size=page, prefix_cache=True),
+            params, port=0, registry="private",
+            replica_id=f"disagg-{t[0]}{i}", tier=t).start()
+            for i, t in enumerate(tiers)]
+        router = RouterServer(
+            [(s.host, s.port) for s in srvs], registry="private",
+            poll_s=0.1, try_timeout_s=60.0, deadline_s=240.0,
+            fleet_kwargs={"stale_s_": 2.0, "down_s_": 10.0}).start()
+        try:
+            # Tier pickup is health-advertised: wait for the poll to
+            # see every role before timing (an untiered fleet is all
+            # "unified" and passes immediately).
+            deadline = time.perf_counter() + 20.0
+            want = set(tiers)
+            while time.perf_counter() < deadline:
+                rows = router.status()["replicas"]
+                if {r.get("tier") for r in rows} >= want:
+                    break
+                time.sleep(0.05)
+            # Warmup compiles every bucket the timed window touches
+            # through the front door — and, on the tiered leg, runs
+            # the first COLD handoffs so the decode replicas' prefix
+            # caches hold the preamble chain (the steady state the
+            # dedup ratio reports).
+            fanout(router.host, router.port, timeout=600,
+                   requests=[dict(r, gen_len=2) for r in reqs])
+            t0 = time.perf_counter()
+            outs = fanout(router.host, router.port, timeout=600,
+                          requests=reqs)
+            dt = time.perf_counter() - t0
+            toks = sum(len(o["tokens"][0]) for o in outs
+                       if "tokens" in o)
+            errors = [o for o in outs if "tokens" not in o]
+            tps = toks / dt if dt > 0 else 0.0
+            snaps = [s.registry.snapshot() for s in srvs]
+            return tps, errors, snaps, router.status()["counters"]
+        finally:
+            router.stop()
+            for s in srvs:
+                s.stop()
+
+    tps_u, err_u, _, _ = run(("unified",) * 3)
+    tps_d, err_d, snaps, rctr = run(("prefill", "decode", "decode"))
+
+    extras["serving_disagg_clients"] = clients
+    extras["serving_disagg_tokens_per_s"] = round(tps_d, 2)
+    extras["serving_disagg_unified_tokens_per_s"] = round(tps_u, 2)
+    ratio = round(tps_d / tps_u, 4) if tps_u > 0 else None
+    extras["serving_disagg_vs_unified"] = ratio
+    if err_u or err_d:
+        extras["serving_disagg_errors"] = [
+            str(e)[:120] for e in (err_u + err_d)[:4]]
+
+    merged = merge_snapshots(snaps)
+    ctr = merged.get("counters", {})
+    extras["serving_disagg_handoffs"] = int(ctr.get("disagg.handoffs",
+                                                    0))
+    extras["serving_disagg_fallbacks"] = int(ctr.get("disagg.fallbacks",
+                                                     0))
+    extras["serving_disagg_dispatches"] = int(
+        rctr.get("router.disagg_dispatches", 0))
+    offered = ctr.get("disagg.blocks_offered", 0)
+    if offered:
+        extras["serving_disagg_dedup_ratio"] = round(
+            ctr.get("disagg.blocks_deduped", 0) / offered, 4)
+    h = merged.get("histograms", {}).get("disagg.handoff_ms")
+    if h:
+        for q, tag in ((0.50, "p50"), (0.99, "p99")):
+            v = histogram_quantile(h, q)
+            extras[f"serving_disagg_handoff_{tag}_ms"] = (
+                round(v, 3) if v is not None else None)
+    # The disagg fleet's metrics for the report's "disagg" section:
+    # ONLY the disagg.* namespace — the six replicas' serving.*
+    # counters would masquerade as one server's in the telemetry
+    # merge.
+    extras["disagg_snapshot"] = {
+        "counters": {k: v for k, v in ctr.items()
+                     if k.startswith("disagg.")},
+        "histograms": {k: v
+                       for k, v in merged.get("histograms", {}).items()
+                       if k.startswith("disagg.")},
+    }
+    return (extras.get("serving_disagg_tokens_per_s"), ratio)
+
+
 def _bench_prefix(mesh, n, on_tpu, extras):
     """Cross-request prefix caching (ISSUE 6): 8 clients sharing one
     long system preamble against the paged block-granular scheduler,
@@ -2516,6 +2666,8 @@ def main():
              lambda: _bench_serving_router(mesh, n, on_tpu, extras)),
             ("serving_history",
              lambda: _bench_serving_history(mesh, n, on_tpu, extras)),
+            ("serving_disagg",
+             lambda: _bench_serving_disagg(mesh, n, on_tpu, extras)),
             ("prefix",
              lambda: _bench_prefix(mesh, n, on_tpu, extras)),
             ("sp_attn",
@@ -2540,6 +2692,15 @@ def main():
             except Exception as e:  # noqa: BLE001 — partial over rc!=0
                 extras[name + "_error"] = _err(e)
             tel = obs.snapshot()
+            if "disagg_snapshot" in extras:
+                # The serving_disagg part's private-registry disagg.*
+                # metrics merge into the part telemetry (report.py
+                # "disagg" section reads top-level counters /
+                # histograms); extras stays a flat scalar map for the
+                # regress gate.
+                from triton_dist_tpu.obs import merge_snapshots
+                tel = merge_snapshots(
+                    [tel, extras.pop("disagg_snapshot")])
             if _trace.enabled():
                 tel["trace"] = _trace.stats()
             for k in ("serving_waterfall", "prefix_waterfall"):

@@ -429,6 +429,7 @@ def test_regress_gates_fleet(tmp_path):
         "serving_mega_vs_plain": 1.0, "serving_spec_vs_plain": 1.6,
         "serving_router_vs_direct": 0.9,
         "serving_history_on_vs_off": 0.97,
+        "serving_disagg_vs_unified": 0.31,
         "serving_fleet_vs_single": 0.84,
         "serving_fleet_tokens_per_s": 1200.0,
         "serving_fleet_replica_ids": ["r0", "r1"],

@@ -696,6 +696,7 @@ def test_regress_from_file_gates_overlap(tmp_path):
               "serving_fleet_vs_single": 0.84,
               "serving_router_vs_direct": 0.9,
               "serving_history_on_vs_off": 0.97,
+              "serving_disagg_vs_unified": 0.31,
               "ag_gemm_pallas_ms": 1.0, "baseline_anomaly": None}
     path = tmp_path / "ck.json"
     path.write_text(json.dumps({"extras": extras}))

@@ -22,6 +22,10 @@ Built-in passes:
   including the documented TPU collapse case (:mod:`.a2a_model`);
 - ``p2p-protocol`` — the PP ``_shift_kernel`` hop protocol, composed
   over mixed ±delta pipelines (:mod:`.p2p_model`);
+- ``kvstream-protocol`` — the disaggregated prefill/decode KV-handoff
+  offer/need/ship/signal sequence over the kernel's own dedup/ship
+  schedule helpers, every (n_blocks, held) shape
+  (:mod:`.kvstream_model`);
 - ``flash-decode-protocol`` — the distributed flash-decode softmax-
   state combine: each rank's (acc, l, m) partial merges exactly once
   (:mod:`.flash_model`);
@@ -192,6 +196,19 @@ def _p2p_pass(root):
     return p2p_model.verify_p2p()
 
 
+@register_pass("kvstream-protocol",
+               "model-check the disaggregated KV-handoff offer/need/"
+               "ship/signal protocol over every (n_blocks, held) "
+               "dedup shape",
+               watches=_CORE + (
+                   "triton_dist_tpu/analysis/kvstream_model.py",
+                   "triton_dist_tpu/serving/kv_stream.py",
+                   "triton_dist_tpu/serving/disagg.py"))
+def _kvstream_pass(root):
+    from triton_dist_tpu.analysis import kvstream_model
+    return kvstream_model.verify_kvstream()
+
+
 @register_pass("flash-decode-protocol",
                "model-check the distributed flash-decode softmax-"
                "state combine (exactly-once merge), worlds 1..8",
@@ -237,11 +254,14 @@ def _vmem_pass(root):
                # contract (spec telemetry stays cataloged), the
                # ISSUE-14 one (fleet/fleet_top telemetry likewise),
                # the ISSUE-15 one (router + chaos-harness telemetry),
-               # and the ISSUE-16 one (history-plane telemetry)
+               # the ISSUE-16 one (history-plane telemetry), and the
+               # ISSUE-18 one (disagg stream/handoff telemetry)
                # against a future narrowing of the package glob.
                watches=("triton_dist_tpu/", "docs/observability.md",
                         "triton_dist_tpu/serving/",
                         "triton_dist_tpu/serving/router.py",
+                        "triton_dist_tpu/serving/kv_stream.py",
+                        "triton_dist_tpu/serving/disagg.py",
                         "triton_dist_tpu/models/spec.py",
                         "triton_dist_tpu/obs/fleet.py",
                         "triton_dist_tpu/obs/history.py",
@@ -296,11 +316,16 @@ def _fallback_pass(root):
                # re-drives the serving path end to end. The ISSUE-16
                # history sampler rides because it lives inside the
                # pump's lifecycle (scheduler-owned thread peeking the
-               # registry the labeled step updates).
+               # registry the labeled step updates). The ISSUE-18
+               # disagg plane rides because the prefill-side kv_export
+               # hook runs inside the pump's record path and the
+               # decode-side adopt bypasses the labeled prefill step.
                watches=("triton_dist_tpu/resilience/router.py",
                         "triton_dist_tpu/obs/devprof.py",
                         "triton_dist_tpu/serving/",
                         "triton_dist_tpu/serving/router.py",
+                        "triton_dist_tpu/serving/kv_stream.py",
+                        "triton_dist_tpu/serving/disagg.py",
                         "triton_dist_tpu/models/spec.py",
                         "triton_dist_tpu/obs/fleet.py",
                         "triton_dist_tpu/obs/history.py",

@@ -1,0 +1,137 @@
+"""Static model checker for the KV-stream handoff protocol (ISSUE 18).
+
+``serving/kv_stream.py`` moves one disaggregated handoff's KV blocks
+from a prefill replica to a decode replica: a content-addressed
+``kv_offer``/``kv_need`` negotiation picks the dedup point, then every
+needed block ships with a per-block SEQUENCE-NUMBERED completion
+signal, and the receiver admits decode-only only once the signal
+sequence is contiguous and every needed block has landed
+(``HandoffStaging.verify``). Per the protocol-coverage meta-lint
+(PR 11), the protocol lands WITH this verifier: the trace builders
+execute the kernel's OWN schedule helpers
+(:func:`~triton_dist_tpu.serving.kv_stream.ship_schedule` /
+``needed_blocks`` — the same functions the sender's loop, the
+receiver's contiguity check, and the symm-mem tier follow), so the
+protocol and its proof cannot drift.
+
+The model (two ranks: 0 = prefill sender, 1 = decode receiver):
+
+- each scheduled block is a ``signal`` (the shipped payload + its
+  sequence-numbered completion) on sem ``("kv", seq)``, drained by the
+  sender's ``wait_send`` (the ack);
+- the receiver ``wait_recv``s the block's signal BEFORE consuming it
+  (no signal before its block — consuming unguarded is the
+  ``kvstream.race`` class);
+- commit consumes the receiver's locally-held dedup prefix (guard
+  ``None`` — local data);
+- the coverage oracle demands EVERY block of the handoff consumed
+  exactly once — held locally or shipped — so dedup dropping a needed
+  block is ``kvstream.coverage``, a dropped completion signal is
+  ``kvstream.deadlock``, and a double-ship is
+  ``kvstream.signal_wait_imbalance`` (the three mutation classes
+  tests/test_disagg.py proves produce DISTINCT codes).
+"""
+
+from __future__ import annotations
+
+from triton_dist_tpu.analysis.protocol_model import (
+    Ev, Trace, anchor_of, copy_trace, first_event,
+    violations_to_findings)
+
+__all__ = [
+    "handoff_trace", "verify_kvstream", "drop_signal", "double_ship",
+    "dedup_drop_needed", "SENDER", "RECEIVER",
+]
+
+SENDER, RECEIVER = 0, 1
+
+
+def handoff_trace(n_blocks: int, held: int,
+                  shipped_from: int | None = None) -> Trace:
+    """Event trace of one handoff: ``n_blocks`` total, the receiver's
+    prefix cache already holding the first ``held``. The ship plan is
+    the kernel's own :func:`ship_schedule`; ``shipped_from`` overrides
+    the plan's dedup point WITHOUT changing what the receiver actually
+    holds — the ``dedup_drop_needed`` mutant's knob (a broken
+    negotiation that trusts a dedup point past the held prefix drops
+    a needed block, which the coverage oracle catches)."""
+    from triton_dist_tpu.serving import kv_stream
+    held = max(0, min(int(held), int(n_blocks)))
+    plan = kv_stream.ship_schedule(
+        n_blocks, held if shipped_from is None else shipped_from)
+    sevs, revs = [], []
+    for j, s in plan:
+        sem = ("kv", s)
+        sevs.append(Ev("signal", SENDER, sem=sem, dst=RECEIVER,
+                       call=s))
+        sevs.append(Ev("wait_send", SENDER, sem=sem, call=s))
+        revs.append(Ev("wait_recv", RECEIVER, sem=sem, call=s))
+        revs.append(Ev("consume", RECEIVER, key=("blk", j), guard=sem,
+                       call=s))
+    # kv_commit: the admission consumes the locally-held dedup prefix
+    # too (local data, no delivery guard) — the blocks the negotiation
+    # promised were already resident.
+    for j in range(held):
+        revs.append(Ev("consume", RECEIVER, key=("blk", j)))
+    expected = {SENDER: {},
+                RECEIVER: {("blk", j): 1 for j in range(n_blocks)}}
+    return Trace(
+        name=f"kvstream[n{n_blocks} held{held}"
+             + (f" ship@{shipped_from}]" if shipped_from is not None
+                else "]"),
+        world=2, dirs=1,
+        events={SENDER: sevs, RECEIVER: revs},
+        expected=expected,
+        anchor=anchor_of(kv_stream.ship_schedule),
+        code_prefix="kvstream")
+
+
+def verify_kvstream(max_blocks: int = 6) -> list:
+    """Model-check every (n_blocks, held) handoff shape up to
+    ``max_blocks`` — cold (held 0), every partial overlap, and the
+    fully-warm near-zero-byte handoff (held == n_blocks). Returns
+    findings (empty == verified)."""
+    findings = []
+    for n in range(1, int(max_blocks) + 1):
+        for held in range(0, n + 1):
+            findings.extend(violations_to_findings(
+                handoff_trace(n, held), "kvstream-protocol",
+                fix_hint=("the ship schedule this trace mirrors "
+                          "violates the KV handoff protocol — see "
+                          "docs/serving.md 'Disaggregated "
+                          "prefill/decode'")))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Known-bad mutants (tests/test_disagg.py): each must fail with its
+# DISTINCT finding code, or the checker is untested.
+# ---------------------------------------------------------------------------
+
+def drop_signal(trace: Trace) -> Trace:
+    """Dropped completion signal: a block ships but its signal never
+    fires — the receiver's wait blocks forever
+    (``kvstream.deadlock``)."""
+    t = copy_trace(trace)
+    r, i = first_event(t, "signal", SENDER, sem_kind="kv")
+    del t.events[r][i]
+    return t
+
+
+def double_ship(trace: Trace) -> Trace:
+    """Double-shipped block: the same sequence number signals twice —
+    a semaphore left nonzero at exit
+    (``kvstream.signal_wait_imbalance``)."""
+    t = copy_trace(trace)
+    r, i = first_event(t, "signal", SENDER, sem_kind="kv")
+    t.events[r].insert(i, t.events[r][i])
+    return t
+
+
+def dedup_drop_needed(n_blocks: int, held: int) -> Trace:
+    """Dedup drops a needed block: the ship plan trusts a dedup point
+    ONE PAST the receiver's held prefix, so block ``held`` is neither
+    resident nor shipped (``kvstream.coverage``)."""
+    if held >= n_blocks:
+        raise ValueError("need at least one non-held block to drop")
+    return handoff_trace(n_blocks, held, shipped_from=held + 1)

@@ -49,13 +49,17 @@ __all__ = ["CLAIMS", "BACKLOG", "PROTOCOL_FREE", "PRIMITIVES",
            "scan_module", "collect_findings", "run"]
 
 #: Verified kernels: ops/ module basename -> the registered pass that
-#: model-checks its protocol (docs/analysis.md pass catalog).
+#: model-checks its protocol (docs/analysis.md pass catalog). Keys
+#: containing ``/`` are PACKAGE-relative paths — comm kernels living
+#: outside ops/ (the disaggregated KV-stream transport, ISSUE 18)
+#: carry the same claim discipline, scanned at the package root.
 CLAIMS = {
     "allgather_gemm.py": "ring-protocol",
     "gemm_reduce_scatter.py": "ring-protocol",
     "all_to_all.py": "a2a-protocol",
     "p2p.py": "p2p-protocol",
     "flash_decode.py": "flash-decode-protocol",
+    "serving/kv_stream.py": "kvstream-protocol",
 }
 
 #: Pre-zoo kernels awaiting trace builders — each entry names what
@@ -140,6 +144,7 @@ def collect_findings(ops_dir: Path = None, claims: dict = None,
     if ops_dir is None:
         import triton_dist_tpu.ops
         ops_dir = Path(triton_dist_tpu.ops.__file__).parent
+    default_claims = claims is None
     claims = CLAIMS if claims is None else claims
     backlog = BACKLOG if backlog is None else backlog
     if protocol_free is None:
@@ -152,6 +157,15 @@ def collect_findings(ops_dir: Path = None, claims: dict = None,
         passes = PASSES
     findings = []
     seen = set()
+    # "/" keys are package-relative claims (kernels outside ops/) —
+    # handled in their own scan below, not by the ops/ basename walk.
+    path_claims = {k: v for k, v in claims.items() if "/" in k}
+    claims = {k: v for k, v in claims.items() if "/" not in k}
+    if not default_tree and default_claims:
+        # An injected synthetic tree with the default claims map would
+        # see the real package-relative claims dangle under it — same
+        # opt-in rule as PROTOCOL_FREE.
+        path_claims = {}
     for path in sorted(ops_dir.glob("*.py")):
         name = path.name
         if name == "__init__.py":
@@ -200,9 +214,42 @@ def collect_findings(ops_dir: Path = None, claims: dict = None,
             file=str(ops_dir / name), line=1,
             pass_name="protocol-coverage",
             fix_hint="remove the dangling claim"))
+    # Package-relative claims (comm kernels outside ops/): same three
+    # finding classes as the basename walk, scanned at the package
+    # root.
+    pkg_dir = ops_dir.parent
+    for rel in sorted(path_claims):
+        path = pkg_dir / rel
+        if not path.exists():
+            findings.append(Finding(
+                code="protocol.stale_claim",
+                message=f"{rel} is claimed but does not exist under "
+                        f"{pkg_dir}",
+                file=str(path), line=1,
+                pass_name="protocol-coverage",
+                fix_hint="remove the dangling claim"))
+            continue
+        line, used = scan_module(path)
+        if not used:
+            findings.append(Finding(
+                code="protocol.stale_claim",
+                message=f"{rel} is claimed but no longer uses any "
+                        f"protocol primitive — drop the stale entry",
+                file=str(path), line=1,
+                pass_name="protocol-coverage",
+                fix_hint="remove the module from lint_protocol.CLAIMS"))
+        elif path_claims[rel] not in passes:
+            findings.append(Finding(
+                code="protocol.unknown_pass",
+                message=f"{rel} claims verifier pass "
+                        f"{path_claims[rel]!r}, which is not "
+                        f"registered — a claim must be checkable",
+                file=str(path), line=line,
+                pass_name="protocol-coverage",
+                fix_hint="register the pass in analysis/__init__.py "
+                         "or fix the CLAIMS entry"))
     # Declared protocol-free modules outside ops/ (package-relative):
     # verify the claim instead of trusting the prose.
-    pkg_dir = ops_dir.parent
     for rel in sorted(protocol_free):
         path = pkg_dir / rel
         if not path.exists():

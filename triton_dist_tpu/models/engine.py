@@ -1222,6 +1222,106 @@ class StreamSession:
         scattered into the batch, so the session stays consistent)."""
         self._pending.pop(row, None)
 
+    # -- disaggregated handoff (ISSUE 18) ----------------------------------
+    def export_row(self, row: int, prompt) -> dict:
+        """Extract row ``row``'s finished prompt KV blocks for a
+        disaggregated handoff (serving/disagg.py): per-block packed
+        payloads plus the dedup-eligible hash chain. Must run while
+        the row still holds its blocks — the scheduler invokes the
+        request's ``kv_export`` callback just BEFORE ``retire_row``
+        (a retired row's private blocks return to the free stack and
+        may be overwritten by the next admission)."""
+        from triton_dist_tpu.serving import kv_stream
+        eng, kv = self.engine, self.engine.kv
+        assert eng.paged, "export_row needs a paged engine"
+        prompt = [int(t) for t in prompt]
+        L = len(prompt)
+        n_blocks = kv_stream.block_span(L, kv.page_size)
+        hashes = kv.prefix_hashes(prompt) or []
+        lookup = kv.prefix_lookup_blocks(L)
+        blocks = {}
+        for j in range(n_blocks):
+            r, lp = kv._block_lane(j)
+            idx = (r * kv.phys_slots_per_dev
+                   + int(kv._table[r, row, lp]))
+            layers = [(np.asarray(pk[idx]), np.asarray(pv[idx]))
+                      for pk, pv in self.caches]
+            blocks[j] = kv_stream.pack_block(layers)
+        return {"hashes": [h.hex() for h in hashes[:lookup]],
+                "n_blocks": n_blocks, "blocks": blocks,
+                "meta": {"layers": len(self.caches),
+                         "page": kv.page_size,
+                         "heads": kv.num_kv_heads,
+                         "dim": kv.head_dim, "prompt_len": L}}
+
+    def adopt_row(self, row: int, prompt, first: int,
+                  gen_budget: int | None, blocks: dict) -> int:
+        """Admit row ``row`` DECODE-ONLY from a verified handoff: no
+        prefill program runs. The block allocator maps whatever prefix
+        the local cache already holds (the dedup the ``kv_need``
+        negotiation promised), the SHIPPED payloads are written into
+        the privately-allocated remaining blocks, and the row starts
+        decoding from the prefill side's first sampled token — under
+        greedy decoding the output is bit-identical to a local prefill
+        of the same prompt (the shipped blocks hold exactly the K/V a
+        local prefill would have written; docs/serving.md
+        "Disaggregated prefill/decode"). ``blocks`` maps block index →
+        packed payload; a block neither held locally nor shipped fails
+        the admission with ``ValueError`` (the caller's re-prefill
+        fallback), with full rollback like any failed admission."""
+        from triton_dist_tpu.serving import kv_stream
+        eng, kv = self.engine, self.engine.kv
+        assert eng.paged, "adopt_row needs a paged engine"
+        assert not self.live[row] and row not in self._pending, \
+            f"row {row} is occupied"
+        prompt = [int(t) for t in prompt]
+        assert prompt, "prompts must be non-empty"
+        L = len(prompt)
+        n_blocks = kv_stream.block_span(L, kv.page_size)
+        hashes = kv.prefix_hashes(prompt)
+        k = kv.prefix_probe(prompt, hashes=hashes)
+        cached = kv.admit_row(row, prompt,
+                              gen_budget=int(gen_budget or 0),
+                              use_hits=k, hashes=hashes)
+        try:
+            self.cur_table = kv.block_table()
+            k_blocks = cached // kv.page_size
+            missing = [j for j in range(k_blocks, n_blocks)
+                       if j not in blocks]
+            if missing:
+                raise ValueError(
+                    f"adopt_row: blocks {missing} neither held "
+                    f"locally nor shipped — incomplete handoff")
+            shape = (kv.page_size, kv.num_kv_heads, kv.head_dim)
+            caches = self.caches
+            for j in range(k_blocks, n_blocks):
+                layers = kv_stream.unpack_block(
+                    blocks[j], len(caches), shape)
+                r, lp = kv._block_lane(j)
+                idx = (r * kv.phys_slots_per_dev
+                       + int(kv._table[r, row, lp]))
+                caches = [
+                    (pk.at[idx].set(jnp.asarray(lk, pk.dtype)),
+                     pv.at[idx].set(jnp.asarray(lv, pv.dtype)))
+                    for (pk, pv), (lk, lv) in zip(caches, layers)]
+            if n_blocks > k_blocks:
+                # Materialize inside the rollback window, like the
+                # admission programs: an async upload failure must not
+                # leave a zombie live row holding its blocks.
+                jax.block_until_ready(caches[0][0])
+            self.caches = caches
+        except Exception:
+            kv.release_row(row)
+            self.cur_table = kv.block_table()
+            raise
+        kv.register_prefix(row, prompt, hashes=hashes)
+        self._note_prefix(row, L, cached)
+        self.admit_info = {"cached": cached, "adopted": True}
+        self._mark_admitted(row, L)
+        self.token = self.token.at[row].set(int(first))
+        self._spec_start(row, prompt, int(first), gen_budget)
+        return int(first)
+
     def _mark_admitted(self, row: int, prompt_len: int) -> None:
         obs.counter("engine.stream_admissions").inc()
         _trace.instant("engine.stream_admission", "engine",

@@ -83,6 +83,17 @@ class PrefixCache:
             k += 1
         return k
 
+    def chain_prefix_match(self, hashes) -> int:
+        """Longest locally-held hash-chain prefix of ``hashes`` — the
+        ``kv_need`` primitive of the disaggregated handoff
+        (docs/serving.md "Disaggregated prefill/decode"): a decode
+        replica answers a ``kv_offer`` with this count, so the prefill
+        side ships ONLY the missing suffix. Identical walk to
+        :meth:`probe` (stateless, no LRU touch), exposed under the
+        protocol's name so the negotiation and the admission planner
+        provably share one lookup."""
+        return self.probe(hashes)
+
     def resolve(self, hashes, max_hits: int | None = None):
         """Resolve the longest indexed prefix to its slots (no counter
         accounting — the allocator accounts only admissions that

@@ -139,7 +139,7 @@ def peek_counters(registry=None) -> dict:
 
 def replica_health(replica_id: str, seq: int, started_monotonic: float,
                    registry=None, engine=None, scheduler=None,
-                   clock=time.monotonic) -> dict:
+                   clock=time.monotonic, tier: str | None = None) -> dict:
     """The compact ``ReplicaHealth`` dict ``{"cmd": "health"}``
     returns (docs/serving.md "Server"): everything the fleet view and
     the placement score consume, built from lock-free reads of the
@@ -198,6 +198,11 @@ def replica_health(replica_id: str, seq: int, started_monotonic: float,
                                        "server.requests",
                                        "server.errors") if k in c},
     }
+    if tier is not None:
+        # Disaggregated-fleet role (ISSUE 18): "prefill" / "decode" /
+        # "unified" — a tiered router pools replicas by this field, so
+        # it rides the cheap health verb like draining does.
+        health["tier"] = str(tier)
     if engine is not None:
         kv = getattr(engine, "kv", None)
         health["batch"] = getattr(kv, "batch", None)

@@ -50,6 +50,7 @@ MIN_INTERVAL_S = 1.0
 _LOCK = threading.Lock()
 _LAST: dict | None = None           # {"path", "reason", "ts", "count"}
 _COUNT = 0
+_SEQ = 0                            # filename uniquifier (same-ms dumps)
 _LAST_BY_REASON: dict[str, float] = {}
 _SIGTERM_INSTALLED = False
 _REPLICA_ID: str | None = None
@@ -122,7 +123,7 @@ def dump(reason: str, last_s: float | None = None) -> str | None:
 
     The filename carries the reason, host index, and a millisecond
     timestamp so repeated dumps never clobber each other."""
-    global _LAST, _COUNT
+    global _LAST, _COUNT, _SEQ
     if not _trace.enabled():
         return None
     from triton_dist_tpu.tools import trace_export as _texp
@@ -152,9 +153,16 @@ def dump(reason: str, last_s: float | None = None) -> str | None:
     # The replica segment keeps two same-host replicas' dumps
     # filename-distinct even at identical millisecond timestamps.
     rep = f"_r{_safe(_REPLICA_ID, 48)}" if _REPLICA_ID else ""
+    with _LOCK:
+        # Per-process sequence number: two dumps inside the SAME
+        # millisecond (fast hosts, back-to-back triggers) must not
+        # share a path — the second would silently overwrite the
+        # first postmortem.
+        _SEQ += 1
+        seq = _SEQ
     path = os.path.join(
         d, f"flight_{safe}{rep}_h{_texp._host_index()}"
-           f"_{int(time.time() * 1e3)}_{os.getpid()}.trace.json")
+           f"_{int(time.time() * 1e3)}_{os.getpid()}_{seq}.trace.json")
     with open(path, "w") as f:
         json.dump(chrome, f)
     with _LOCK:

@@ -1,0 +1,324 @@
+"""Disaggregated prefill/decode over the KV-stream protocol (ISSUE 18).
+
+The subsystem that specializes the fleet: a PREFILL replica runs the
+chunked prefill of a request into its paged pools, samples the first
+token, then streams the finished KV blocks to a DECODE replica —
+content-addressed by the prefix cache's sha1 block-hash chain
+(models/prefix_cache.py), so the ``kv_offer``/``kv_need`` negotiation
+ships ONLY the blocks the decode side's prefix cache does not already
+hold (serving/kv_stream.py carries the wire protocol, the schedule
+helpers the model checker executes, and the one-sided symm-mem tier).
+The decode replica verifies the chain and admits the row DECODE-ONLY
+(:meth:`StreamSession.adopt_row` — no re-prefill), so one long prompt
+never stalls TPOT for the decoders co-scheduled on that replica.
+
+One :class:`DisaggEndpoint` hangs off every scheduler-path
+``ModelServer`` and serves both roles on the existing JSON-lines
+protocol:
+
+- as the DECODE side: ``kv_offer`` (answers ``need_from`` — the
+  longest hash-chain prefix its cache holds), ``kv_ship``
+  (sequence-numbered block payloads into a staging table), and
+  ``kv_commit`` (verify chain → ``Scheduler.submit_preloaded`` →
+  generated tokens back to the prefill side);
+- as the PREFILL side: ``disagg_prefill`` (the verb a tiered router
+  dispatches) — prefill locally with a ``kv_export`` capture, then
+  negotiate/ship/commit against ``decode_endpoint``.
+
+Transport is TIERED per handoff: a decode endpoint registered in this
+process (:func:`find_inproc` — the bench and tests run whole fleets in
+one process) is driven by direct calls with each block pushed through
+the one-sided :func:`~triton_dist_tpu.serving.kv_stream.symm_ship`
+path (``disagg.ship_inproc``); anything else speaks the
+length-prefixed wire verbs via
+:class:`~triton_dist_tpu.serving.kv_stream.KVStreamSender`
+(``disagg.ship_wire``).
+
+The FALLBACK CONTRACT (docs/serving.md "Disaggregated
+prefill/decode"): ANY handoff failure — export miss, dead decode
+peer, chain-verify reject, decode-side eviction between offer and
+commit — counts ``disagg.fallbacks`` and re-serves the request
+locally in full. The prompt's blocks are still warm in the prefill
+replica's prefix cache, so the re-prefill is near-free, and the
+client sees tokens, never an error. One trace ID spans prefill admit
+→ stream → decode admit (``disagg.*`` instants).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from triton_dist_tpu import obs
+from triton_dist_tpu.obs import trace
+from triton_dist_tpu.serving import kv_stream
+
+__all__ = ["DisaggEndpoint", "find_inproc", "register_inproc",
+           "unregister_inproc"]
+
+# In-process endpoint registry: "host:port" → DisaggEndpoint. The
+# same-host transport tier — a fleet bench or test running N replicas
+# in one process hands block payloads over directly (through the
+# symm-mem ship path) instead of re-entering its own TCP stack.
+_INPROC_LOCK = threading.Lock()
+_INPROC: dict = {}
+
+
+def register_inproc(label: str, endpoint: "DisaggEndpoint") -> None:
+    with _INPROC_LOCK:
+        _INPROC[label] = endpoint
+
+
+def unregister_inproc(label: str) -> None:
+    with _INPROC_LOCK:
+        _INPROC.pop(label, None)
+
+
+def find_inproc(label: str):
+    with _INPROC_LOCK:
+        return _INPROC.get(label)
+
+
+def _hash_chain(kv, prompt):
+    """The prompt's full-block sha1 chain, independent of whether this
+    replica enabled the prefix-cache INDEX (verification must work on
+    any paged decode replica; dedup simply finds nothing without the
+    index)."""
+    if kv.prefix is not None:
+        return kv.prefix.block_hashes(prompt)
+    from triton_dist_tpu.models.prefix_cache import PrefixCache
+    return PrefixCache(1, kv.page_size).block_hashes(prompt)
+
+
+class DisaggEndpoint:
+    """Both halves of the disaggregated handoff for one ModelServer."""
+
+    #: Verbs ``ModelServer._serve_command`` delegates here.
+    VERBS = frozenset({"kv_offer", "kv_ship", "kv_commit",
+                       "disagg_prefill"})
+
+    def __init__(self, server):
+        self.server = server
+        self.staging = kv_stream.HandoffStaging()
+        self._hid = itertools.count(1)
+        #: Injectable post-ship callback ``(handoff_id, block, seq)``,
+        #: called after every block leaves this PREFILL side (both
+        #: transport tiers) — the chaos harness's sever point
+        #: (testing/chaos.py ``sever_stream``).
+        self.ship_hook = None
+
+    def handle(self, cmd: str, req: dict) -> dict:
+        if cmd == "kv_offer":
+            return self._serve_offer(req)
+        if cmd == "kv_ship":
+            return self._serve_ship(req)
+        if cmd == "kv_commit":
+            return self._serve_commit(req)
+        return self._serve_disagg_prefill(req)
+
+    # -- decode side (receiver verbs) --------------------------------------
+    def _serve_offer(self, req: dict) -> dict:
+        severed = self.staging.purge_stale()
+        if severed:
+            # Half-received handoffs whose sender died (sever_stream):
+            # the staging table never leaks for a prefill replica's
+            # death.
+            obs.counter("disagg.streams_severed").inc(severed)
+        kv = self.server.engine.kv
+        hashes_hex = [str(h) for h in (req.get("hashes") or [])]
+        n_blocks = int(req["n_blocks"])
+        need_from = 0
+        if kv.prefix is not None and hashes_hex:
+            need_from = kv.prefix.chain_prefix_match(
+                [bytes.fromhex(h) for h in hashes_hex])
+        self.staging.open(str(req["handoff_id"]), hashes_hex, n_blocks,
+                          need_from, req.get("meta") or {})
+        obs.counter("disagg.offers").inc()
+        obs.counter("disagg.blocks_offered").inc(n_blocks)
+        if need_from:
+            obs.counter("disagg.blocks_deduped").inc(need_from)
+        trace.emit("i", "disagg.offer", "serving",
+                   args={"handoff_id": req["handoff_id"],
+                         "n_blocks": n_blocks, "need_from": need_from},
+                   trace_id=req.get("trace_id"))
+        return {"need_from": need_from}
+
+    def _serve_ship(self, req: dict) -> dict:
+        payload = req.get("_payload")
+        if payload is None:
+            raise ValueError("kv_ship carried no framed payload "
+                             "(nbytes + raw bytes after the line)")
+        seq = int(req["seq"])
+        self.staging.put(str(req["handoff_id"]), int(req["block"]),
+                         seq, payload)
+        obs.counter("disagg.stream_bytes").inc(len(payload))
+        return {"ok": True, "seq": seq}
+
+    def _serve_commit(self, req: dict) -> dict:
+        try:
+            return self._commit(req)
+        except Exception:
+            # Every reject — unknown/stale handoff, chain mismatch,
+            # broken signal sequence, admission failure (including a
+            # block the cache EVICTED between offer and commit) —
+            # reaches the prefill side as a structured error reply,
+            # whose fallback re-prefills locally. Never a wrong decode.
+            obs.counter("disagg.commit_rejects").inc()
+            raise
+
+    def _commit(self, req: dict) -> dict:
+        entry = self.staging.take(str(req["handoff_id"]))
+        prompt = [int(t) for t in req["prompt_ids"]]
+        kv = self.server.engine.kv
+        self.staging.verify(entry, prompt, kv.page_size,
+                            _hash_chain(kv, prompt))
+        trace.emit("i", "disagg.decode_admit", "serving",
+                   args={"handoff_id": req["handoff_id"],
+                         "shipped": len(entry["blocks"]),
+                         "need_from": entry["need_from"]},
+                   trace_id=req.get("trace_id"))
+        fut = self.server.scheduler.submit_preloaded(
+            prompt, int(req["gen_len"]), int(req["first"]),
+            entry["blocks"], stop_tokens=req.get("stop_tokens"),
+            trace_id=req.get("trace_id"))
+        tokens = fut.result()
+        obs.counter("disagg.decode_admits").inc()
+        return {"tokens": [int(t) for t in tokens]}
+
+    # -- prefill side (the verb a tiered router dispatches) ----------------
+    def _serve_disagg_prefill(self, req: dict) -> dict:
+        t0 = time.perf_counter()
+        sched = self.server.scheduler
+        prompt = [int(t) for t in req["prompt_ids"]]
+        gen_len = int(req.get("gen_len", 16))
+        stop = req.get("stop_tokens")
+        trace_id = str(req.get("trace_id") or trace.new_trace_id())
+
+        # Prefill-only pass: one generated token, with the finished KV
+        # chain captured at retirement (the scheduler runs kv_export
+        # just before retire_row, while the row still owns its
+        # blocks). A failed export leaves `box` empty and the fallback
+        # serves the whole request locally.
+        if gen_len <= 0:
+            return {"tokens": [[]], "gen_len": gen_len,
+                    "trace_id": trace_id}
+        box: dict = {}
+
+        def kv_export(sess, row, _req):
+            box["export"] = sess.export_row(row, prompt)
+
+        first = int(sched.submit(prompt, 1, stop_tokens=stop,
+                                 trace_id=trace_id,
+                                 kv_export=kv_export).result()[0])
+        trace.emit("i", "disagg.prefill_done", "serving",
+                   args={"prompt_len": len(prompt), "first": first},
+                   trace_id=trace_id)
+
+        if stop is None:
+            eos = getattr(self.server.engine.model.config,
+                          "eos_token_id", -1)
+            stop_set = {eos} if eos >= 0 else set()
+        else:
+            stop_set = {int(t) for t in stop}
+        if gen_len <= 1 or first in stop_set:
+            # Nothing left to decode: the prefill replica IS the
+            # answer, no handoff.
+            return {"tokens": [[first]], "gen_len": gen_len,
+                    "trace_id": trace_id}
+
+        export = box.get("export")
+        endpoint = req.get("decode_endpoint")
+        if export is not None and endpoint:
+            try:
+                tokens = self._stream_to_decode(
+                    str(endpoint), export, prompt, first, gen_len,
+                    stop, trace_id)
+                obs.counter("disagg.handoffs").inc()
+                obs.histogram("disagg.handoff_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
+                return {"tokens": [tokens], "gen_len": gen_len,
+                        "trace_id": trace_id,
+                        "disagg": {"decode": str(endpoint),
+                                   "shipped": export["n_blocks"]}}
+            except Exception as e:  # noqa: BLE001 — fallback contract
+                trace.emit("i", "disagg.fallback", "serving",
+                           args={"error": str(e)[:120]},
+                           trace_id=trace_id)
+        # Fallback: serve the FULL request locally. The prompt's
+        # blocks are still indexed in this replica's prefix cache, so
+        # the re-prefill is near-free; the client sees tokens, never
+        # the handoff's failure.
+        obs.counter("disagg.fallbacks").inc()
+        tokens = sched.submit(prompt, gen_len, stop_tokens=stop,
+                              trace_id=trace_id).result()
+        return {"tokens": [[int(t) for t in tokens]],
+                "gen_len": gen_len, "trace_id": trace_id,
+                "disagg": {"fallback": True}}
+
+    def _stream_to_decode(self, endpoint: str, export: dict, prompt,
+                          first: int, gen_len: int, stop,
+                          trace_id: str) -> list:
+        handoff_id = (f"{self.server.replica_id}"
+                      f"#{next(self._hid)}")
+        peer = find_inproc(endpoint)
+        if peer is not None:
+            return self._handoff_inproc(peer, handoff_id, export,
+                                        prompt, first, gen_len, stop,
+                                        trace_id)
+        host, _, port = endpoint.rpartition(":")
+        with kv_stream.KVStreamSender(host, int(port)) as tx:
+            need_from = tx.offer(handoff_id, export["hashes"],
+                                 export["n_blocks"], export["meta"],
+                                 trace_id=trace_id)
+            for j, s in kv_stream.ship_schedule(export["n_blocks"],
+                                                need_from):
+                tx.ship(handoff_id, j, s, export["blocks"][j])
+                obs.counter("disagg.blocks_shipped").inc()
+                obs.counter("disagg.ship_wire").inc()
+                if self.ship_hook is not None:
+                    self.ship_hook(handoff_id, j, s)
+            resp = tx.commit(handoff_id, prompt, first, gen_len,
+                             stop_tokens=stop, trace_id=trace_id)
+        return [int(t) for t in resp["tokens"]]
+
+    def _handoff_inproc(self, peer: "DisaggEndpoint", handoff_id: str,
+                        export: dict, prompt, first: int, gen_len: int,
+                        stop, trace_id: str) -> list:
+        """Same-process tier: the peer's verbs are called directly
+        (under ITS registry scope, so its disagg.* counters land on
+        the right replica) and every shipped payload rides the
+        one-sided symm-mem path — at world 1 the identity handover,
+        on a real mesh axis the remote-DMA shift protocol
+        (kv_stream.symm_ship)."""
+
+        def on_peer(fn, *a):
+            with obs.scoped_registry(peer.server.registry):
+                return fn(*a)
+
+        need_from = int(on_peer(peer._serve_offer, {
+            "handoff_id": handoff_id, "hashes": export["hashes"],
+            "n_blocks": export["n_blocks"], "meta": export["meta"],
+            "trace_id": trace_id})["need_from"])
+        mesh = getattr(self.server.engine.model, "mesh", None)
+        for j, s in kv_stream.ship_schedule(export["n_blocks"],
+                                            need_from):
+            payload = export["blocks"][j]
+            if mesh is not None:
+                import numpy as np
+                staged = np.frombuffer(payload, np.uint8)
+                moved = kv_stream.symm_ship(
+                    staged, mesh=mesh, axis=mesh.axis_names[0])
+                payload = np.asarray(moved, np.uint8).tobytes()
+            on_peer(peer._serve_ship, {
+                "handoff_id": handoff_id, "block": j, "seq": s,
+                "nbytes": len(payload), "_payload": payload})
+            obs.counter("disagg.blocks_shipped").inc()
+            obs.counter("disagg.ship_inproc").inc()
+            if self.ship_hook is not None:
+                self.ship_hook(handoff_id, j, s)
+        resp = on_peer(peer._serve_commit, {
+            "handoff_id": handoff_id, "prompt_ids": prompt,
+            "first": first, "gen_len": gen_len, "stop_tokens": stop,
+            "trace_id": trace_id})
+        return [int(t) for t in resp["tokens"]]

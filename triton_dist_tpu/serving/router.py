@@ -86,6 +86,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import os
 import threading
 import time
 
@@ -99,7 +100,7 @@ from triton_dist_tpu.serving.server import _Handler, _TCPServer
 
 __all__ = ["DEFAULT_BACKOFF_MS", "DEFAULT_DEADLINE_S",
            "DEFAULT_POLL_S", "DEFAULT_RETRIES", "DEFAULT_STORM",
-           "DEFAULT_TRY_TIMEOUT_S", "RouterServer"]
+           "DEFAULT_TRY_TIMEOUT_S", "RouterServer", "parse_tiers"]
 
 DEFAULT_RETRIES = 3           # max re-dispatches per request
 DEFAULT_BACKOFF_MS = 50       # base failover backoff (exponential)
@@ -133,15 +134,40 @@ class _Replica:
     """Router-side state for one replica endpoint."""
 
     __slots__ = ("endpoint", "label", "breaker", "inflight",
-                 "draining", "last_status")
+                 "draining", "last_status", "tier")
 
-    def __init__(self, endpoint, breaker: CircuitBreaker):
+    def __init__(self, endpoint, breaker: CircuitBreaker,
+                 tier: str = "unified"):
         self.endpoint = endpoint
         self.label = f"{endpoint[0]}:{endpoint[1]}"
         self.breaker = breaker
         self.inflight = 0          # router-side dispatches in flight
         self.draining = False      # router-side: stop placing
         self.last_status = None    # last observed FleetView status
+        self.tier = tier           # prefill / decode / unified
+
+
+def parse_tiers(spec: str) -> dict:
+    """Parse ``TDT_ROUTER_TIERS`` — semicolon-separated
+    ``tier=host:port`` entries, e.g.
+    ``prefill=10.0.0.1:8777;decode=10.0.0.2:8777;decode=10.0.0.3:8777``
+    — into ``{(host, port): tier}``. Unlisted replicas stay
+    ``unified``; a replica's OWN health-advertised tier (ModelServer
+    ``tier=...``) overrides this static map at every poll, so the env
+    knob is only needed for replicas that don't advertise."""
+    out: dict = {}
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        tier, sep, ep = part.partition("=")
+        tier = tier.strip().lower()
+        if not sep or tier not in ("prefill", "decode", "unified"):
+            raise ValueError(
+                f"TDT_ROUTER_TIERS entry {part!r} is not "
+                f"tier=host:port with tier in prefill/decode/unified")
+        out[parse_endpoint(ep.strip())] = tier
+    return out
 
 
 class RouterServer:
@@ -167,7 +193,8 @@ class RouterServer:
                  breaker_threshold: int | None = None,
                  breaker_cooldown_s: float | None = None,
                  fleet: FleetView | None = None,
-                 fleet_kwargs: dict | None = None):
+                 fleet_kwargs: dict | None = None,
+                 tiers: dict | None = None):
         if not endpoints:
             raise ValueError("RouterServer needs at least one replica "
                              "endpoint")
@@ -201,6 +228,14 @@ class RouterServer:
         self._breaker_cooldown_s = breaker_cooldown_s
         self.fleet = (fleet if fleet is not None
                       else FleetView(endpoints, **(fleet_kwargs or {})))
+        # Two-tier placement config (ISSUE 18): static endpoint→tier
+        # map (ctor > TDT_ROUTER_TIERS), refined live by each
+        # replica's health-advertised tier and by router_retier.
+        self._tiers = ({parse_endpoint(k): str(v)
+                        for k, v in tiers.items()}
+                       if tiers is not None else parse_tiers(
+                           os.environ.get("TDT_ROUTER_TIERS", "")))
+        self._retiered: set = set()     # router_retier overrides
         self._lock = threading.Lock()   # replica dict + inflight
         self._replicas: dict = {}
         for ep in self.fleet.endpoints:
@@ -238,7 +273,8 @@ class RouterServer:
             return _Replica(ep, CircuitBreaker(
                 f"replica.{ep[0]}:{ep[1]}",
                 threshold=self._breaker_threshold,
-                cooldown_s=self._breaker_cooldown_s))
+                cooldown_s=self._breaker_cooldown_s),
+                tier=self._tiers.get(ep, "unified"))
 
     def add_replica(self, endpoint) -> dict:
         """Attach a replica live: it joins the fleet view now and
@@ -293,6 +329,44 @@ class RouterServer:
         return {"removed": st.label, "drained": drained,
                 "inflight": st.inflight}
 
+    def retier(self, endpoint, tier: str,
+               wait_s: float | None = None) -> dict:
+        """Live re-specialization (ISSUE 18, ``router_retier``):
+        drain the replica ROUTER-SIDE (stop placing, wait up to
+        ``wait_s`` — default 10 s — for this router's in-flight
+        dispatches to it to finish), flip its tier, undrain. The
+        override outlives later health polls (a replica advertising
+        its boot-time tier must not flap the operator's decision
+        back)."""
+        tier = str(tier).lower()
+        if tier not in ("prefill", "decode", "unified"):
+            obs.counter("router.errors").inc()
+            return {"error": f"unknown tier {tier!r} (prefill / "
+                             f"decode / unified)", "type": "ValueError"}
+        ep = parse_endpoint(endpoint)
+        with self._lock:
+            st = self._replicas.get(ep)
+        if st is None:
+            obs.counter("router.errors").inc()
+            return {"error": f"unknown replica {endpoint!r}"}
+        st.draining = True
+        self._publish_draining()
+        deadline = time.monotonic() + (10.0 if wait_s is None
+                                       else float(wait_s))
+        while st.inflight > 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        drained = st.inflight == 0
+        st.tier = tier
+        self._tiers[ep] = tier
+        self._retiered.add(ep)
+        st.draining = False
+        self._publish_draining()
+        obs.counter("router.retiers").inc()
+        trace.instant("router.retier", "serving",
+                      args={"replica": st.label, "tier": tier})
+        return {"retiered": st.label, "tier": tier,
+                "drained": drained}
+
     def _publish_draining(self) -> None:
         with self._lock:
             n = sum(1 for st in self._replicas.values() if st.draining)
@@ -307,6 +381,14 @@ class RouterServer:
                 st = self._replicas.get(ep)
             if st is None:
                 continue
+            adv = (r["health"] or {}).get("tier")
+            if adv and ep not in self._retiered:
+                # Health-advertised tier (ModelServer tier=...) wins
+                # over the static TDT_ROUTER_TIERS map — the replica
+                # knows its own role. A live router_retier is the one
+                # exception: the operator's re-specialization must not
+                # flap back on the next poll.
+                st.tier = str(adv)
             prev, st.last_status = st.last_status, r["status"]
             if r["status"] == "down" and prev not in (None, "down"):
                 # A replica just went dark: leave the postmortem NOW,
@@ -360,6 +442,107 @@ class RouterServer:
             if st.breaker.allow():
                 return ep, st
         return None, None
+
+    def _tier_pools(self):
+        """Two-tier placement pools (ISSUE 18): placeable prefill
+        replicas ranked by TTFT burn and decode replicas by TPOT burn
+        — each tier is scored by the SLO its phase owns, lower burn
+        first, the router's live in-flight count as the tiebreak
+        (same real-time term as ``_candidates``)."""
+        prefill, decode = [], []
+        for r in self.fleet.replicas():
+            ep = parse_endpoint(r["endpoint"])
+            if r["status"] == "down":
+                continue
+            with self._lock:
+                st = self._replicas.get(ep)
+            if st is None or st.draining:
+                continue
+            h = r["health"] or {}
+            if h.get("draining"):
+                continue
+            slo = h.get("slo") or {}
+
+            def burn(name):
+                return float((slo.get(name) or {}).get("burn") or 0.0)
+
+            if st.tier == "prefill":
+                prefill.append((burn("ttft")
+                                + INFLIGHT_WEIGHT * st.inflight,
+                                ep, st))
+            elif st.tier == "decode":
+                decode.append((burn("tpot")
+                               + INFLIGHT_WEIGHT * st.inflight,
+                               ep, st))
+        prefill.sort(key=lambda t: t[0])
+        decode.sort(key=lambda t: t[0])
+        return ([(ep, st) for _, ep, st in prefill],
+                [(ep, st) for _, ep, st in decode])
+
+    def _try_disagg(self, req: dict, payload: dict,
+                    deadline: float):
+        """Disaggregated dispatch, preference-with-fallback: when the
+        fleet has BOTH a prefill and a decode pool, a single-prompt
+        generation goes to the best prefill replica as a
+        ``disagg_prefill`` verb naming the best decode replica; ANY
+        failure (shed, transport death, replica-fault reply) returns
+        ``None`` and the caller's ordinary placement loop serves the
+        request unified — the handoff is an optimization, never a new
+        way to fail a client."""
+        prompts = req.get("prompt_ids") or []
+        if len(prompts) != 1:
+            # The handoff verb moves one row's KV chain; batched
+            # requests keep the unified path.
+            return None
+        prefill, decode = self._tier_pools()
+        if not prefill or not decode:
+            return None
+        d_ep, d_st = decode[0]
+        for p_ep, p_st in prefill:
+            if not p_st.breaker.allow():
+                continue
+            budget = deadline - time.perf_counter()
+            if budget <= 0:
+                return None
+            body = dict(payload)
+            body.update({"cmd": "disagg_prefill",
+                         "prompt_ids": list(prompts[0]),
+                         "decode_endpoint": d_st.label})
+            obs.counter(f"router.placements.{p_st.label}").inc()
+            with self._lock:
+                p_st.inflight += 1
+                d_st.inflight += 1
+            try:
+                resp = self._dispatch(p_ep, body,
+                                      min(self.try_timeout_s, budget))
+            except (OSError, ValueError):
+                p_st.breaker.record_failure()
+                obs.counter("router.disagg_errors").inc()
+                return None
+            finally:
+                with self._lock:
+                    p_st.inflight -= 1
+                    d_st.inflight -= 1
+            err = (resp.get("error")
+                   if isinstance(resp, dict) else "torn reply")
+            if isinstance(resp, dict) \
+                    and resp.get("type") in _SHED_TYPES:
+                p_st.breaker.record_success()
+                return None
+            if err is None or (isinstance(resp, dict) and
+                               resp.get("type") in _CLIENT_FAULT_TYPES):
+                p_st.breaker.record_success()
+                obs.counter("router.disagg_dispatches").inc()
+                if isinstance(resp, dict):
+                    resp.setdefault("replica", p_st.label)
+                    resp.setdefault("disagg_route",
+                                    {"prefill": p_st.label,
+                                     "decode": d_st.label})
+                return resp
+            p_st.breaker.record_failure()
+            obs.counter("router.disagg_errors").inc()
+            return None
+        return None
 
     # -- dispatch ----------------------------------------------------------
     def _dispatch(self, ep, payload: dict, timeout: float) -> dict:
@@ -431,6 +614,10 @@ class RouterServer:
                            args={"gen_len": req.get("gen_len"),
                                  "batch": len(req.get("prompt_ids")
                                               or [])}):
+            resp = self._try_disagg(req, payload, deadline)
+            if resp is not None:
+                resp.setdefault("trace_id", trace_id)
+                return resp
             while True:
                 ep, st = self._place(excluded)
                 if ep is None and excluded and failed \
@@ -579,6 +766,7 @@ class RouterServer:
                 "status": r["status"],
                 "age_s": r["age_s"],
                 "score": r["score"],
+                "tier": st.tier,
                 "breaker": st.breaker.state,
                 "inflight": st.inflight,
                 "draining": bool(
@@ -620,6 +808,20 @@ class RouterServer:
                 req["endpoint"], drain=bool(req.get("drain", True)),
                 wait_s=float(wait_s) if wait_s is not None else None,
                 replica_drain=bool(req.get("replica_drain")))
+        if cmd == "router_retier":
+            if "endpoint" not in req or "tier" not in req:
+                obs.counter("router.errors").inc()
+                return {"error": "router_retier needs endpoint and "
+                                 "tier"}
+            wait_s = req.get("wait_s")
+            try:
+                return self.retier(
+                    req["endpoint"], req["tier"],
+                    wait_s=float(wait_s) if wait_s is not None
+                    else None)
+            except ValueError as e:
+                obs.counter("router.errors").inc()
+                return {"error": str(e), "type": "ValueError"}
         if cmd == "health":
             # The router's OWN health (a router is not a replica —
             # point FleetView at the replicas, or use router_status,
@@ -654,8 +856,8 @@ class RouterServer:
             return {"dumped": path, "trace": trace.stats()}
         obs.counter("router.errors").inc()
         return {"error": f"unknown cmd {cmd!r} (known: router_status, "
-                         f"router_add, router_remove, health, "
-                         f"metrics, dump_trace)"}
+                         f"router_add, router_remove, router_retier, "
+                         f"health, metrics, dump_trace)"}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "RouterServer":

@@ -162,7 +162,7 @@ class Request:
     __slots__ = ("prompt", "gen_len", "stop_set", "trace_id", "rid",
                  "t_submit", "t_admit", "t_first", "tokens", "error",
                  "done", "cached", "chunks", "timing", "draft_ms",
-                 "verify_ms")
+                 "verify_ms", "kv_export", "preloaded")
 
     def __init__(self, prompt, gen_len: int, stop_set, trace_id, rid):
         self.prompt = prompt
@@ -181,6 +181,16 @@ class Request:
         self.timing: dict | None = None   # attribution waterfall
         self.draft_ms = 0.0        # spec draft time this request rode
         self.verify_ms = 0.0       # spec verify time this request rode
+        # Disaggregated handoff hooks (ISSUE 18, serving/disagg.py):
+        # ``kv_export`` is called by the pump as fn(session, row,
+        # request) just BEFORE the row retires — while its KV blocks
+        # are still mapped — so a prefill replica can extract the
+        # finished chain for streaming; ``preloaded`` =
+        # {"first": tok, "blocks": {j: payload}} admits the row
+        # DECODE-ONLY through StreamSession.adopt_row instead of
+        # running a prefill program.
+        self.kv_export = None
+        self.preloaded: dict | None = None
 
     def result(self, timeout: float | None = None) -> list[int]:
         """Block until the request finishes; returns the generated
@@ -378,15 +388,19 @@ class Scheduler:
         return Request(prompt, gen_len, stop_set, trace_id, self._rid)
 
     def submit(self, prompt, gen_len: int, stop_tokens=None,
-               trace_id: str | None = None) -> Request:
+               trace_id: str | None = None, kv_export=None) -> Request:
         """Enqueue one prompt; returns its :class:`Request` future.
         Raises :class:`QueueFull` when ``max_waiting`` requests are
-        already queued, ``ValueError`` on an unservable request."""
+        already queued, ``ValueError`` on an unservable request.
+        ``kv_export`` (ISSUE 18): per-request retirement hook — see
+        :class:`Request`; attached atomically with the enqueue so the
+        pump can never retire the row before the hook exists."""
         return self.submit_many([prompt], gen_len, stop_tokens=stop_tokens,
-                                trace_id=trace_id)[0]
+                                trace_id=trace_id, kv_export=kv_export)[0]
 
     def submit_many(self, prompts, gen_len: int, stop_tokens=None,
-                    trace_id: str | None = None) -> list[Request]:
+                    trace_id: str | None = None,
+                    kv_export=None) -> list[Request]:
         """Atomically enqueue several prompts (one client request's
         batch): either every prompt is queued or none is — a
         half-admitted batch is worse than a clean ``queue_full``
@@ -400,6 +414,9 @@ class Scheduler:
                     "nothing new; retry on another replica")
             reqs = [self._make_request(p, gen_len, stop_tokens, trace_id)
                     for p in prompts]
+            if kv_export is not None:
+                for r in reqs:
+                    r.kv_export = kv_export
             live = [r for r in reqs if r.gen_len > 0]
             for r in reqs:
                 if r.gen_len <= 0:      # nothing to generate
@@ -426,6 +443,41 @@ class Scheduler:
                 obs.gauge("serving.queue_depth").set(len(self._queue))
                 self._cond.notify()
         return reqs
+
+    def submit_preloaded(self, prompt, gen_len: int, first: int,
+                         blocks: dict, stop_tokens=None,
+                         trace_id: str | None = None) -> Request:
+        """Enqueue one DECODE-ONLY request from a verified
+        disaggregated handoff (ISSUE 18, serving/disagg.py): the KV
+        chain for ``prompt`` was streamed in (``blocks``: block index
+        → packed payload) and ``first`` is the prefill side's sampled
+        token, so admission runs :meth:`StreamSession.adopt_row`
+        instead of a prefill program. Same FIFO queue, backpressure,
+        and drain semantics as :meth:`submit`; the request's tokens
+        include ``first``."""
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("scheduler is not running")
+            if self._draining:
+                raise Draining(
+                    "scheduler is draining — this replica admits "
+                    "nothing new; retry on another replica")
+            req = self._make_request(prompt, gen_len, stop_tokens,
+                                     trace_id)
+            req.preloaded = {"first": int(first), "blocks": blocks}
+            if req.gen_len <= 0:
+                req.done.set()
+                return req
+            if len(self._queue) + 1 > self.max_waiting:
+                obs.counter("serving.rejected_queue_full").inc()
+                raise QueueFull(
+                    f"admission queue full ({len(self._queue)} "
+                    f"waiting, max_waiting {self.max_waiting})")
+            self._queue.append(req)
+            self._inflight += 1
+            obs.gauge("serving.queue_depth").set(len(self._queue))
+            self._cond.notify()
+        return req
 
     def generate(self, prompt, gen_len: int, stop_tokens=None,
                  trace_id: str | None = None,
@@ -573,6 +625,17 @@ class Scheduler:
                     self.slo.observe("ttft", ttft_ms)
             budgets[row] -= 1
             if budgets[row] <= 0 or tok in req.stop_set:
+                if req.kv_export is not None:
+                    # Disaggregated handoff (ISSUE 18): extract the
+                    # row's finished KV chain while its blocks are
+                    # still mapped — retire_row releases them eagerly.
+                    # Export failure degrades the HANDOFF (the caller
+                    # falls back to a local re-prefill), never the
+                    # request itself.
+                    try:
+                        req.kv_export(sess, row, req)
+                    except Exception:  # noqa: BLE001 — handoff-scoped
+                        obs.counter("disagg.export_errors").inc()
                 sess.retire_row(row)
                 rows.pop(row)
                 budgets.pop(row)
@@ -624,9 +687,18 @@ class Scheduler:
                        trace_id=req.trace_id)
             try:
                 with self._bind(req):
-                    first = sess.prefill_into_row(
-                        row, req.prompt, chunk=self.prefill_chunk,
-                        gen_budget=req.gen_len)
+                    if req.preloaded is not None:
+                        # Decode-only admission from a verified
+                        # disaggregated handoff (ISSUE 18): the KV
+                        # chain was streamed in, no prefill runs.
+                        first = sess.adopt_row(
+                            row, req.prompt,
+                            req.preloaded["first"],
+                            req.gen_len, req.preloaded["blocks"])
+                    else:
+                        first = sess.prefill_into_row(
+                            row, req.prompt, chunk=self.prefill_chunk,
+                            gen_budget=req.gen_len)
             except Exception as e:  # noqa: BLE001 — degrade THIS request
                 sess.cancel_prefill(row)
                 obs.counter("serving.admit_errors").inc()

@@ -169,6 +169,19 @@ class _Handler(socketserver.StreamRequestHandler):
                 resp = {"error": f"malformed request: {e}",
                         "type": type(e).__name__}
             else:
+                # Length-prefixed binary framing (ISSUE 18): a request
+                # carrying "nbytes" is followed by exactly that many
+                # raw bytes (the kv_ship block payload) — read them
+                # off the SAME buffered stream before the next JSON
+                # line. A short read means the peer died mid-frame:
+                # connection-scoped, like any other sever.
+                nbytes = req.get("nbytes") if isinstance(req, dict) \
+                    else None
+                if nbytes is not None:
+                    payload = self.rfile.read(int(nbytes))
+                    if len(payload) != int(nbytes):
+                        return
+                    req["_payload"] = payload
                 try:
                     resp = self.server.model_server._serve_request(req)
                 except Exception as e:  # report, keep serving
@@ -221,14 +234,21 @@ class ModelServer:
                  scheduler: bool | None = None,
                  max_waiting: int | None = None,
                  prefill_chunk: int | None = None,
-                 replica_id: str | None = None, registry=None):
+                 replica_id: str | None = None, registry=None,
+                 tier: str = "unified"):
         """``replica_id``: this server's stable fleet identity
         (explicit > ``TDT_REPLICA_ID`` > ``host:port`` after bind).
         ``registry``: ``"private"`` gives the server its own metrics
         registry (or pass a ``obs.Registry``) — REQUIRED for distinct
         per-replica metrics when several servers share one process;
         the default (None) keeps the historical process-global
-        registry."""
+        registry. ``tier`` (ISSUE 18): this replica's advertised role
+        in a disaggregated fleet — ``"prefill"``, ``"decode"``, or the
+        default ``"unified"``; it rides the health verb so a tiered
+        router (``TDT_ROUTER_TIERS``) can pool replicas without extra
+        config, and any scheduler-path paged server answers the
+        ``kv_*``/``disagg_prefill`` verbs regardless of tier (the
+        tier is placement policy, not capability)."""
         self.engine = engine
         self.params = params
         self.registry = None
@@ -294,6 +314,17 @@ class ModelServer:
                     prefill_chunk=prefill_chunk,
                     replica_id=self.replica_id,
                     registry=self.registry).start()
+            self.tier = str(tier)
+            self.disagg = None
+            if self.scheduler is not None \
+                    and getattr(engine, "paged", False):
+                # Disaggregated handoff endpoint (ISSUE 18,
+                # serving/disagg.py): decode-only admission needs the
+                # paged pools; non-paged or serialized servers simply
+                # don't answer the kv verbs.
+                from triton_dist_tpu.serving.disagg import \
+                    DisaggEndpoint
+                self.disagg = DisaggEndpoint(self)
         except BaseException:
             self._srv.server_close()
             raise
@@ -354,7 +385,8 @@ class ModelServer:
             health = _fleet.replica_health(
                 self.replica_id, seq, self._started_monotonic,
                 registry=self.registry or obs.get_registry(),
-                engine=self.engine, scheduler=self.scheduler)
+                engine=self.engine, scheduler=self.scheduler,
+                tier=self.tier)
             obs.gauge("serving.replica_uptime_s").set(
                 health["uptime_s"])
             return {"health": health}
@@ -443,10 +475,18 @@ class ModelServer:
                 last_s=req.get("last_s"),
                 series=list(series) if series else None,
                 max_points=req.get("max_points"))}
+        if self.disagg is not None and cmd in self.disagg.VERBS:
+            # Disaggregated handoff verbs (ISSUE 18): kv_offer /
+            # kv_ship / kv_commit (decode side) and disagg_prefill
+            # (prefill side). A verb failure answers THIS request with
+            # the structured error the sender's fallback contract
+            # expects (_serve_lines wraps it).
+            return self.disagg.handle(cmd, req)
         obs.counter("server.errors").inc()
         return {"error": f"unknown cmd {cmd!r} (known: metrics, "
                          f"health, drain, dump_trace, request_stats, "
-                         f"history)"}
+                         f"history, kv_offer, kv_ship, kv_commit, "
+                         f"disagg_prefill)"}
 
     def _effective_gen_len(self, req: dict, prompts) -> int:
         """Clamp the requested gen_len to the protocol cap (4096) AND
@@ -578,9 +618,19 @@ class ModelServer:
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
         self._thread.start()
+        if self.disagg is not None:
+            # Same-process transport tier (ISSUE 18): a sibling
+            # prefill replica in this process hands blocks over
+            # directly instead of re-entering the TCP stack.
+            from triton_dist_tpu.serving import disagg as _disagg
+            _disagg.register_inproc(f"{self.host}:{self.port}",
+                                    self.disagg)
         return self
 
     def stop(self):
+        if self.disagg is not None:
+            from triton_dist_tpu.serving import disagg as _disagg
+            _disagg.unregister_inproc(f"{self.host}:{self.port}")
         self._srv.shutdown()
         self._srv.server_close()
         if self.scheduler is not None:

@@ -20,6 +20,13 @@ FleetView/breaker transition it claims):
   failure class — liveness checks pass while the replica serves
   nothing; only a dispatch deadline (the router's per-attempt
   timeout → breaker) catches it. FleetView: stays ``live``.
+- :func:`sever_stream` — kills a PREFILL replica mid-KV-stream
+  (ISSUE 18): the disagg endpoint's injectable ``ship_hook`` fires
+  after N shipped blocks, kill-9s the replica and aborts the stream —
+  the decode side is left holding a half-received handoff (its
+  staging entry goes stale and counts ``disagg.streams_severed``),
+  the router sees a dead socket and re-places the request on a
+  healthy replica, and the client sees tokens, never an error.
 - :class:`ChaosProxy` — a TCP proxy fronting a replica with
   switchable connection faults, for failure classes that live in the
   NETWORK rather than the replica: ``blackhole`` (accepts, swallows
@@ -43,7 +50,8 @@ import contextlib
 import socket
 import threading
 
-__all__ = ["ChaosProxy", "Wedge", "kill_replica", "wedge_pump"]
+__all__ = ["ChaosProxy", "SeveredStream", "Wedge", "kill_replica",
+           "sever_stream", "wedge_pump"]
 
 _BUF = 65536
 
@@ -114,6 +122,55 @@ def wedge_pump(scheduler):
     finally:
         scheduler.pump_hook = prev
         w.release()
+
+
+class SeveredStream:
+    """Handle for a severed KV stream: ``fired`` is set once the
+    prefill replica was killed mid-stream; ``blocks`` counts how many
+    blocks actually shipped before the cut."""
+
+    def __init__(self):
+        self.fired = threading.Event()
+        self.blocks = 0
+
+
+@contextlib.contextmanager
+def sever_stream(prefill_server, after_blocks: int = 1):
+    """Kill a prefill replica in the middle of a KV-block stream.
+
+    Arms the server's :class:`~triton_dist_tpu.serving.disagg.
+    DisaggEndpoint` ``ship_hook``: once ``after_blocks`` blocks have
+    left for the decode side, the hook :func:`kill_replica`-s the
+    prefill server (sockets severed, pump stopped — so even the local
+    re-prefill fallback dies with it, exactly like a crashed process)
+    and aborts the stream. The decode side never sees ``kv_commit``:
+    its half-received staging entry goes stale and is purged on the
+    next offer (``disagg.streams_severed``). Yields a
+    :class:`SeveredStream`; the hook is restored on exit."""
+    dis = prefill_server.disagg
+    if dis is None:
+        raise ValueError("server has no disagg endpoint "
+                         "(scheduler-path paged engines only)")
+    handle = SeveredStream()
+    prev = dis.ship_hook
+
+    def hook(handoff_id, block, seq):
+        del handoff_id, block, seq
+        handle.blocks += 1
+        if handle.blocks >= after_blocks and not handle.fired.is_set():
+            handle.fired.set()
+            kill_replica(prefill_server)
+            raise ConnectionError(
+                f"chaos: prefill killed mid-stream after "
+                f"{handle.blocks} block(s)")
+        if handle.fired.is_set():
+            raise ConnectionError("chaos: prefill is dead")
+
+    dis.ship_hook = hook
+    try:
+        yield handle
+    finally:
+        dis.ship_hook = prev
 
 
 class ChaosProxy:

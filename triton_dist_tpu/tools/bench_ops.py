@@ -552,6 +552,51 @@ def check_history_wellformed(extras: dict) -> list[str]:
     return fails
 
 
+def check_disagg_wellformed(extras: dict) -> list[str]:
+    """Failure strings when the serving_disagg part ran (its tokens/s
+    key exists) without leaving well-formed disaggregation evidence
+    (ISSUE 18):
+
+    - ``serving_disagg_vs_unified`` present and positive (the 1
+      prefill + 2 decode fleet vs 3 unified replicas on the same
+      workload — the BASELINE.json cpu floor gates magnitude, this
+      check guards shape);
+    - ``serving_disagg_handoffs`` ≥ 1 — at least one prefill→decode
+      KV stream actually completed (zero would mean every request
+      fell back and the ratio compared nothing);
+    - ``serving_disagg_dedup_ratio`` in [0, 1] — blocks deduped over
+      blocks offered: the content-addressed negotiation's yield is a
+      RATIO by construction, anything outside the interval means the
+      counters are wrong, not the workload.
+
+    Empty when the part did not run."""
+    if "serving_disagg_tokens_per_s" not in extras:
+        return []
+    fails = []
+    v = extras.get("serving_disagg_vs_unified")
+    if not isinstance(v, (int, float)) or isinstance(v, bool) \
+            or float(v) <= 0.0:
+        fails.append(
+            f"serving_disagg_vs_unified: missing/malformed ({v!r}) — "
+            f"the serving_disagg part ran but published no "
+            f"disagg-vs-unified ratio")
+    ho = extras.get("serving_disagg_handoffs")
+    if not isinstance(ho, (int, float)) or isinstance(ho, bool) \
+            or ho < 1:
+        fails.append(
+            f"serving_disagg_handoffs: want >= 1 completed KV "
+            f"handoff, got {ho!r} — the disagg leg fell back to "
+            f"unified serving throughout")
+    dr = extras.get("serving_disagg_dedup_ratio")
+    if not isinstance(dr, (int, float)) or isinstance(dr, bool) \
+            or not 0.0 <= float(dr) <= 1.0:
+        fails.append(
+            f"serving_disagg_dedup_ratio: want a ratio in [0, 1], "
+            f"got {dr!r} — blocks_deduped/blocks_offered accounting "
+            f"is broken")
+    return fails
+
+
 def _extras_from_file(path: str) -> dict:
     """Extras dict from any bench artifact: a bench.py checkpoint
     ({"extras": ...}), a bench.py result line ({"metric", "extras"}),
@@ -615,6 +660,7 @@ def run_regress(baseline_path: str, from_file: str | None,
     fails += check_fleet_wellformed(extras)
     fails += check_router_wellformed(extras)
     fails += check_history_wellformed(extras)
+    fails += check_disagg_wellformed(extras)
     fails += check_overlap_measured_wellformed(extras)
     fails += check_measured_overlap_floors(
         extras, load_measured_overlap_floors(baseline_path, tier))

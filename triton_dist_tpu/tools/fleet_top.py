@@ -90,6 +90,9 @@ def _row_cells(r: dict) -> list:
     return [
         r.get("replica_id") or r.get("endpoint") or "?",
         r.get("status", "?"),
+        # Disagg placement tier (ISSUE 18): which pool this replica
+        # serves — advertised in health, "-" pre-disagg.
+        str(h.get("tier") or "-"),
         f"{_fmt(r.get('age_s'))}s",
         _fmt(h.get("queue_depth")),
         f"{occ}/{_fmt(batch)}" if batch is not None else occ,
@@ -102,7 +105,7 @@ def _row_cells(r: dict) -> list:
     ]
 
 
-_HEADER = ["replica", "st", "age", "q", "occ", "ttft p50/p99",
+_HEADER = ["replica", "st", "tier", "age", "q", "occ", "ttft p50/p99",
            "tpot p50/p99", "brch", "score"]
 
 
@@ -189,8 +192,8 @@ def render(state: dict) -> str:
     return "\n".join(lines)
 
 
-_ROUTER_HEADER = ["replica", "st", "age", "breaker", "infl", "drain",
-                  "score", "placed"]
+_ROUTER_HEADER = ["replica", "st", "tier", "age", "breaker", "infl",
+                  "drain", "score", "placed"]
 
 
 def render_router(status: dict) -> str:
@@ -212,6 +215,7 @@ def render_router(status: dict) -> str:
             table.append([
                 rid,
                 r.get("status", "?"),
+                str(r.get("tier") or "-"),
                 f"{_fmt(r.get('age_s'))}s",
                 r.get("breaker", "?"),
                 _fmt(r.get("inflight")),
@@ -232,7 +236,10 @@ def render_router(status: dict) -> str:
                        ("router.shed", "shed"),
                        ("router.no_replicas", "no-replica"),
                        ("router.dispatch_errors", "dispatch-err"),
-                       ("router.failover_storms", "storms")):
+                       ("router.failover_storms", "storms"),
+                       ("router.disagg_dispatches", "disagg"),
+                       ("router.disagg_errors", "disagg-err"),
+                       ("router.retiers", "retiers")):
         if key in c:
             bits.append(f"{label} {_fmt(c[key])}")
     if bits:

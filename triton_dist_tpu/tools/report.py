@@ -138,6 +138,43 @@ def render_kv(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_disagg(snap: dict) -> str:
+    """Summarize the disaggregated prefill/decode plane (``disagg.*``
+    metrics, docs/serving.md "Disaggregated prefill/decode"): stream
+    counters — handoffs, blocks shipped/deduped by transport tier,
+    fallbacks, severed streams — plus handoff-latency percentiles
+    interpolated from the ``disagg.handoff_ms`` histogram and the
+    end-to-end dedup ratio. Empty string when the snapshot carries no
+    disagg metrics (a unified fleet)."""
+    counters = {k: v for k, v in snap.get("counters", {}).items()
+                if k.startswith("disagg.")}
+    hists = {k: h for k, h in snap.get("histograms", {}).items()
+             if k.startswith("disagg.")}
+    if not counters and not hists:
+        return ""
+    from triton_dist_tpu.obs import histogram_quantile
+    lines = ["#### disagg", "| metric | value |", "|---|---|"]
+    for k in sorted(counters):
+        v = counters[k]
+        lines.append(f"| {k} | "
+                     f"{int(v) if float(v) == int(v) else v} |")
+    for k in sorted(hists):
+        h = hists[k]
+        p50 = histogram_quantile(h, 0.50)
+        p99 = histogram_quantile(h, 0.99)
+        lines.append(
+            f"| {k} | n={h.get('count', 0)} "
+            f"p50={round(p50, 3) if p50 is not None else '-'} "
+            f"p99={round(p99, 3) if p99 is not None else '-'} "
+            f"max={h.get('max')} |")
+    offered = counters.get("disagg.blocks_offered")
+    if offered:
+        lines.append(
+            f"| dedup ratio | "
+            f"{round(counters.get('disagg.blocks_deduped', 0) / offered, 4)} |")
+    return "\n".join(lines)
+
+
 def render_fleet(merged: dict | None) -> str:
     """Summarize a fleet-merged snapshot (``obs.fleet.
     merge_fleet_snapshots`` — bench.py's ``serving_fleet`` part embeds
@@ -369,6 +406,7 @@ def render_telemetry(snap: dict) -> str:
     resil = render_resilience(snap)
     serving = render_serving(snap)
     kv = render_kv(snap)
+    disagg = render_disagg(snap)
     fleet = render_fleet(snap.get("fleet"))
     router = render_router(snap.get("router"))
     tracing = render_tracing(snap.get("trace"))
@@ -382,6 +420,7 @@ def render_telemetry(snap: dict) -> str:
     skip = lambda k: (k.startswith("resilience.")  # noqa: E731
                       or (bool(serving) and k.startswith("serving."))
                       or (bool(kv) and k.startswith("kv."))
+                      or (bool(disagg) and k.startswith("disagg."))
                       or (bool(tracing) and k.startswith("trace."))
                       or (bool(devprof)
                           and (k.startswith("device.")
@@ -405,6 +444,8 @@ def render_telemetry(snap: dict) -> str:
         lines += [serving, ""]
     if kv:
         lines += [kv, ""]
+    if disagg:
+        lines += [disagg, ""]
     if fleet:
         lines += [fleet, ""]
     if router:
